@@ -1,0 +1,20 @@
+"""gan_deeplearning4j_tpu — a TPU-native deep-learning framework.
+
+A from-scratch JAX/XLA/Pallas re-design of the capability surface of
+``javadev-berlin/gan_deeplearning4j`` (DL4J ComputationGraph + ND4J + libnd4j +
+dl4j-spark): a named-layer computation-graph API with per-layer optimizers and
+transfer-learning surgery, ops lowered to XLA/Pallas instead of libnd4j
+CPU/CUDA kernels, and data-parallel replica sync over ICI all-reduce instead of
+Spark parameter averaging / Aeron gradient sharing.
+
+Layer map (reference SURVEY.md §1 -> this package):
+  L1/L2 ndarray+kernels  -> jax.Array on PJRT + ops/ (XLA, Pallas)
+  L3 ComputationGraph    -> graph/ (named-layer graph builder, autodiff via jax.grad)
+  L4 dl4j-spark DP       -> parallel/ (pjit/shard_map + psum over ICI)
+  L5 DataVec CSV         -> data/ (CSV pipeline, native C++ fast loader)
+  L7 the two mains       -> train/ (cv_main, insurance_main)
+"""
+
+__version__ = "0.1.0"
+
+from gan_deeplearning4j_tpu.runtime import backend  # noqa: F401

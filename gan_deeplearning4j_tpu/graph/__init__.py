@@ -1,0 +1,25 @@
+from gan_deeplearning4j_tpu.graph.graph import (  # noqa: F401
+    ComputationGraph,
+    GraphBuilder,
+    InputSpec,
+)
+from gan_deeplearning4j_tpu.graph.layers import (  # noqa: F401
+    BatchNorm,
+    Conv2D,
+    ConvTranspose2D,
+    Dense,
+    Dropout,
+    MaxPool2D,
+    Merge,
+    Output,
+    Upsampling2D,
+)
+from gan_deeplearning4j_tpu.graph.preprocessors import (  # noqa: F401
+    CnnToFeedForward,
+    FeedForwardToCnn,
+)
+from gan_deeplearning4j_tpu.graph.serialization import read_model, write_model  # noqa: F401
+from gan_deeplearning4j_tpu.graph.transfer import (  # noqa: F401
+    FineTuneConfiguration,
+    TransferLearning,
+)

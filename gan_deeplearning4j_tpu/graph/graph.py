@@ -1,0 +1,400 @@
+"""Named-layer computation graph — DL4J ``ComputationGraph`` re-designed
+TPU-first.
+
+API surface mirrors what the reference exercises
+(dl4jGANComputerVision.java:111-160, 322-351, 387-527): a builder with named
+layers and explicit wiring, per-layer updaters (freezing = lr 0.0), input
+types with automatic preprocessor insertion, ``init`` / ``output`` / ``fit`` /
+``get_param`` / ``set_param`` / ``summary``.
+
+The execution model is nothing like DL4J's: parameters are an immutable
+pytree ``{layer_name: {param_name: jax.Array}}``; forward/backward/update is
+ONE jitted XLA computation per step (traced once, cached), instead of DL4J's
+per-layer native-kernel dispatch.  ``set_param`` is a pytree functional
+update — because jax.Arrays are immutable, the reference's 30+ per-iteration
+cross-graph ``setParam`` copies (SURVEY.md §3.2) become free reference
+assignments here, no device traffic.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Dict, List, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+from gan_deeplearning4j_tpu.graph.layers import (
+    LAYER_TYPES,
+    BatchNorm,
+    Layer,
+    Merge,
+    Output,
+)
+from gan_deeplearning4j_tpu.graph.preprocessors import (
+    PREPROCESSOR_TYPES,
+    CnnToFeedForward,
+    FeedForwardToCnn,
+)
+from gan_deeplearning4j_tpu.ops import losses as loss_lib
+from gan_deeplearning4j_tpu.optim.rmsprop import RmsProp
+from gan_deeplearning4j_tpu.optim.updater import GraphUpdater
+from gan_deeplearning4j_tpu.runtime import prng
+
+
+@dataclasses.dataclass(frozen=True)
+class InputSpec:
+    """DL4J InputType equivalent."""
+
+    kind: str  # 'ff' | 'cnn_flat' | 'cnn'
+    shape: Tuple[int, ...]
+
+    @staticmethod
+    def feed_forward(n: int) -> "InputSpec":
+        return InputSpec("ff", (n,))
+
+    @staticmethod
+    def convolutional_flat(height: int, width: int, channels: int) -> "InputSpec":
+        return InputSpec("cnn_flat", (height, width, channels))
+
+    @staticmethod
+    def convolutional(channels: int, height: int, width: int) -> "InputSpec":
+        return InputSpec("cnn", (channels, height, width))
+
+    def node_shape(self) -> Tuple[int, ...]:
+        if self.kind == "ff":
+            return self.shape
+        if self.kind == "cnn_flat":
+            h, w, c = self.shape
+            return (c, h, w)
+        return self.shape
+
+
+@dataclasses.dataclass
+class Node:
+    name: str
+    layer: Layer
+    inputs: Tuple[str, ...]
+    preprocessor: Optional[object] = None
+    in_shape: Optional[Tuple[int, ...]] = None
+    out_shape: Optional[Tuple[int, ...]] = None
+
+
+class GraphBuilder:
+    """``NeuralNetConfiguration.Builder()...graphBuilder()`` equivalent."""
+
+    def __init__(
+        self,
+        seed: int = prng.NUMBER_OF_THE_BEAST,
+        l2: float = 0.0,
+        activation: str = "identity",
+        weight_init: str = "xavier",
+        updater: Optional[RmsProp] = None,
+        clip_threshold: Optional[float] = None,
+    ):
+        self.seed = seed
+        self.l2 = l2
+        self.default_activation = activation
+        self.weight_init = weight_init
+        self.default_updater = updater
+        self.clip_threshold = clip_threshold
+        self.input_names: List[str] = []
+        self.input_specs: Dict[str, InputSpec] = {}
+        self.nodes: Dict[str, Node] = {}
+        self.output_names: List[str] = []
+        self._preprocessors: Dict[str, object] = {}
+
+    def add_inputs(self, *names: str) -> "GraphBuilder":
+        self.input_names.extend(names)
+        return self
+
+    def set_input_types(self, *specs: InputSpec) -> "GraphBuilder":
+        for name, spec in zip(self.input_names, specs):
+            self.input_specs[name] = spec
+        return self
+
+    def add_layer(self, name: str, layer: Layer, *inputs: str) -> "GraphBuilder":
+        if name in self.nodes or name in self.input_names:
+            raise ValueError(f"duplicate node name {name!r}")
+        for inp in inputs:
+            if inp not in self.nodes and inp not in self.input_names:
+                raise ValueError(f"layer {name!r}: unknown input {inp!r}")
+        self.nodes[name] = Node(name=name, layer=layer, inputs=tuple(inputs))
+        return self
+
+    add_vertex = add_layer  # Merge etc. are layers with has_params=False
+
+    def input_preprocessor(self, layer_name: str, preproc) -> "GraphBuilder":
+        self._preprocessors[layer_name] = preproc
+        return self
+
+    def set_outputs(self, *names: str) -> "GraphBuilder":
+        self.output_names = list(names)
+        return self
+
+    # -- shape/config resolution -------------------------------------------
+
+    def _infer_input_shape(self, input_name: str) -> Tuple[int, ...]:
+        """DL4J infers input size from the first consumer's nIn when no
+        InputType is given (the insurance dis graph does this,
+        dl4jGANInsurance.java:110-144)."""
+        for node in self.nodes.values():
+            if input_name in node.inputs:
+                n_in = getattr(node.layer, "n_in", None)
+                if n_in is None:
+                    n_in = getattr(node.layer, "n", None)
+                if n_in is not None:
+                    return (int(n_in),)
+        raise ValueError(
+            f"input {input_name!r}: no InputType set and no consumer declares nIn"
+        )
+
+    def build(self) -> "ComputationGraph":
+        if not self.output_names:
+            raise ValueError("set_outputs() not called")
+        shapes: Dict[str, Tuple[int, ...]] = {}
+        for inp in self.input_names:
+            spec = self.input_specs.get(inp)
+            if spec is None:
+                spec = InputSpec.feed_forward(self._infer_input_shape(inp)[0])
+                self.input_specs[inp] = spec
+            shapes[inp] = spec.node_shape()
+
+        resolved: Dict[str, Node] = {}
+        for name, node in self.nodes.items():
+            layer = node.layer.resolved(self.default_activation, self.default_updater)
+            if layer.weight_init == "xavier":
+                layer = dataclasses.replace(layer, weight_init=self.weight_init)
+            pre = self._preprocessors.get(name)
+            in_shapes = [shapes[i] for i in node.inputs]
+            if isinstance(layer, Merge):
+                in_shape: Union[Tuple[int, ...], List[Tuple[int, ...]]] = in_shapes
+            else:
+                if len(in_shapes) != 1:
+                    raise ValueError(f"layer {name!r} expects exactly one input")
+                in_shape = in_shapes[0]
+                if pre is not None:
+                    in_shape = pre.out_shape(in_shape)
+            out_shape = layer.out_shape(in_shape)
+            resolved[name] = Node(
+                name=name,
+                layer=layer,
+                inputs=node.inputs,
+                preprocessor=pre,
+                in_shape=in_shape,
+                out_shape=out_shape,
+            )
+            shapes[name] = out_shape
+
+        return ComputationGraph(
+            nodes=resolved,
+            input_names=list(self.input_names),
+            input_specs=dict(self.input_specs),
+            output_names=list(self.output_names),
+            seed=self.seed,
+            l2=self.l2,
+            clip_threshold=self.clip_threshold,
+        )
+
+
+class ComputationGraph:
+    """The runnable graph: topology + params + updater state."""
+
+    def __init__(
+        self,
+        nodes: Dict[str, Node],
+        input_names: List[str],
+        input_specs: Dict[str, InputSpec],
+        output_names: List[str],
+        seed: int,
+        l2: float,
+        clip_threshold: Optional[float],
+        frozen: Optional[frozenset] = None,
+    ):
+        self.nodes = nodes
+        self.input_names = input_names
+        self.input_specs = input_specs
+        self.output_names = output_names
+        self.seed = seed
+        self.l2 = l2
+        self.clip_threshold = clip_threshold
+        self.frozen = frozenset(frozen or ())
+        self.updater = GraphUpdater(
+            {
+                name: node.layer.updater
+                for name, node in nodes.items()
+                if node.layer.has_params and name not in self.frozen
+            },
+            l2=l2,
+            clip_threshold=clip_threshold,
+        )
+        self.params: Dict[str, Dict[str, jax.Array]] = {}
+        self.opt_state: Dict[str, Dict[str, jax.Array]] = {}
+        self.score: float = float("nan")
+        self._step_rng = prng.stream(prng.root_key(seed), "graph-step")
+        self._step_count = 0
+        self._jit_infer = jax.jit(functools.partial(self._forward_outputs, train=False))
+        self._jit_fit = jax.jit(self._train_step)
+
+    # -- init ---------------------------------------------------------------
+
+    def init(self, seed: Optional[int] = None) -> "ComputationGraph":
+        """Deterministic per-layer init: key folded per layer name, so two
+        graphs built with the same seed and layer shapes get identical params
+        for identically-named layers (the reference relies on same-seed init
+        across its three graphs)."""
+        key = prng.root_key(self.seed if seed is None else seed)
+        params = {}
+        for name, node in self.nodes.items():
+            if node.layer.has_params:
+                params[name] = node.layer.init(prng.stream(key, name), node.in_shape)
+            else:
+                params[name] = {}
+        self.params = params
+        self.opt_state = self.updater.init(params)
+        return self
+
+    # -- forward ------------------------------------------------------------
+
+    def _forward(self, params, inputs: Dict[str, jax.Array], train: bool, rng):
+        """Pure forward over the DAG in insertion (topological) order.
+
+        Returns (values, state_updates): all node outputs by name, plus BN
+        running-stat updates produced by train-mode layers.
+        """
+        values: Dict[str, jax.Array] = {}
+        for inp in self.input_names:
+            x = inputs[inp]
+            spec = self.input_specs[inp]
+            if spec.kind == "cnn_flat":
+                h, w, c = spec.shape
+                x = x.reshape(x.shape[0], c, h, w)
+            values[inp] = x
+        state_updates: Dict[str, Dict[str, jax.Array]] = {}
+        for name, node in self.nodes.items():
+            if isinstance(node.layer, Merge):
+                x = [values[i] for i in node.inputs]
+            else:
+                x = values[node.inputs[0]]
+                if node.preprocessor is not None:
+                    x = node.preprocessor(x)
+            layer_train = train and name not in self.frozen
+            layer_rng = jax.random.fold_in(rng, _stable_hash(name)) if rng is not None else None
+            y, upd = node.layer.apply(params[name], x, layer_train, layer_rng)
+            if upd:
+                state_updates[name] = upd
+            values[name] = y
+        return values, state_updates
+
+    def _forward_outputs(self, params, inputs, rng=None, train: bool = False):
+        values, _ = self._forward(params, inputs, train, rng)
+        return [values[name] for name in self.output_names]
+
+    def output(self, *xs: jax.Array, params=None) -> List[jax.Array]:
+        """Inference forward (running BN stats, no dropout) — DL4J
+        ``ComputationGraph.output``.  Returns a list, one per output layer."""
+        inputs = dict(zip(self.input_names, xs))
+        return self._jit_infer(params if params is not None else self.params, inputs)
+
+    def feed_forward(self, *xs: jax.Array) -> Dict[str, jax.Array]:
+        """All intermediate activations by layer name (inference mode)."""
+        inputs = dict(zip(self.input_names, xs))
+        values, _ = self._forward(self.params, inputs, False, None)
+        return values
+
+    # -- training -----------------------------------------------------------
+
+    def _loss(self, outputs: Dict[str, jax.Array], labels: Dict[str, jax.Array]):
+        total = 0.0
+        for name in self.output_names:
+            node = self.nodes[name]
+            loss_name = getattr(node.layer, "loss", "mse")
+            total = total + loss_lib.get(loss_name)(outputs[name], labels[name])
+        return total
+
+    def _train_step(self, params, opt_state, rng, inputs, labels):
+        def loss_fn(p):
+            values, state_updates = self._forward(p, inputs, True, rng)
+            outputs = {n: values[n] for n in self.output_names}
+            return self._loss(outputs, labels), state_updates
+
+        (loss, state_updates), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
+        new_params, new_opt_state = self.updater.apply(params, grads, opt_state)
+        for lname, upd in state_updates.items():
+            merged = dict(new_params[lname])
+            merged.update(upd)
+            new_params[lname] = merged
+        return new_params, new_opt_state, loss
+
+    def fit(self, features, labels) -> float:
+        """One optimization step on a batch — the unit the reference's
+        ``SparkComputationGraph.fit(rdd)`` reduces to per worker.  For the
+        distributed version see parallel/data_parallel.py."""
+        inputs = (
+            features if isinstance(features, dict)
+            else dict(zip(self.input_names, [features]))
+        )
+        label_map = (
+            labels if isinstance(labels, dict)
+            else dict(zip(self.output_names, [labels]))
+        )
+        self._step_count += 1
+        rng = jax.random.fold_in(self._step_rng, self._step_count)
+        self.params, self.opt_state, loss = self._jit_fit(
+            self.params, self.opt_state, rng, inputs, label_map
+        )
+        self.score = loss
+        return loss
+
+    # -- param access (the GAN protocol's weight-sync surface) ---------------
+
+    def get_param(self, layer: str, name: str) -> jax.Array:
+        return self.params[layer][name]
+
+    def set_param(self, layer: str, name: str, value: jax.Array) -> None:
+        new_layer = dict(self.params[layer])
+        new_layer[name] = value
+        self.params = {**self.params, layer: new_layer}
+
+    def get_layer_params(self, layer: str) -> Dict[str, jax.Array]:
+        return dict(self.params[layer])
+
+    def set_layer_params(self, layer: str, values: Dict[str, jax.Array]) -> None:
+        new_layer = dict(self.params[layer])
+        new_layer.update(values)
+        self.params = {**self.params, layer: new_layer}
+
+    def num_params(self) -> int:
+        return sum(
+            int(v.size) for lp in self.params.values() for v in lp.values()
+        )
+
+    def summary(self) -> str:
+        """DL4J ``summary()`` equivalent — the reference prints this after
+        every init as its de-facto shape test (SURVEY.md §4.1)."""
+        lines = ["=" * 76]
+        lines.append(f"{'Layer (type)':<40}{'Out shape':<20}{'Params':>10}")
+        lines.append("-" * 76)
+        for inp in self.input_names:
+            spec = self.input_specs[inp]
+            lines.append(f"{inp + ' (Input/' + spec.kind + ')':<40}{str(spec.node_shape()):<20}{0:>10}")
+        total = 0
+        for name, node in self.nodes.items():
+            n = sum(int(v.size) for v in self.params.get(name, {}).values())
+            total += n
+            frozen = " [frozen]" if name in self.frozen else ""
+            lines.append(
+                f"{name + ' (' + type(node.layer).__name__ + ')' + frozen:<40}"
+                f"{str(node.out_shape):<20}{n:>10}"
+            )
+        lines.append("-" * 76)
+        lines.append(f"Total params: {total}")
+        lines.append("=" * 76)
+        return "\n".join(lines)
+
+
+def _stable_hash(name: str) -> int:
+    import hashlib
+
+    return int.from_bytes(hashlib.sha256(name.encode()).digest()[:4], "little")

@@ -1,0 +1,328 @@
+"""Layer configuration classes for the named-layer graph API.
+
+Covers every layer type the reference's graphs use (SURVEY.md §2a):
+DenseLayer, ConvolutionLayer, SubsamplingLayer (max pool), BatchNormalization,
+Upsampling2D, DropoutLayer, OutputLayer — plus ConvTranspose2D and Merge for
+the roadmap model families (conditional GAN, WGAN-GP, CelebA DCGAN).
+
+Each config is a plain dataclass with three pure methods:
+  out_shape(in_shape)         -- shape inference (batch dim excluded; FF
+                                 shapes are (n,), CNN shapes (c, h, w)),
+                                 reproducing DL4J's Truncate conv arithmetic
+  init(key, in_shape)         -- parameter pytree {name: array}, DL4J names
+                                 (W, b, gamma, beta, mean, var) so the
+                                 reference's getParam/setParam dance maps 1:1
+  apply(params, x, train, rng)-- forward; returns (y, state_updates|None)
+
+A layer's ``activation``/``updater`` of None inherits the graph-level default
+(DL4J's NeuralNetConfiguration.Builder global settings,
+dl4jGANComputerVision.java:117-125); the builder resolves these before the
+graph is built.  Note: like the reference's author assumed
+(dl4jGANInsurance.java:228 sets ELU explicitly on a BatchNormalization), the
+BN layer applies its (inherited) activation after normalization.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from gan_deeplearning4j_tpu.ops import (
+    activations as act_lib,
+    batch_norm_inference,
+    batch_norm_train,
+    conv2d,
+    conv2d_out_size,
+    initializers,
+    max_pool2d,
+    upsample2d,
+)
+from gan_deeplearning4j_tpu.ops.dense import dense as dense_op, dropout as dropout_op
+from gan_deeplearning4j_tpu.ops.upsample import conv_transpose2d
+from gan_deeplearning4j_tpu.optim.rmsprop import RmsProp
+
+Shape = Tuple[int, ...]
+Params = Dict[str, jax.Array]
+
+
+def _flat_size(shape: Shape) -> int:
+    n = 1
+    for s in shape:
+        n *= s
+    return n
+
+
+def _as_ff(x: jax.Array) -> jax.Array:
+    """Auto CnnToFeedForward: flatten trailing dims (DL4J inserts this
+    preprocessor when a dense layer follows a conv stack)."""
+    if x.ndim > 2:
+        return x.reshape(x.shape[0], -1)
+    return x
+
+
+@dataclasses.dataclass
+class Layer:
+    """Base layer config."""
+
+    activation: Optional[str] = None
+    updater: Optional[RmsProp] = None
+    weight_init: str = "xavier"
+
+    @property
+    def has_params(self) -> bool:
+        return True
+
+    def resolved(self, default_activation: str, default_updater: Optional[RmsProp]):
+        new = dataclasses.replace(self)
+        if new.activation is None:
+            new.activation = default_activation
+        if new.updater is None:
+            new.updater = default_updater
+        return new
+
+    def _act(self, x):
+        return act_lib.get(self.activation or "identity")(x)
+
+    def out_shape(self, in_shape: Shape) -> Shape:
+        raise NotImplementedError
+
+    def init(self, key: jax.Array, in_shape: Shape) -> Params:
+        return {}
+
+    def apply(self, params: Params, x, train: bool, rng):
+        raise NotImplementedError
+
+
+@dataclasses.dataclass
+class Dense(Layer):
+    """DL4J DenseLayer (dl4jGANComputerVision.java:144-148).  W: [nIn, nOut]."""
+
+    n_out: int = 0
+    n_in: Optional[int] = None
+    bf16_matmul: bool = False
+
+    def out_shape(self, in_shape):
+        return (self.n_out,)
+
+    def init(self, key, in_shape):
+        n_in = self.n_in if self.n_in is not None else _flat_size(in_shape)
+        k_w, _ = jax.random.split(key)
+        if self.weight_init == "xavier":
+            w = initializers.xavier(k_w, (n_in, self.n_out), n_in, self.n_out)
+        else:
+            w = initializers.xavier_uniform(k_w, (n_in, self.n_out), n_in, self.n_out)
+        return {"W": w, "b": initializers.zeros((self.n_out,))}
+
+    def apply(self, params, x, train, rng):
+        x = _as_ff(x)
+        return self._act(dense_op(x, params["W"], params["b"], bf16=self.bf16_matmul)), None
+
+
+@dataclasses.dataclass
+class Output(Dense):
+    """DL4J OutputLayer: a dense layer with a loss attached
+    (dl4jGANComputerVision.java:150-155)."""
+
+    loss: str = "xent"
+
+
+@dataclasses.dataclass
+class Conv2D(Layer):
+    """DL4J ConvolutionLayer, Truncate mode.  W: [nOut, nIn, kh, kw] (OIHW)."""
+
+    kernel: Sequence[int] = (3, 3)
+    stride: Sequence[int] = (1, 1)
+    padding: Sequence[int] = (0, 0)
+    n_in: Optional[int] = None
+    n_out: int = 0
+
+    def out_shape(self, in_shape):
+        c, h, w = in_shape
+        kh, kw = self.kernel
+        sh, sw = self.stride
+        ph, pw = self.padding
+        return (
+            self.n_out,
+            conv2d_out_size(h, kh, sh, ph),
+            conv2d_out_size(w, kw, sw, pw),
+        )
+
+    def init(self, key, in_shape):
+        n_in = self.n_in if self.n_in is not None else in_shape[0]
+        kh, kw = self.kernel
+        fan_in, fan_out = initializers.fan_in_out_conv(n_in, self.n_out, (kh, kw))
+        k_w, _ = jax.random.split(key)
+        w = initializers.xavier(k_w, (self.n_out, n_in, kh, kw), fan_in, fan_out)
+        return {"W": w, "b": initializers.zeros((self.n_out,))}
+
+    def apply(self, params, x, train, rng):
+        y = conv2d(x, params["W"], params["b"], self.stride, self.padding)
+        return self._act(y), None
+
+
+@dataclasses.dataclass
+class ConvTranspose2D(Layer):
+    """Real transposed conv, for roadmap DCGAN variants (not used by the
+    reference, whose 'deconv' layers are upsample+conv — SURVEY.md §3.3)."""
+
+    kernel: Sequence[int] = (4, 4)
+    stride: Sequence[int] = (2, 2)
+    padding: Sequence[int] = (1, 1)
+    n_in: Optional[int] = None
+    n_out: int = 0
+
+    def out_shape(self, in_shape):
+        c, h, w = in_shape
+        kh, kw = self.kernel
+        sh, sw = self.stride
+        ph, pw = self.padding
+        return (
+            self.n_out,
+            (h - 1) * sh - 2 * ph + kh,
+            (w - 1) * sw - 2 * pw + kw,
+        )
+
+    def init(self, key, in_shape):
+        n_in = self.n_in if self.n_in is not None else in_shape[0]
+        kh, kw = self.kernel
+        fan_in, fan_out = initializers.fan_in_out_conv(n_in, self.n_out, (kh, kw))
+        k_w, _ = jax.random.split(key)
+        w = initializers.xavier(k_w, (self.n_out, n_in, kh, kw), fan_in, fan_out)
+        return {"W": w, "b": initializers.zeros((self.n_out,))}
+
+    def apply(self, params, x, train, rng):
+        y = conv_transpose2d(x, params["W"], params["b"], self.stride, self.padding)
+        return self._act(y), None
+
+
+@dataclasses.dataclass
+class MaxPool2D(Layer):
+    """DL4J SubsamplingLayer(MAX) — e.g. the unusual 2x2 stride-1 pools
+    (dl4jGANComputerVision.java:134-138)."""
+
+    kernel: Sequence[int] = (2, 2)
+    stride: Sequence[int] = (2, 2)
+
+    @property
+    def has_params(self):
+        return False
+
+    def out_shape(self, in_shape):
+        c, h, w = in_shape
+        kh, kw = self.kernel
+        sh, sw = self.stride
+        return (c, (h - kh) // sh + 1, (w - kw) // sw + 1)
+
+    def apply(self, params, x, train, rng):
+        return max_pool2d(x, self.kernel, self.stride), None
+
+
+@dataclasses.dataclass
+class Upsampling2D(Layer):
+    """DL4J Upsampling2D (dl4jGANComputerVision.java:191-192)."""
+
+    size: int = 2
+
+    @property
+    def has_params(self):
+        return False
+
+    def out_shape(self, in_shape):
+        c, h, w = in_shape
+        return (c, h * self.size, w * self.size)
+
+    def apply(self, params, x, train, rng):
+        return upsample2d(x, self.size), None
+
+
+@dataclasses.dataclass
+class BatchNorm(Layer):
+    """DL4J BatchNormalization with stats-as-params (mean/var retrievable and
+    settable by name — the GAN protocol's cross-graph BN sync,
+    dl4jGANComputerVision.java:404-420, depends on this)."""
+
+    n: Optional[int] = None
+    decay: float = 0.9
+    eps: float = 1e-5
+
+    def out_shape(self, in_shape):
+        return in_shape
+
+    def _n(self, in_shape):
+        if self.n is not None:
+            return self.n
+        # 4-D input: per-channel; FF input: per-feature.
+        return in_shape[0] if len(in_shape) == 3 else _flat_size(in_shape)
+
+    def init(self, key, in_shape):
+        n = self._n(in_shape)
+        return {
+            "gamma": initializers.ones((n,)),
+            "beta": initializers.zeros((n,)),
+            "mean": initializers.zeros((n,)),
+            "var": initializers.ones((n,)),
+        }
+
+    def apply(self, params, x, train, rng):
+        if train:
+            y, new_mean, new_var = batch_norm_train(
+                x, params["gamma"], params["beta"], params["mean"], params["var"],
+                self.decay, self.eps,
+            )
+            return self._act(y), {"mean": new_mean, "var": new_var}
+        y = batch_norm_inference(
+            x, params["gamma"], params["beta"], params["mean"], params["var"], self.eps
+        )
+        return self._act(y), None
+
+
+@dataclasses.dataclass
+class Dropout(Layer):
+    """DL4J DropoutLayer.  The reference's ``new DropoutLayer()`` has DL4J's
+    unset default probability => identity (SURVEY.md appendix quirk); rate=0.0
+    reproduces that."""
+
+    rate: float = 0.0
+
+    @property
+    def has_params(self):
+        return False
+
+    def out_shape(self, in_shape):
+        return in_shape
+
+    def apply(self, params, x, train, rng):
+        return dropout_op(x, self.rate, rng, train), None
+
+
+@dataclasses.dataclass
+class Merge(Layer):
+    """DL4J MergeVertex equivalent: concat along the feature/channel axis.
+    Needed by the conditional-GAN roadmap config (label conditioning)."""
+
+    @property
+    def has_params(self):
+        return False
+
+    def out_shape(self, in_shape):
+        # in_shape is a list of shapes for multi-input vertices.
+        shapes = in_shape
+        first = shapes[0]
+        total = sum(s[0] for s in shapes)
+        return (total,) + tuple(first[1:])
+
+    def apply(self, params, xs, train, rng):
+        axis = 1 if xs[0].ndim > 1 else 0
+        return jnp.concatenate(xs, axis=axis), None
+
+
+LAYER_TYPES = {
+    cls.__name__: cls
+    for cls in [
+        Dense, Output, Conv2D, ConvTranspose2D, MaxPool2D, Upsampling2D,
+        BatchNorm, Dropout, Merge,
+    ]
+}

@@ -1,0 +1,47 @@
+"""Input preprocessors — DL4J InputPreProcessor equivalents.
+
+The reference uses ``FeedForwardToCnnPreProcessor(7, 7, 128)`` to reshape the
+generator's dense output into the conv stack
+(dl4jGANComputerVision.java:190); the inverse flatten is auto-inserted by the
+graph builder when a dense layer follows a conv output (DL4J's
+CnnToFeedForwardPreProcessor).  Pure reshapes — free under XLA.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+
+@dataclasses.dataclass(frozen=True)
+class FeedForwardToCnn:
+    """[B, h*w*c] -> [B, c, h, w] (DL4J argument order: height, width, channels)."""
+
+    height: int
+    width: int
+    channels: int
+
+    def out_shape(self, in_shape):
+        return (self.channels, self.height, self.width)
+
+    def __call__(self, x):
+        return x.reshape(x.shape[0], self.channels, self.height, self.width)
+
+
+@dataclasses.dataclass(frozen=True)
+class CnnToFeedForward:
+    """[B, c, h, w] -> [B, c*h*w]."""
+
+    def out_shape(self, in_shape):
+        n = 1
+        for s in in_shape:
+            n *= s
+        return (n,)
+
+    def __call__(self, x):
+        return x.reshape(x.shape[0], -1)
+
+
+PREPROCESSOR_TYPES = {
+    "FeedForwardToCnn": FeedForwardToCnn,
+    "CnnToFeedForward": CnnToFeedForward,
+}

@@ -1,0 +1,164 @@
+"""Model persistence — DL4J ``ModelSerializer`` equivalent.
+
+The reference saves each of its four graphs as a zip (config + params +
+updater state, ``ModelSerializer.writeModel(..., saveUpdater=true)``,
+dl4jGANComputerVision.java:529-533).  Same shape here: a zip containing
+``config.json`` (topology, layer dataclasses with type tags), ``params.npz``
+and ``updater.npz`` (flat ``layer/param`` keys).  The reference never loads
+its models back (save-only, SURVEY.md §5); we close that gap with
+``load_model``.  Training-loop checkpoint/resume (step counter, all nets,
+opt state) lives in checkpoint/.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import io
+import json
+import zipfile
+from typing import Dict
+
+import jax.numpy as jnp
+import numpy as np
+
+from gan_deeplearning4j_tpu.graph.graph import ComputationGraph, GraphBuilder, InputSpec
+from gan_deeplearning4j_tpu.graph.layers import LAYER_TYPES
+from gan_deeplearning4j_tpu.graph.preprocessors import PREPROCESSOR_TYPES
+from gan_deeplearning4j_tpu.optim.rmsprop import RmsProp
+
+FORMAT_VERSION = 1
+
+
+def _layer_to_dict(layer) -> dict:
+    d = dataclasses.asdict(layer)
+    if d.get("updater") is not None:
+        d["updater"] = dataclasses.asdict(layer.updater)
+    d["__type__"] = type(layer).__name__
+    return d
+
+
+def _layer_from_dict(d: dict):
+    d = dict(d)
+    cls = LAYER_TYPES[d.pop("__type__")]
+    if d.get("updater") is not None:
+        d["updater"] = RmsProp(**d["updater"])
+    return cls(**d)
+
+
+def _preproc_to_dict(p) -> dict:
+    d = dataclasses.asdict(p)
+    d["__type__"] = type(p).__name__
+    return d
+
+
+def _preproc_from_dict(d: dict):
+    d = dict(d)
+    cls = PREPROCESSOR_TYPES[d.pop("__type__")]
+    return cls(**d)
+
+
+def graph_config_to_dict(graph: ComputationGraph) -> dict:
+    return {
+        "format_version": FORMAT_VERSION,
+        "seed": graph.seed,
+        "l2": graph.l2,
+        "clip_threshold": graph.clip_threshold,
+        "frozen": sorted(graph.frozen),
+        "inputs": graph.input_names,
+        "input_specs": {
+            k: {"kind": v.kind, "shape": list(v.shape)}
+            for k, v in graph.input_specs.items()
+        },
+        "outputs": graph.output_names,
+        "nodes": [
+            {
+                "name": name,
+                "layer": _layer_to_dict(node.layer),
+                "inputs": list(node.inputs),
+                "preprocessor": (
+                    _preproc_to_dict(node.preprocessor)
+                    if node.preprocessor is not None else None
+                ),
+            }
+            for name, node in graph.nodes.items()
+        ],
+    }
+
+
+def graph_from_config_dict(cfg: dict) -> ComputationGraph:
+    builder = GraphBuilder(
+        seed=cfg["seed"],
+        l2=cfg["l2"],
+        clip_threshold=cfg["clip_threshold"],
+    )
+    builder.add_inputs(*cfg["inputs"])
+    builder.set_input_types(
+        *[
+            InputSpec(cfg["input_specs"][i]["kind"], tuple(cfg["input_specs"][i]["shape"]))
+            for i in cfg["inputs"]
+        ]
+    )
+    for nd in cfg["nodes"]:
+        builder.add_layer(nd["name"], _layer_from_dict(nd["layer"]), *nd["inputs"])
+        if nd["preprocessor"] is not None:
+            builder.input_preprocessor(nd["name"], _preproc_from_dict(nd["preprocessor"]))
+    builder.set_outputs(*cfg["outputs"])
+    graph = builder.build()
+    graph.frozen = frozenset(cfg["frozen"])
+    graph.updater.layer_updaters = {
+        name: node.layer.updater
+        for name, node in graph.nodes.items()
+        if node.layer.has_params and name not in graph.frozen
+    }
+    return graph
+
+
+def _flatten(tree: Dict[str, Dict[str, jnp.ndarray]]) -> Dict[str, np.ndarray]:
+    return {
+        f"{layer}/{name}": np.asarray(v)
+        for layer, lp in tree.items()
+        for name, v in lp.items()
+    }
+
+
+def _unflatten(flat) -> Dict[str, Dict[str, jnp.ndarray]]:
+    tree: Dict[str, Dict[str, jnp.ndarray]] = {}
+    for key in flat.files:
+        layer, name = key.rsplit("/", 1)
+        tree.setdefault(layer, {})[name] = jnp.asarray(flat[key])
+    return tree
+
+
+def write_model(graph: ComputationGraph, path: str, save_updater: bool = True) -> None:
+    with zipfile.ZipFile(path, "w", zipfile.ZIP_DEFLATED) as zf:
+        zf.writestr("config.json", json.dumps(graph_config_to_dict(graph), indent=1))
+        buf = io.BytesIO()
+        np.savez(buf, **_flatten(graph.params))
+        zf.writestr("params.npz", buf.getvalue())
+        if save_updater:
+            buf = io.BytesIO()
+            np.savez(buf, **_flatten(graph.opt_state))
+            zf.writestr("updater.npz", buf.getvalue())
+
+
+def read_model(path: str) -> ComputationGraph:
+    with zipfile.ZipFile(path) as zf:
+        cfg = json.loads(zf.read("config.json"))
+        graph = graph_from_config_dict(cfg)
+        with zf.open("params.npz") as f:
+            loaded = np.load(io.BytesIO(f.read()))
+            params = _unflatten(loaded)
+        # Layers with no params still need empty slots.
+        for name, node in graph.nodes.items():
+            params.setdefault(name, {})
+        graph.params = params
+        if "updater.npz" in zf.namelist():
+            with zf.open("updater.npz") as f:
+                loaded = np.load(io.BytesIO(f.read()))
+                opt = _unflatten(loaded)
+            for name in graph.nodes:
+                opt.setdefault(name, {})
+            graph.opt_state = opt
+        else:
+            graph.opt_state = graph.updater.init(graph.params)
+    return graph

@@ -1,0 +1,137 @@
+"""Transfer-learning graph surgery — DL4J ``TransferLearning.GraphBuilder``.
+
+Reproduces the operations the reference performs to build its downstream
+classifiers from the GAN discriminator
+(dl4jGANComputerVision.java:322-351):
+
+  - ``fine_tune_configuration``: new global defaults for the rebuilt graph
+  - ``set_feature_extractor(name)``: freeze every layer up to and including
+    ``name`` (no updates; train-mode forward runs them in inference mode)
+  - ``remove_vertex_keep_connections(name)``: drop a layer, keep its wiring
+  - ``add_layer``: append new (trainable) layers
+
+Params of retained layers are carried over by reference (immutable arrays =
+free copy); new layers are freshly initialized from the fine-tune seed.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional
+
+from gan_deeplearning4j_tpu.graph.graph import ComputationGraph, GraphBuilder, Node
+from gan_deeplearning4j_tpu.graph.layers import Layer
+from gan_deeplearning4j_tpu.optim.rmsprop import RmsProp
+from gan_deeplearning4j_tpu.runtime import prng
+
+
+@dataclasses.dataclass
+class FineTuneConfiguration:
+    """The subset of DL4J FineTuneConfiguration the reference uses
+    (dl4jGANComputerVision.java:324-336)."""
+
+    seed: int = prng.NUMBER_OF_THE_BEAST
+    l2: float = 0.0
+    activation: str = "identity"
+    weight_init: str = "xavier"
+    updater: Optional[RmsProp] = None
+    clip_threshold: Optional[float] = None
+
+
+class TransferLearning:
+    """``new TransferLearning.GraphBuilder(graph)`` equivalent."""
+
+    def __init__(self, source: ComputationGraph):
+        self.source = source
+        self.fine_tune: Optional[FineTuneConfiguration] = None
+        self._feature_extractor: Optional[str] = None
+        self._removed: List[str] = []
+        self._added: List[tuple] = []
+        self._new_outputs: Optional[List[str]] = None
+
+    def fine_tune_configuration(self, cfg: FineTuneConfiguration) -> "TransferLearning":
+        self.fine_tune = cfg
+        return self
+
+    def set_feature_extractor(self, layer_name: str) -> "TransferLearning":
+        if layer_name not in self.source.nodes:
+            raise ValueError(f"unknown layer {layer_name!r}")
+        self._feature_extractor = layer_name
+        return self
+
+    def remove_vertex_keep_connections(self, name: str) -> "TransferLearning":
+        self._removed.append(name)
+        return self
+
+    def add_layer(self, name: str, layer: Layer, *inputs: str) -> "TransferLearning":
+        self._added.append((name, layer, inputs))
+        return self
+
+    def set_outputs(self, *names: str) -> "TransferLearning":
+        self._new_outputs = list(names)
+        return self
+
+    def build(self) -> ComputationGraph:
+        cfg = self.fine_tune or FineTuneConfiguration()
+        builder = GraphBuilder(
+            seed=cfg.seed,
+            l2=cfg.l2,
+            activation=cfg.activation,
+            weight_init=cfg.weight_init,
+            updater=cfg.updater,
+            clip_threshold=cfg.clip_threshold,
+        )
+        builder.add_inputs(*self.source.input_names)
+        builder.set_input_types(
+            *[self.source.input_specs[i] for i in self.source.input_names]
+        )
+
+        # Frozen set: every layer up to and including the feature extractor,
+        # in insertion (topological) order — DL4J setFeatureExtractor semantics.
+        frozen = set()
+        if self._feature_extractor is not None:
+            for name in self.source.nodes:
+                frozen.add(name)
+                if name == self._feature_extractor:
+                    break
+
+        kept: Dict[str, Node] = {}
+        for name, node in self.source.nodes.items():
+            if name in self._removed:
+                continue
+            # Retained layers keep their resolved config (incl. activation) —
+            # already resolved, so the new defaults only affect added layers.
+            builder.add_layer(name, node.layer, *node.inputs)
+            if node.preprocessor is not None:
+                builder.input_preprocessor(name, node.preprocessor)
+            kept[name] = node
+
+        for name, layer, inputs in self._added:
+            builder.add_layer(name, layer, *inputs)
+
+        outputs = self._new_outputs
+        if outputs is None:
+            # DL4J keeps the original output names if the removed vertex was
+            # re-added under the same name (the reference re-adds
+            # "dis_output_layer_7" — dl4jGANComputerVision.java:345).
+            outputs = [
+                n for n in self.source.output_names
+                if n in builder.nodes
+            ]
+            if not outputs:
+                outputs = [self._added[-1][0]]
+        builder.set_outputs(*outputs)
+
+        graph = builder.build()
+        graph.frozen = frozenset(frozen)
+        # Rebuild the updater map now that frozen layers are known.
+        graph.updater.layer_updaters = {
+            name: node.layer.updater
+            for name, node in graph.nodes.items()
+            if node.layer.has_params and name not in graph.frozen
+        }
+        graph.init()
+        # Carry over source params for retained layers (free: immutable arrays).
+        for name in kept:
+            graph.params = {**graph.params, name: dict(self.source.params[name])}
+        return graph

@@ -1,0 +1,1 @@
+from gan_deeplearning4j_tpu.models import dcgan_mnist, mlpgan_insurance  # noqa: F401

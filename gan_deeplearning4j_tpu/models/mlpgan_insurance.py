@@ -1,0 +1,166 @@
+"""MLP-GAN on 4x3 transaction lattices — the reference's insurance workload.
+
+Layer-for-layer capability match with
+``Java/src/main/java/org/deeplearning4j/dl4jGANInsurance.java``:
+
+  - discriminator (:110-144): 12 -> BN -> dense 100 (global ELU) -> dropout
+    (identity: DL4J default prob) -> sigmoid(1) XENT; RmsProp(2e-4,1e-8,1e-8).
+  - generator     (:146-185): z(2) -> BN -> dense 100 x3 -> dense 12 sigmoid;
+    global TANH.
+  - stacked gan   (:187-243): gen at lr 4e-4, dis copy at lr 0.0 with ELU set
+    per-layer (the gan graph's global activation is TANH, so the frozen dis
+    tail sets ELU explicitly — :228,233).
+  - transfer classifier (:264-293): freeze through dis_dropout_layer_3, new
+    BN(100) + sigmoid(1) XENT head.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+from gan_deeplearning4j_tpu.graph import (
+    BatchNorm,
+    Dense,
+    Dropout,
+    FineTuneConfiguration,
+    GraphBuilder,
+    InputSpec,
+    Output,
+    TransferLearning,
+)
+from gan_deeplearning4j_tpu.optim.rmsprop import RmsProp
+from gan_deeplearning4j_tpu.runtime import prng
+
+
+@dataclasses.dataclass(frozen=True)
+class InsuranceConfig:
+    """The reference's constants block (dl4jGANInsurance.java:58-84)."""
+
+    seed: int = prng.NUMBER_OF_THE_BEAST
+    lattice_rows: int = 4     # periods
+    lattice_cols: int = 3     # transaction types
+    num_features: int = 12
+    z_size: int = 2
+    hidden: int = 100
+    dis_learning_rate: float = 0.0002
+    gen_learning_rate: float = 0.0004
+    frozen_learning_rate: float = 0.0
+    l2: float = 1e-4
+    clip: float = 1.0
+
+
+def build_discriminator(cfg: InsuranceConfig = InsuranceConfig()):
+    lr = RmsProp(cfg.dis_learning_rate, 1e-8, 1e-8)
+    b = GraphBuilder(seed=cfg.seed, l2=cfg.l2, activation="elu",
+                     weight_init="xavier", clip_threshold=cfg.clip)
+    b.add_inputs("dis_input_layer_0")
+    # no InputType in the reference: inferred from the BN layer's nIn=12
+    b.add_layer("dis_batch_layer_1", BatchNorm(n=cfg.num_features, updater=lr),
+                "dis_input_layer_0")
+    b.add_layer("dis_dense_layer_2",
+                Dense(n_out=cfg.hidden, n_in=cfg.num_features, updater=lr),
+                "dis_batch_layer_1")
+    b.add_layer("dis_dropout_layer_3", Dropout(rate=0.0), "dis_dense_layer_2")
+    b.add_layer("dis_output_layer_4",
+                Output(n_out=1, n_in=cfg.hidden, loss="xent",
+                       activation="sigmoid", updater=lr),
+                "dis_dropout_layer_3")
+    b.set_outputs("dis_output_layer_4")
+    return b.build().init()
+
+
+def _add_generator_layers(b, cfg, lr, prefix, input_name) -> str:
+    b.add_layer(f"{prefix}_batch_1", BatchNorm(updater=lr), input_name)
+    b.add_layer(f"{prefix}_dense_layer_2", Dense(n_out=cfg.hidden, updater=lr),
+                f"{prefix}_batch_1")
+    b.add_layer(f"{prefix}_dense_layer_3", Dense(n_out=cfg.hidden, updater=lr),
+                f"{prefix}_dense_layer_2")
+    b.add_layer(f"{prefix}_dense_layer_4", Dense(n_out=cfg.hidden, updater=lr),
+                f"{prefix}_dense_layer_3")
+    b.add_layer(f"{prefix}_dense_layer_5",
+                Dense(n_out=cfg.num_features, n_in=cfg.hidden,
+                      activation="sigmoid", updater=lr),
+                f"{prefix}_dense_layer_4")
+    return f"{prefix}_dense_layer_5"
+
+
+def build_generator(cfg: InsuranceConfig = InsuranceConfig()):
+    lr = RmsProp(cfg.frozen_learning_rate, 1e-8, 1e-8)
+    b = GraphBuilder(seed=cfg.seed, l2=cfg.l2, activation="tanh",
+                     weight_init="xavier", clip_threshold=cfg.clip)
+    b.add_inputs("gen_input_layer_0")
+    b.set_input_types(InputSpec.feed_forward(cfg.z_size))
+    out = _add_generator_layers(b, cfg, lr, "gen", "gen_input_layer_0")
+    b.set_outputs(out)
+    return b.build().init()
+
+
+def build_gan(cfg: InsuranceConfig = InsuranceConfig()):
+    gen_lr = RmsProp(cfg.gen_learning_rate, 1e-8, 1e-8)
+    frz = RmsProp(cfg.frozen_learning_rate, 1e-8, 1e-8)
+    b = GraphBuilder(seed=cfg.seed, l2=cfg.l2, activation="tanh",
+                     weight_init="xavier", clip_threshold=cfg.clip)
+    b.add_inputs("gan_input_layer_0")
+    b.set_input_types(InputSpec.feed_forward(cfg.z_size))
+    gen_out = _add_generator_layers(b, cfg, gen_lr, "gan", "gan_input_layer_0")
+    # frozen dis tail: ELU set explicitly (gan graph's global default is TANH)
+    b.add_layer("gan_dis_batch_layer_6",
+                BatchNorm(activation="elu", updater=frz), gen_out)
+    b.add_layer("gan_dis_dense_layer_7",
+                Dense(n_out=cfg.hidden, n_in=cfg.num_features,
+                      activation="elu", updater=frz),
+                "gan_dis_batch_layer_6")
+    b.add_layer("gan_dis_dropout_layer_8", Dropout(rate=0.0),
+                "gan_dis_dense_layer_7")
+    b.add_layer("gan_dis_output_layer_9",
+                Output(n_out=1, loss="xent", activation="sigmoid", updater=frz),
+                "gan_dis_dropout_layer_8")
+    b.set_outputs("gan_dis_output_layer_9")
+    return b.build().init()
+
+
+def build_classifier(dis, cfg: InsuranceConfig = InsuranceConfig()):
+    """Loss-risk classifier on GAN-discriminator features
+    (dl4jGANInsurance.java:264-293)."""
+    lr = RmsProp(cfg.dis_learning_rate, 1e-8, 1e-8)
+    return (
+        TransferLearning(dis)
+        .fine_tune_configuration(
+            FineTuneConfiguration(
+                seed=cfg.seed, l2=cfg.l2, activation="elu",
+                weight_init="xavier", updater=lr, clip_threshold=cfg.clip,
+            )
+        )
+        .set_feature_extractor("dis_dropout_layer_3")
+        .remove_vertex_keep_connections("dis_output_layer_4")
+        .add_layer("dis_batch", BatchNorm(n=cfg.hidden, updater=lr),
+                   "dis_dropout_layer_3")
+        .add_layer("dis_output_layer_4",
+                   Output(n_out=1, n_in=cfg.hidden, loss="xent",
+                          activation="sigmoid", updater=lr),
+                   "dis_batch")
+        .build()
+    )
+
+
+BN_PARAMS = ("gamma", "beta", "mean", "var")
+WB_PARAMS = ("W", "b")
+
+DIS_TO_GAN = [
+    ("gan_dis_batch_layer_6", "dis_batch_layer_1", BN_PARAMS),
+    ("gan_dis_dense_layer_7", "dis_dense_layer_2", WB_PARAMS),
+    ("gan_dis_output_layer_9", "dis_output_layer_4", WB_PARAMS),
+]
+
+GAN_TO_GEN = [
+    ("gen_batch_1", "gan_batch_1", BN_PARAMS),
+    ("gen_dense_layer_2", "gan_dense_layer_2", WB_PARAMS),
+    ("gen_dense_layer_3", "gan_dense_layer_3", WB_PARAMS),
+    ("gen_dense_layer_4", "gan_dense_layer_4", WB_PARAMS),
+    ("gen_dense_layer_5", "gan_dense_layer_5", WB_PARAMS),
+]
+
+DIS_TO_CLASSIFIER = [
+    ("dis_batch_layer_1", "dis_batch_layer_1", BN_PARAMS),
+    ("dis_dense_layer_2", "dis_dense_layer_2", WB_PARAMS),
+]

@@ -1,0 +1,34 @@
+"""Ops layer — the libnd4j-kernel-set equivalent, lowered to XLA (+ Pallas).
+
+Every op the reference's two workloads hit (SURVEY.md §2b: conv2d, maxpool,
+batchnorm, dense GEMM, upsampling2d, dropout, activations, XENT/MCXENT,
+RmsProp math, elementwise clip) has a functional jnp/lax implementation here
+that XLA fuses and tiles onto the MXU/VPU.
+"""
+
+from gan_deeplearning4j_tpu.ops import activations, clipping, initializers, losses
+from gan_deeplearning4j_tpu.ops.batchnorm import (
+    batch_norm_inference,
+    batch_norm_train,
+)
+from gan_deeplearning4j_tpu.ops.conv import conv2d, conv2d_out_size
+from gan_deeplearning4j_tpu.ops.dense import dense, dropout
+from gan_deeplearning4j_tpu.ops.pool import avg_pool2d, max_pool2d
+from gan_deeplearning4j_tpu.ops.upsample import conv_transpose2d, upsample2d
+
+__all__ = [
+    "activations",
+    "clipping",
+    "initializers",
+    "losses",
+    "batch_norm_inference",
+    "batch_norm_train",
+    "conv2d",
+    "conv2d_out_size",
+    "dense",
+    "dropout",
+    "avg_pool2d",
+    "max_pool2d",
+    "conv_transpose2d",
+    "upsample2d",
+]

@@ -1,0 +1,70 @@
+"""Activation functions.
+
+TPU-native equivalent of libnd4j's activation kernels (nd4j-native /
+nd4j-cuda-9.0, reference dl4jGAN.iml:255,376): here they are jnp element-wise
+ops that XLA fuses into the surrounding matmul/conv — there is no per-op
+kernel-dispatch boundary to cross, unlike the reference's JNI-per-op hot path
+(SURVEY.md §3.3).
+
+Covers every ``org.nd4j.linalg.activations.Activation`` the reference uses
+(TANH/ELU/SIGMOID/SOFTMAX/IDENTITY — dl4jGANComputerVision.java:124,
+dl4jGANInsurance.java:120) plus LeakyReLU/ReLU for the roadmap configs
+(BASELINE.json: conditional GAN, WGAN-GP).
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+
+Activation = Callable[[jax.Array], jax.Array]
+
+
+def identity(x):
+    return x
+
+
+def tanh(x):
+    return jnp.tanh(x)
+
+
+def sigmoid(x):
+    return jax.nn.sigmoid(x)
+
+
+def elu(x):
+    return jax.nn.elu(x)
+
+
+def relu(x):
+    return jax.nn.relu(x)
+
+
+def leaky_relu(x, alpha: float = 0.01):
+    return jax.nn.leaky_relu(x, negative_slope=alpha)
+
+
+def softmax(x):
+    return jax.nn.softmax(x, axis=-1)
+
+
+_REGISTRY: dict[str, Activation] = {
+    "identity": identity,
+    "tanh": tanh,
+    "sigmoid": sigmoid,
+    "elu": elu,
+    "relu": relu,
+    "leakyrelu": leaky_relu,
+    "softmax": softmax,
+}
+
+
+def get(name) -> Activation:
+    if callable(name):
+        return name
+    try:
+        return _REGISTRY[name.lower()]
+    except KeyError:
+        raise ValueError(f"unknown activation {name!r}; known: {sorted(_REGISTRY)}")

@@ -1,0 +1,73 @@
+"""Batch normalization with explicit (gamma, beta, mean, var) state.
+
+DL4J's BatchNormalization layer exposes its running statistics as *parameters*
+("mean"/"var") that the reference's three-graph GAN protocol copies between
+graphs every iteration (dl4jGANComputerVision.java:404-420, SURVEY.md §7
+"hard parts").  To keep that weight-sync semantics exact, the stats live in
+the same param tree as gamma/beta — functional state, no hidden mutable
+buffers.
+
+DL4J defaults reproduced: decay 0.9 (running = decay*running +
+(1-decay)*batch), eps 1e-5, gamma init 1, beta init 0.  Train mode
+normalizes by batch stats; inference by running stats — the train/inference
+duality the GAN dynamics depend on (generator synthesis runs in inference
+mode while the same weights train inside the stacked gan graph).
+
+2-D input [B, F] normalizes per feature; 4-D input [B, C, H, W] per channel.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+
+DEFAULT_DECAY = 0.9
+DEFAULT_EPS = 1e-5
+
+
+def _reduce_axes(x: jax.Array) -> Tuple[int, ...]:
+    if x.ndim == 2:
+        return (0,)
+    if x.ndim == 4:
+        return (0, 2, 3)
+    raise ValueError(f"batchnorm expects 2-D or 4-D input, got shape {x.shape}")
+
+
+def _shaped(p: jax.Array, x: jax.Array) -> jax.Array:
+    if x.ndim == 2:
+        return p.reshape(1, -1)
+    return p.reshape(1, -1, 1, 1)
+
+
+def batch_norm_train(
+    x: jax.Array,
+    gamma: jax.Array,
+    beta: jax.Array,
+    running_mean: jax.Array,
+    running_var: jax.Array,
+    decay: float = DEFAULT_DECAY,
+    eps: float = DEFAULT_EPS,
+):
+    """Returns (out, new_running_mean, new_running_var)."""
+    axes = _reduce_axes(x)
+    mean = jnp.mean(x, axis=axes)
+    var = jnp.var(x, axis=axes)
+    out = (x - _shaped(mean, x)) * jax.lax.rsqrt(_shaped(var, x) + eps)
+    out = out * _shaped(gamma, x) + _shaped(beta, x)
+    new_mean = decay * running_mean + (1.0 - decay) * mean
+    new_var = decay * running_var + (1.0 - decay) * var
+    return out, new_mean, new_var
+
+
+def batch_norm_inference(
+    x: jax.Array,
+    gamma: jax.Array,
+    beta: jax.Array,
+    running_mean: jax.Array,
+    running_var: jax.Array,
+    eps: float = DEFAULT_EPS,
+) -> jax.Array:
+    out = (x - _shaped(running_mean, x)) * jax.lax.rsqrt(_shaped(running_var, x) + eps)
+    return out * _shaped(gamma, x) + _shaped(beta, x)

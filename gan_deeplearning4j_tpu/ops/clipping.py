@@ -1,0 +1,25 @@
+"""Gradient normalization.
+
+The reference clips every gradient element to [-t, t]
+(``GradientNormalization.ClipElementWiseAbsoluteValue`` with threshold 1.0,
+dl4jGANComputerVision.java:120-121) — reproduced as a pytree map.  L2-norm
+clipping provided for roadmap configs.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def clip_elementwise(grads, threshold: float = 1.0):
+    return jax.tree_util.tree_map(
+        lambda g: jnp.clip(g, -threshold, threshold), grads
+    )
+
+
+def clip_by_global_norm(grads, max_norm: float):
+    leaves = jax.tree_util.tree_leaves(grads)
+    norm = jnp.sqrt(sum(jnp.sum(g.astype(jnp.float32) ** 2) for g in leaves))
+    scale = jnp.minimum(1.0, max_norm / (norm + 1e-12))
+    return jax.tree_util.tree_map(lambda g: g * scale, grads)

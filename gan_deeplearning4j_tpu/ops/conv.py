@@ -1,0 +1,56 @@
+"""2-D convolution with DL4J semantics, lowered to XLA's TPU conv emitter.
+
+Replaces libnd4j's im2col+GEMM conv kernels (the reference's dominant FLOPs,
+SURVEY.md §3.2 "hot loops").  On TPU the convolution lowers straight onto the
+MXU via ``lax.conv_general_dilated`` — no im2col materialization, no JNI
+boundary.
+
+DL4J semantics reproduced exactly (ConvolutionLayer, ConvolutionMode.Truncate
+default — dl4jGANComputerVision.java:126-133):
+  - data layout NCHW, weights OIHW, explicit symmetric padding (default 0),
+  - out = floor((in + 2p - k) / s) + 1  ("Truncate": trailing rows/cols that
+    don't fill a window are dropped),
+  - bias per output channel.
+
+Shape chain to preserve (SURVEY.md §7 "hard parts"): 28x28 -5x5 s2-> 12x12
+-pool 2x2 s1-> 11x11 -5x5 s2-> 4x4 -pool-> 3x3 -> flatten 128*3*3=1152.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+DIMENSION_NUMBERS = ("NCHW", "OIHW", "NCHW")
+
+
+def conv2d_out_size(in_size: int, kernel: int, stride: int, pad: int) -> int:
+    """DL4J Truncate-mode output size (floor division)."""
+    return (in_size + 2 * pad - kernel) // stride + 1
+
+
+def conv2d(
+    x: jax.Array,
+    w: jax.Array,
+    b: jax.Array | None = None,
+    stride: Sequence[int] = (1, 1),
+    padding: Sequence[int] = (0, 0),
+    *,
+    preferred_dtype=None,
+) -> jax.Array:
+    """x: [B, C, H, W]; w: [O, I, kh, kw]; b: [O] or None."""
+    ph, pw = padding
+    out = lax.conv_general_dilated(
+        x,
+        w,
+        window_strides=tuple(stride),
+        padding=[(ph, ph), (pw, pw)],
+        dimension_numbers=DIMENSION_NUMBERS,
+        preferred_element_type=preferred_dtype,
+    )
+    if b is not None:
+        out = out + b.reshape(1, -1, 1, 1)
+    return out

@@ -1,0 +1,48 @@
+"""Dense (fully connected) layer op.
+
+Replaces libnd4j's GEMM path (OpenBLAS/MKL on CPU, cuBLAS on GPU —
+dl4jGAN.iml:229,244) with ``jnp.dot`` lowered to XLA ``dot_general`` on the
+MXU.  Optionally accumulates in bfloat16 inputs / float32 accumulation for
+the MXU fast path.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def dense(
+    x: jax.Array,
+    w: jax.Array,
+    b: jax.Array | None = None,
+    *,
+    bf16: bool = False,
+) -> jax.Array:
+    """x: [B, F_in]; w: [F_in, F_out] (DL4J "W" layout); b: [F_out]."""
+    if bf16:
+        out = jnp.dot(
+            x.astype(jnp.bfloat16),
+            w.astype(jnp.bfloat16),
+            preferred_element_type=jnp.float32,
+        )
+    else:
+        out = jnp.dot(x, w)
+    if b is not None:
+        out = out + b
+    return out
+
+
+def dropout(x: jax.Array, rate: float, rng: jax.Array, train: bool) -> jax.Array:
+    """Inverted dropout.
+
+    Note: the reference's ``new DropoutLayer()`` carries DL4J's unset default
+    dropout probability, i.e. it is an identity op in practice
+    (dl4jGANInsurance.java:134; SURVEY-verified quirk).  rate=0.0 reproduces
+    that; nonzero rates are for the roadmap configs.
+    """
+    if not train or rate <= 0.0:
+        return x
+    keep = 1.0 - rate
+    mask = jax.random.bernoulli(rng, keep, x.shape)
+    return jnp.where(mask, x / keep, 0.0)

@@ -1,0 +1,42 @@
+"""Weight initializers.
+
+DL4J ``WeightInit.XAVIER`` (the reference's global choice,
+dl4jGANComputerVision.java:125) is a *Gaussian* N(0, 2/(fanIn+fanOut)) — not
+Glorot-uniform.  Reproduced exactly; biases init to 0 (DL4J default).
+"""
+
+from __future__ import annotations
+
+from typing import Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def fan_in_out_dense(n_in: int, n_out: int) -> Tuple[int, int]:
+    return n_in, n_out
+
+
+def fan_in_out_conv(n_in: int, n_out: int, kernel: Sequence[int]) -> Tuple[int, int]:
+    receptive = 1
+    for k in kernel:
+        receptive *= k
+    return n_in * receptive, n_out * receptive
+
+
+def xavier(key: jax.Array, shape: Sequence[int], fan_in: int, fan_out: int, dtype=jnp.float32):
+    std = jnp.sqrt(2.0 / (fan_in + fan_out))
+    return jax.random.normal(key, tuple(shape), dtype) * std
+
+
+def xavier_uniform(key: jax.Array, shape: Sequence[int], fan_in: int, fan_out: int, dtype=jnp.float32):
+    limit = jnp.sqrt(6.0 / (fan_in + fan_out))
+    return jax.random.uniform(key, tuple(shape), dtype, -limit, limit)
+
+
+def zeros(shape: Sequence[int], dtype=jnp.float32):
+    return jnp.zeros(tuple(shape), dtype)
+
+
+def ones(shape: Sequence[int], dtype=jnp.float32):
+    return jnp.ones(tuple(shape), dtype)

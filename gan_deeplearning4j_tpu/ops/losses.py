@@ -1,0 +1,91 @@
+"""Loss functions.
+
+Reproduces the two DL4J losses the reference exercises
+(``LossFunctions.LossFunction.XENT`` — binary cross-entropy on sigmoid
+outputs, dl4jGANComputerVision.java:152; ``MCXENT`` — multi-class
+cross-entropy on softmax, :345) plus the roadmap losses (Wasserstein /
+gradient-penalty for WGAN-GP — BASELINE.json configs).
+
+Convention (matches DL4J scoring): sum over output units, mean over the
+minibatch.  All losses are plain jnp compositions, so ``jax.grad`` composes
+through them — including second order, which WGAN-GP's gradient penalty
+requires (grad-of-grad through the conv stack, SURVEY.md §7).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+_EPS = 1e-7
+
+
+def binary_xent(probs: jax.Array, labels: jax.Array) -> jax.Array:
+    """XENT on probabilities (post-sigmoid), as DL4J computes it."""
+    p = jnp.clip(probs, _EPS, 1.0 - _EPS)
+    per_example = -(labels * jnp.log(p) + (1.0 - labels) * jnp.log(1.0 - p))
+    return jnp.mean(jnp.sum(per_example, axis=-1))
+
+
+def binary_xent_from_logits(logits: jax.Array, labels: jax.Array) -> jax.Array:
+    """Numerically stable sigmoid+XENT fusion (used by the fused fast path)."""
+    per_example = jnp.maximum(logits, 0) - logits * labels + jnp.log1p(
+        jnp.exp(-jnp.abs(logits))
+    )
+    return jnp.mean(jnp.sum(per_example, axis=-1))
+
+
+def mcxent(probs: jax.Array, labels: jax.Array) -> jax.Array:
+    """MCXENT on probabilities (post-softmax), labels one-hot."""
+    p = jnp.clip(probs, _EPS, 1.0)
+    return jnp.mean(-jnp.sum(labels * jnp.log(p), axis=-1))
+
+
+def mcxent_from_logits(logits: jax.Array, labels: jax.Array) -> jax.Array:
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    return jnp.mean(-jnp.sum(labels * logp, axis=-1))
+
+
+def mse(pred: jax.Array, target: jax.Array) -> jax.Array:
+    return jnp.mean(jnp.sum((pred - target) ** 2, axis=-1))
+
+
+def wasserstein(critic_out: jax.Array, labels: jax.Array) -> jax.Array:
+    """WGAN critic loss: labels +1 for real, -1 for fake; minimize -label*D(x)."""
+    return -jnp.mean(critic_out * labels)
+
+
+def gradient_penalty(critic_fn, real: jax.Array, fake: jax.Array, rng: jax.Array) -> jax.Array:
+    """WGAN-GP penalty E[(||∇_x D(x̂)||₂ - 1)²] on interpolates x̂.
+
+    ``critic_fn`` must be a pure fn of the input batch; second-order autodiff
+    flows through it (the reference's SameDiff could not express this —
+    BASELINE.json lists it as a stress config).
+    """
+    alpha_shape = (real.shape[0],) + (1,) * (real.ndim - 1)
+    alpha = jax.random.uniform(rng, alpha_shape, dtype=real.dtype)
+    interp = alpha * real + (1.0 - alpha) * fake
+
+    def scalar_critic(x_single):
+        return jnp.sum(critic_fn(x_single[None, ...]))
+
+    grads = jax.vmap(jax.grad(scalar_critic))(interp)
+    norms = jnp.sqrt(jnp.sum(grads.reshape(grads.shape[0], -1) ** 2, axis=-1) + 1e-12)
+    return jnp.mean((norms - 1.0) ** 2)
+
+
+_REGISTRY = {
+    "xent": binary_xent,
+    "mcxent": mcxent,
+    "mse": mse,
+    "wasserstein": wasserstein,
+}
+
+
+def get(name):
+    if callable(name):
+        return name
+    try:
+        return _REGISTRY[name.lower()]
+    except KeyError:
+        raise ValueError(f"unknown loss {name!r}; known: {sorted(_REGISTRY)}")

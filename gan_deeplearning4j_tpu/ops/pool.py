@@ -1,0 +1,55 @@
+"""Pooling ops (DL4J SubsamplingLayer equivalents).
+
+The reference uses the unusual max-pool 2x2 **stride 1**
+(dl4jGANComputerVision.java:134-138 — kernel (2,2), stride (1,1), Truncate),
+which shrinks each spatial dim by exactly 1.  Lowered to
+``lax.reduce_window`` which XLA maps onto the VPU.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def max_pool2d(
+    x: jax.Array,
+    kernel: Sequence[int] = (2, 2),
+    stride: Sequence[int] = (2, 2),
+    padding: Sequence[int] = (0, 0),
+) -> jax.Array:
+    """x: [B, C, H, W]; DL4J Truncate (VALID after explicit padding)."""
+    kh, kw = kernel
+    sh, sw = stride
+    ph, pw = padding
+    return lax.reduce_window(
+        x,
+        -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) else jnp.iinfo(x.dtype).min,
+        lax.max,
+        window_dimensions=(1, 1, kh, kw),
+        window_strides=(1, 1, sh, sw),
+        padding=[(0, 0), (0, 0), (ph, ph), (pw, pw)],
+    )
+
+
+def avg_pool2d(
+    x: jax.Array,
+    kernel: Sequence[int] = (2, 2),
+    stride: Sequence[int] = (2, 2),
+    padding: Sequence[int] = (0, 0),
+) -> jax.Array:
+    kh, kw = kernel
+    sh, sw = stride
+    ph, pw = padding
+    summed = lax.reduce_window(
+        x,
+        jnp.zeros((), x.dtype),
+        lax.add,
+        window_dimensions=(1, 1, kh, kw),
+        window_strides=(1, 1, sh, sw),
+        padding=[(0, 0), (0, 0), (ph, ph), (pw, pw)],
+    )
+    return summed / (kh * kw)

@@ -1,0 +1,50 @@
+"""Upsampling2D — nearest-neighbour repeat, DL4J Upsampling2D equivalent.
+
+The reference's generator "deconv" layers are Upsampling2D(2) followed by a
+stride-1 conv (dl4jGANComputerVision.java:191-209), NOT transposed
+convolution (SURVEY.md §3.3 note).  ``conv_transpose2d`` is provided for the
+roadmap model families that do use real deconvs.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from gan_deeplearning4j_tpu.ops.conv import DIMENSION_NUMBERS
+
+
+def upsample2d(x: jax.Array, size: int | Sequence[int] = 2) -> jax.Array:
+    """x: [B, C, H, W] -> [B, C, H*sh, W*sw] by nearest-neighbour repeat."""
+    if isinstance(size, int):
+        sh = sw = size
+    else:
+        sh, sw = size
+    x = jnp.repeat(x, sh, axis=2)
+    x = jnp.repeat(x, sw, axis=3)
+    return x
+
+
+def conv_transpose2d(
+    x: jax.Array,
+    w: jax.Array,
+    b: jax.Array | None = None,
+    stride: Sequence[int] = (2, 2),
+    padding: Sequence[int] = (0, 0),
+) -> jax.Array:
+    """Real transposed conv (for roadmap DCGAN variants). w: [O, I, kh, kw]."""
+    ph, pw = padding
+    out = lax.conv_transpose(
+        x,
+        w,
+        strides=tuple(stride),
+        padding=[(ph, ph), (pw, pw)],
+        dimension_numbers=DIMENSION_NUMBERS,
+        transpose_kernel=True,
+    )
+    if b is not None:
+        out = out + b.reshape(1, -1, 1, 1)
+    return out

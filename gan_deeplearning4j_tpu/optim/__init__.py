@@ -1,0 +1,6 @@
+from gan_deeplearning4j_tpu.optim.rmsprop import (  # noqa: F401
+    RmsProp,
+    rmsprop_init,
+    rmsprop_update,
+)
+from gan_deeplearning4j_tpu.optim.updater import GraphUpdater  # noqa: F401

@@ -1,0 +1,65 @@
+"""RmsProp with DL4J's exact parameterization and update rule.
+
+The reference constructs ``new RmsProp(learningRate, rmsDecay, epsilon)`` with
+the odd values (lr, 1e-8, 1e-8) on every layer (e.g.
+dl4jGANComputerVision.java:128).  DL4J's RmsPropUpdater computes:
+
+    cache  = rmsDecay * cache + (1 - rmsDecay) * g^2
+    update = lr * g / sqrt(cache + eps)
+
+Note eps is added *inside* the sqrt (unlike optax.rmsprop, which adds it
+outside) — with rmsDecay=1e-8 the cache is ~g^2, so the update is
+~lr * sign(g): effectively signSGD.  Reproducing this exactly matters for
+training-dynamics parity; hence a hand-rolled kernel rather than optax.
+
+"Frozen" layers in the reference are lr=0.0 (not DL4J FrozenLayer) —
+SURVEY.md appendix; per-leaf lr support makes that a scale, not a branch.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass(frozen=True)
+class RmsProp:
+    """Per-layer updater config (DL4J constructor argument order)."""
+
+    learning_rate: float = 0.001
+    rms_decay: float = 1e-8
+    epsilon: float = 1e-8
+
+
+def rmsprop_init(params):
+    """Cache ("lastGradient") zero-initialized, one slot per param leaf."""
+    return jax.tree_util.tree_map(jnp.zeros_like, params)
+
+
+def rmsprop_update_leaf(g, cache, lr, rms_decay, eps):
+    new_cache = rms_decay * cache + (1.0 - rms_decay) * g * g
+    update = lr * g * jax.lax.rsqrt(new_cache + eps)
+    return update, new_cache
+
+
+def rmsprop_update(grads, cache, lr_tree, rms_decay: float, eps: float):
+    """Apply the DL4J RmsProp rule leaf-wise.
+
+    ``lr_tree`` is either a scalar or a pytree of per-leaf learning rates
+    (the per-layer-lr mechanism; frozen = 0.0).
+    Returns (updates, new_cache); caller does param -= update.
+    """
+    if isinstance(lr_tree, (int, float)):
+        lr_tree = jax.tree_util.tree_map(lambda g: lr_tree, grads)
+    flat = jax.tree_util.tree_map(
+        lambda g, c, lr: rmsprop_update_leaf(g, c, lr, rms_decay, eps),
+        grads,
+        cache,
+        lr_tree,
+    )
+    updates = jax.tree_util.tree_map(lambda t: t[0], flat, is_leaf=lambda t: isinstance(t, tuple))
+    new_cache = jax.tree_util.tree_map(lambda t: t[1], flat, is_leaf=lambda t: isinstance(t, tuple))
+    return updates, new_cache

@@ -1,0 +1,1 @@
+from gan_deeplearning4j_tpu.runtime import backend, prng  # noqa: F401

@@ -1,0 +1,103 @@
+"""Device/backend runtime configuration.
+
+TPU-native replacement for the reference's backend plumbing: the Maven
+``nd4j.backend`` build property and the runtime CUDA context setup
+(``CudaEnvironment.getInstance().getConfiguration().allowMultiGPU(true)...``,
+reference ``Java/src/main/java/org/deeplearning4j/dl4jGANComputerVision.java:96-105``)
+become a runtime flag choosing a JAX platform plus a ``jax.sharding.Mesh``
+over however many chips are attached.  There is no device cache to size and no
+P2P toggle: HBM allocation and ICI routing are owned by XLA/PJRT.
+
+Dtype policy mirrors ``Nd4j.setDataType(DataBuffer.Type.FLOAT)``
+(dl4jGANComputerVision.java:98): default compute dtype float32, with an
+optional bfloat16 matmul policy for the MXU fast path.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+
+
+@dataclasses.dataclass
+class RuntimeConfig:
+    """Runtime equivalent of the reference's hardcoded backend constants."""
+
+    # `useGpu` (dl4jGANComputerVision.java:85) -> platform selection; None = auto.
+    platform: Optional[str] = None
+    # Nd4j.setDataType(FLOAT) (dl4jGANComputerVision.java:98).
+    dtype: np.dtype = np.float32
+    # bfloat16 matmuls on the MXU; params/activations stay float32.
+    matmul_bf16: bool = False
+    # seed 666 everywhere ("numberOfTheBeast", dl4jGANComputerVision.java:68).
+    seed: int = 666
+
+
+_config = RuntimeConfig()
+
+
+def configure(**kwargs) -> RuntimeConfig:
+    """Set global runtime options (platform, dtype, seed)."""
+    global _config
+    _config = dataclasses.replace(_config, **kwargs)
+    if _config.platform is not None:
+        jax.config.update("jax_platforms", _config.platform)
+    return _config
+
+
+def config() -> RuntimeConfig:
+    return _config
+
+
+def default_dtype() -> np.dtype:
+    return _config.dtype
+
+
+def devices() -> list:
+    return jax.devices()
+
+
+def device_count() -> int:
+    return len(jax.devices())
+
+
+def make_mesh(
+    axis_sizes: Optional[Sequence[int]] = None,
+    axis_names: Sequence[str] = ("data",),
+    devices: Optional[Sequence] = None,
+) -> jax.sharding.Mesh:
+    """Build a device mesh.
+
+    The reference's only parallel axis is data (4 Spark workers under
+    ``local[4]``, dl4jGANComputerVision.java:305); the general form here also
+    carries a 'model' axis for tensor parallelism, which DL4J cannot express.
+    """
+    devs = list(devices if devices is not None else jax.devices())
+    if axis_sizes is None:
+        axis_sizes = [len(devs)] + [1] * (len(axis_names) - 1)
+    n = int(np.prod(axis_sizes))
+    if n > len(devs):
+        raise ValueError(f"mesh wants {n} devices, only {len(devs)} available")
+    grid = np.array(devs[:n]).reshape(axis_sizes)
+    return jax.sharding.Mesh(grid, axis_names)
+
+
+def host_device_count_for_testing(n: int = 8) -> None:
+    """The reference tests its distributed path with Spark ``local[4]`` on one
+    machine (SURVEY.md §4.4).  The TPU-native equivalent: N virtual CPU
+    devices, so the full pjit/shard_map collective path runs clusterless.
+
+    Must be called before the JAX backend initializes.
+    """
+    os.environ.setdefault(
+        "XLA_FLAGS",
+        f"--xla_force_host_platform_device_count={n}",
+    )
+    try:
+        jax.config.update("jax_num_cpu_devices", n)
+    except Exception:
+        pass

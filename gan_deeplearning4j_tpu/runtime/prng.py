@@ -1,0 +1,46 @@
+"""PRNG seeding discipline.
+
+The reference seeds everything with 666 (``numberOfTheBeast``,
+dl4jGANComputerVision.java:68) and relies on ND4J's global stateful RNG.  JAX
+PRNG is functional — this module provides a small named-stream splitter so
+trainers get reproducible, independent streams (init / noise / dropout / data)
+from one root seed without global mutable state.
+"""
+
+from __future__ import annotations
+
+import hashlib
+
+import jax
+
+NUMBER_OF_THE_BEAST = 666
+
+
+def root_key(seed: int = NUMBER_OF_THE_BEAST) -> jax.Array:
+    return jax.random.key(seed)
+
+
+def stream(key: jax.Array, name: str) -> jax.Array:
+    """Derive a named independent stream from a key (stable across runs)."""
+    h = int.from_bytes(hashlib.sha256(name.encode()).digest()[:4], "little")
+    return jax.random.fold_in(key, h)
+
+
+class KeySequence:
+    """Stateful convenience wrapper: `next(seq)` yields fresh subkeys.
+
+    Host-side only (do not use inside jit); inside jitted steps thread keys
+    explicitly.
+    """
+
+    def __init__(self, key: jax.Array):
+        self._key = key
+
+    def __next__(self) -> jax.Array:
+        self._key, sub = jax.random.split(self._key)
+        return sub
+
+    def take(self, n: int):
+        keys = jax.random.split(self._key, n + 1)
+        self._key = keys[0]
+        return list(keys[1:])

@@ -1,0 +1,34 @@
+"""Test harness configuration.
+
+The reference exercises its full distributed path on one machine via Spark
+``local[4]`` (SURVEY.md §4.4).  The TPU-native equivalent: force the JAX host
+platform with 8 virtual CPU devices so every pjit/shard_map collective path
+runs clusterless.
+
+Note: this environment's TPU plugin (axon) force-sets
+``jax_platforms="axon,cpu"`` via ``jax.config.update`` at interpreter startup
+(sitecustomize), which overrides the JAX_PLATFORMS env var — so we must
+override it back through jax.config, after importing jax but before any
+backend initialization.
+"""
+
+import os
+
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def cpu_devices():
+    devs = jax.devices()
+    assert len(devs) >= 8, f"expected 8 virtual CPU devices, got {len(devs)}"
+    return devs

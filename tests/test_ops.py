@@ -1,0 +1,156 @@
+"""Numerical/shape tests for the ops layer (libnd4j-kernel equivalents).
+
+Mirrors the reference's verification style — the printed-summary shape
+checks (SURVEY.md §4.1) become assertions — plus numerical checks of each
+kernel against straightforward numpy references.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from gan_deeplearning4j_tpu import ops
+from gan_deeplearning4j_tpu.ops import activations, losses
+
+
+class TestConv2D:
+    def test_truncate_output_size(self):
+        # DL4J Truncate arithmetic: the CV discriminator chain (SURVEY.md §7).
+        assert ops.conv2d_out_size(28, 5, 2, 0) == 12
+        assert ops.conv2d_out_size(11, 5, 2, 0) == 4
+        # Generator convs: 5x5 s1 pad2 preserves size.
+        assert ops.conv2d_out_size(14, 5, 1, 2) == 14
+        assert ops.conv2d_out_size(28, 5, 1, 2) == 28
+
+    def test_conv_shapes(self):
+        x = jnp.zeros((2, 1, 28, 28))
+        w = jnp.zeros((64, 1, 5, 5))
+        b = jnp.zeros((64,))
+        y = ops.conv2d(x, w, b, stride=(2, 2))
+        assert y.shape == (2, 64, 12, 12)
+
+    def test_conv_value_vs_numpy(self):
+        rng = np.random.RandomState(0)
+        x = rng.randn(1, 2, 6, 6).astype(np.float32)
+        w = rng.randn(3, 2, 3, 3).astype(np.float32)
+        b = rng.randn(3).astype(np.float32)
+        y = np.asarray(ops.conv2d(jnp.asarray(x), jnp.asarray(w), jnp.asarray(b)))
+        # naive correlation reference
+        ref = np.zeros((1, 3, 4, 4), np.float32)
+        for o in range(3):
+            for i_ in range(4):
+                for j in range(4):
+                    ref[0, o, i_, j] = (
+                        np.sum(x[0, :, i_:i_ + 3, j:j + 3] * w[o]) + b[o]
+                    )
+        np.testing.assert_allclose(y, ref, rtol=2e-5, atol=2e-5)
+
+
+class TestPool:
+    def test_maxpool_stride1(self):
+        # The reference's unusual 2x2 stride-1 pool shrinks dims by one.
+        x = jnp.arange(2 * 1 * 12 * 12, dtype=jnp.float32).reshape(2, 1, 12, 12)
+        y = ops.max_pool2d(x, (2, 2), (1, 1))
+        assert y.shape == (2, 1, 11, 11)
+
+    def test_maxpool_values(self):
+        x = jnp.asarray([[[[1.0, 2.0], [3.0, 4.0]]]])
+        y = ops.max_pool2d(x, (2, 2), (1, 1))
+        assert y.shape == (1, 1, 1, 1)
+        assert float(y[0, 0, 0, 0]) == 4.0
+
+
+class TestUpsample:
+    def test_nearest_repeat(self):
+        x = jnp.asarray([[[[1.0, 2.0], [3.0, 4.0]]]])
+        y = ops.upsample2d(x, 2)
+        assert y.shape == (1, 1, 4, 4)
+        np.testing.assert_array_equal(
+            np.asarray(y[0, 0]),
+            [[1, 1, 2, 2], [1, 1, 2, 2], [3, 3, 4, 4], [3, 3, 4, 4]],
+        )
+
+
+class TestBatchNorm:
+    def test_train_normalizes(self):
+        rng = np.random.RandomState(1)
+        x = jnp.asarray(rng.randn(64, 5).astype(np.float32) * 3 + 2)
+        gamma, beta = jnp.ones(5), jnp.zeros(5)
+        mean, var = jnp.zeros(5), jnp.ones(5)
+        y, m2, v2 = ops.batch_norm_train(x, gamma, beta, mean, var)
+        np.testing.assert_allclose(np.asarray(jnp.mean(y, 0)), 0, atol=1e-5)
+        np.testing.assert_allclose(np.asarray(jnp.std(y, 0)), 1, atol=1e-3)
+        # running stats: decay 0.9 toward batch stats
+        np.testing.assert_allclose(
+            np.asarray(m2), 0.1 * np.asarray(jnp.mean(x, 0)), rtol=1e-5
+        )
+
+    def test_channelwise_4d(self):
+        x = jnp.ones((4, 3, 8, 8))
+        y, m, v = ops.batch_norm_train(
+            x, jnp.ones(3), jnp.zeros(3), jnp.zeros(3), jnp.ones(3)
+        )
+        assert y.shape == x.shape
+        assert m.shape == (3,)
+
+    def test_inference_uses_running_stats(self):
+        x = jnp.full((2, 3), 4.0)
+        y = ops.batch_norm_inference(
+            x, jnp.ones(3), jnp.zeros(3), jnp.full(3, 4.0), jnp.ones(3)
+        )
+        np.testing.assert_allclose(np.asarray(y), 0, atol=1e-3)
+
+
+class TestLosses:
+    def test_binary_xent_matches_formula(self):
+        p = jnp.asarray([[0.9], [0.1]])
+        y = jnp.asarray([[1.0], [0.0]])
+        expected = -np.mean([np.log(0.9), np.log(0.9)])
+        np.testing.assert_allclose(float(losses.binary_xent(p, y)), expected, rtol=1e-5)
+
+    def test_logits_form_agrees(self):
+        logits = jnp.asarray([[2.0], [-1.0], [0.3]])
+        y = jnp.asarray([[1.0], [0.0], [1.0]])
+        a = float(losses.binary_xent(jax.nn.sigmoid(logits), y))
+        b = float(losses.binary_xent_from_logits(logits, y))
+        np.testing.assert_allclose(a, b, rtol=1e-5)
+
+    def test_mcxent(self):
+        p = jnp.asarray([[0.7, 0.2, 0.1]])
+        y = jnp.asarray([[1.0, 0.0, 0.0]])
+        np.testing.assert_allclose(float(losses.mcxent(p, y)), -np.log(0.7), rtol=1e-5)
+
+    def test_gradient_penalty_second_order(self):
+        # grad-of-grad must compose (WGAN-GP roadmap, SURVEY.md §7).
+        w = jnp.asarray([[0.5], [2.0]])
+
+        def critic(x):
+            return jnp.tanh(x @ w)
+
+        gp = losses.gradient_penalty(
+            critic,
+            jnp.ones((4, 2)),
+            jnp.zeros((4, 2)),
+            jax.random.key(0),
+        )
+        assert np.isfinite(float(gp))
+
+        # and it is differentiable wrt critic params
+        def loss(w_):
+            def c(x):
+                return jnp.tanh(x @ w_)
+            return losses.gradient_penalty(
+                c, jnp.ones((4, 2)), jnp.zeros((4, 2)), jax.random.key(0)
+            )
+
+        g = jax.grad(loss)(w)
+        assert np.all(np.isfinite(np.asarray(g)))
+
+
+class TestActivations:
+    @pytest.mark.parametrize("name", ["tanh", "sigmoid", "elu", "relu", "softmax", "identity"])
+    def test_registry(self, name):
+        f = activations.get(name)
+        x = jnp.asarray([[0.5, -0.5]])
+        assert f(x).shape == x.shape
