@@ -1,0 +1,39 @@
+"""Data pipeline — TPU-native DataVec equivalent (SURVEY.md §1 L5).
+
+CSV record readers and batch iterators matching the reference's
+``CSVRecordReader`` + ``RecordReaderDataSetIterator`` semantics, dataset
+modules reproducing the notebook's export pipelines, and an optional
+native C++ fast-decode path.
+"""
+
+from gan_deeplearning4j_tpu.data.csv import (
+    CSVRecordReader,
+    DataSet,
+    RecordReaderDataSetIterator,
+    read_csv_matrix,
+    write_csv_matrix,
+)
+from gan_deeplearning4j_tpu.data.datasets import (
+    ensure_insurance_csv,
+    ensure_mnist_csv,
+    export_mnist_csv,
+    load_split,
+    prepare_insurance,
+    synthetic_mnist,
+    synthetic_transactions,
+)
+
+__all__ = [
+    "CSVRecordReader",
+    "DataSet",
+    "RecordReaderDataSetIterator",
+    "read_csv_matrix",
+    "write_csv_matrix",
+    "ensure_insurance_csv",
+    "ensure_mnist_csv",
+    "export_mnist_csv",
+    "load_split",
+    "prepare_insurance",
+    "synthetic_mnist",
+    "synthetic_transactions",
+]

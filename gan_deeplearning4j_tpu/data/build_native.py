@@ -1,0 +1,36 @@
+"""Build the native fastcsv shared library with g++.
+
+Usage: ``python -m gan_deeplearning4j_tpu.data.build_native``
+No external dependencies; output lands next to the source as
+``native_src/libfastcsv.so`` where data/native.py looks for it.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+
+
+def build(verbose: bool = True) -> str:
+    src_dir = os.path.join(os.path.dirname(__file__), "native_src")
+    src = os.path.join(src_dir, "fastcsv.cpp")
+    out = os.path.join(src_dir, "libfastcsv.so")
+    cmd = [
+        "g++", "-O3", "-march=native", "-shared", "-fPIC",
+        "-o", out, src,
+    ]
+    if verbose:
+        print(" ".join(cmd))
+    subprocess.run(cmd, check=True)
+    return out
+
+
+if __name__ == "__main__":
+    path = build()
+    from gan_deeplearning4j_tpu.data import native
+
+    native._LIB_TRIED = False  # force reload after a rebuild
+    ok = native.available()
+    print(f"built {path}; loadable: {ok}")
+    sys.exit(0 if ok else 1)

@@ -1,0 +1,207 @@
+"""Dataset modules — the notebook's data-prep pipelines as code.
+
+The reference prepares data in ``Python/gan.ipynb``:
+  - cell 2 (raw lines 44-110): Keras MNIST -> flatten 784 -> /255 ->
+    ``mnist_{train,test}.csv`` with the label appended as column 784.
+  - cell 8 (raw lines 959-1000): R-generated ``data/claim_risk.csv`` +
+    ``data/transactions.csv`` (1000 policies x 4 periods x 3 types) ->
+    reshape (1000, 12) -> 70/30 split seed 666 -> min-max scaling by
+    *train* stats -> ``insurance_{train,test}.csv`` with label column 12.
+
+This module reproduces both contracts.  Because this environment has no
+network egress and the reference's raw inputs (Keras download, R script
+output) are unavailable, each dataset also has a deterministic synthetic
+generator with real class structure so end-to-end training/eval is
+meaningful: a procedural bitmap-font digit renderer for MNIST and a
+label-dependent Poisson transaction-lattice model for insurance (the
+reference's own insurance data is synthetic too).  If contract CSVs exist
+at the given path they are always preferred.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Tuple
+
+import numpy as np
+
+from gan_deeplearning4j_tpu.data.csv import CSVRecordReader
+
+SEED = 666  # numberOfTheBeast — the reference's seed everywhere
+
+# ---------------------------------------------------------------------------
+# MNIST (surrogate): procedural 5x7 bitmap-font digits -> 28x28
+# ---------------------------------------------------------------------------
+
+_DIGIT_FONT = [
+    # 5x7 bitmaps, row-major, one string per digit
+    "01110100011001110101110011000101110",  # 0
+    "00100011000010000100001000010001110",  # 1
+    "01110100010000100010001000100011111",  # 2
+    "11111000100010000010000011000101110",  # 3
+    "00010001100101010010111110001000010",  # 4
+    "11111100001111000001000011000101110",  # 5
+    "00110010001000011110100011000101110",  # 6
+    "11111000010001000100010000100001000",  # 7
+    "01110100011000101110100011000101110",  # 8
+    "01110100011000101111000010001001100",  # 9
+]
+
+
+def _digit_bitmap(d: int) -> np.ndarray:
+    bits = np.frombuffer(_DIGIT_FONT[d].encode(), dtype=np.uint8) - ord("0")
+    return bits.reshape(7, 5).astype(np.float32)
+
+
+def synthetic_mnist(
+    n: int, seed: int = SEED, noise: float = 0.08
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Deterministic MNIST-like digits: scaled/jittered bitmap glyphs with
+    pixel noise; features in [0,1] like the notebook's /255 scaling.
+
+    Returns (features[n,784] float32, labels[n] int64).
+    """
+    rng = np.random.RandomState(seed)
+    labels = rng.randint(0, 10, size=n)
+    imgs = np.zeros((n, 28, 28), dtype=np.float32)
+    scale = 3  # 5x7 glyph -> 15x21
+    for i in range(n):
+        glyph = _digit_bitmap(int(labels[i]))
+        big = np.kron(glyph, np.ones((scale, scale), dtype=np.float32))  # 21x15
+        # intensity variation per sample
+        big = big * rng.uniform(0.7, 1.0)
+        dy = rng.randint(0, 28 - big.shape[0] + 1)
+        dx = rng.randint(0, 28 - big.shape[1] + 1)
+        imgs[i, dy:dy + big.shape[0], dx:dx + big.shape[1]] = big
+    imgs += rng.randn(n, 28, 28).astype(np.float32) * noise
+    np.clip(imgs, 0.0, 1.0, out=imgs)
+    return imgs.reshape(n, 784), labels.astype(np.int64)
+
+
+def export_mnist_csv(
+    out_dir: str,
+    n_train: int = 60000,
+    n_test: int = 10000,
+    seed: int = SEED,
+) -> Tuple[str, str]:
+    """Write ``mnist_{train,test}.csv`` in the notebook's contract (cell 2):
+    784 feature columns formatted %.2f, integer label as column 784."""
+    os.makedirs(out_dir, exist_ok=True)
+    paths = []
+    for split, n, s in (("train", n_train, seed), ("test", n_test, seed + 1)):
+        path = os.path.join(out_dir, f"mnist_{split}.csv")
+        feats, labels = synthetic_mnist(n, seed=s)
+        table = np.concatenate([feats, labels.reshape(-1, 1).astype(np.float32)], axis=1)
+        fmt = ["%.2f"] * 784 + ["%d"]
+        np.savetxt(path, table, delimiter=",", fmt=fmt)
+        paths.append(path)
+    return tuple(paths)
+
+
+def ensure_mnist_csv(data_dir: str, n_train: int = 60000, n_test: int = 10000) -> Tuple[str, str]:
+    """Return (train_csv, test_csv), generating the synthetic surrogate only
+    if the contract files don't already exist (real exported MNIST wins;
+    a half-present pair is an error rather than a silent overwrite)."""
+    train = os.path.join(data_dir, "mnist_train.csv")
+    test = os.path.join(data_dir, "mnist_test.csv")
+    have = (os.path.exists(train), os.path.exists(test))
+    if have == (True, True):
+        return train, test
+    if have != (False, False):
+        raise FileExistsError(
+            f"one of {train} / {test} exists without the other; refusing to "
+            "overwrite — delete the stray file or provide both"
+        )
+    export_mnist_csv(data_dir, n_train, n_test)
+    return train, test
+
+
+# ---------------------------------------------------------------------------
+# Insurance: synthetic transaction lattices (notebook cell 8 pipeline)
+# ---------------------------------------------------------------------------
+
+N_POLICIES = 1000
+N_PERIODS = 4       # tensorDimOneSize (dl4jGANInsurance.java:70)
+N_TYPES = 3         # tensorDimTwoSize (:71)
+
+
+def synthetic_transactions(
+    n_policies: int = N_POLICIES, seed: int = SEED
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Label-dependent transaction lattices: (transactions[n,4,3], risk[n]).
+
+    Stands in for the reference's R-generated ``data/transactions.csv`` +
+    ``data/claim_risk.csv`` (gitignored upstream, reference ``.gitignore:6``).
+    High-risk policies (P=0.3) have escalating claim-type activity across
+    periods; low-risk have flat premium-type activity — a structure a GAN
+    discriminator's features can separate, like the real data's.
+    """
+    rng = np.random.RandomState(seed)
+    risk = (rng.rand(n_policies) < 0.3).astype(np.int64)
+    base = np.array([[6.0, 3.0, 0.5]] * N_PERIODS)  # premium, service, claim
+    lam = np.tile(base, (n_policies, 1, 1))
+    escalate = np.array([0.5, 1.0, 2.0, 4.0]).reshape(1, N_PERIODS)
+    lam[:, :, 2] += risk.reshape(-1, 1) * escalate * 2.0
+    lam[:, :, 0] -= risk.reshape(-1, 1) * escalate * 0.8
+    lam = np.clip(lam, 0.1, None)
+    trans = rng.poisson(lam).astype(np.float64)
+    return trans, risk
+
+
+def prepare_insurance(
+    out_dir: str,
+    n_policies: int = N_POLICIES,
+    test_fraction: float = 0.3,
+    seed: int = SEED,
+) -> Tuple[str, str]:
+    """The notebook's cell-8 pipeline: reshape (n, 12), 70/30 split seed 666,
+    min-max scale **by train-split stats**, write
+    ``insurance_{train,test}.csv`` (12 features + label column 12)."""
+    os.makedirs(out_dir, exist_ok=True)
+    trans, risk = synthetic_transactions(n_policies, seed)
+    flat = trans.reshape(n_policies, N_PERIODS * N_TYPES)
+
+    # train_test_split(..., test_size=0.3, random_state=666) semantics
+    rng = np.random.RandomState(seed)
+    perm = rng.permutation(n_policies)
+    n_test = int(round(n_policies * test_fraction))
+    test_idx, train_idx = perm[:n_test], perm[n_test:]
+    x_train, x_test = flat[train_idx], flat[test_idx]
+    y_train, y_test = risk[train_idx], risk[test_idx]
+
+    lo = x_train.min(axis=0)
+    hi = x_train.max(axis=0)
+    span = np.where(hi > lo, hi - lo, 1.0)
+    x_train = (x_train - lo) / span
+    x_test = (x_test - lo) / span  # train stats, per the notebook
+
+    paths = []
+    for split, x, y in (("train", x_train, y_train), ("test", x_test, y_test)):
+        path = os.path.join(out_dir, f"insurance_{split}.csv")
+        table = np.concatenate([x, y.reshape(-1, 1).astype(np.float64)], axis=1)
+        np.savetxt(path, table, delimiter=",", fmt="%.6f")
+        paths.append(path)
+    return tuple(paths)
+
+
+def ensure_insurance_csv(data_dir: str) -> Tuple[str, str]:
+    train = os.path.join(data_dir, "insurance_train.csv")
+    test = os.path.join(data_dir, "insurance_test.csv")
+    have = (os.path.exists(train), os.path.exists(test))
+    if have == (True, True):
+        return train, test
+    if have != (False, False):
+        raise FileExistsError(
+            f"one of {train} / {test} exists without the other; refusing to "
+            "overwrite — delete the stray file or provide both"
+        )
+    prepare_insurance(data_dir)
+    return train, test
+
+
+def load_split(path: str, label_index: int) -> Tuple[np.ndarray, np.ndarray]:
+    """Decode a contract CSV into (features, raw label column)."""
+    table = CSVRecordReader().read(path)
+    feats = np.delete(table, label_index, axis=1)
+    labels = table[:, label_index]
+    return feats, labels

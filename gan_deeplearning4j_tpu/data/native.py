@@ -1,0 +1,87 @@
+"""Native (C++) fast CSV decode path — libnd4j/DataVec-style native IO.
+
+The reference's IO runs on the JVM with native BLAS underneath; its CSV
+decode is pure Java (DataVec).  Here the hot decode is optionally offloaded
+to a small C++ shared library (see ``native_src/fastcsv.cpp``), loaded via
+ctypes.  Falls back to numpy transparently when the library isn't built.
+
+Build: ``python -m gan_deeplearning4j_tpu.data.build_native`` (uses g++;
+no external deps).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+from typing import Optional
+
+import numpy as np
+
+_LIB = None
+_LIB_TRIED = False
+
+
+def _lib_path() -> str:
+    return os.path.join(os.path.dirname(__file__), "native_src", "libfastcsv.so")
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    global _LIB, _LIB_TRIED
+    if _LIB_TRIED:
+        return _LIB
+    _LIB_TRIED = True
+    path = _lib_path()
+    if not os.path.exists(path):
+        return None
+    try:
+        lib = ctypes.CDLL(path)
+        lib.fastcsv_count.restype = ctypes.c_long
+        lib.fastcsv_count.argtypes = [
+            ctypes.c_char_p, ctypes.c_long, ctypes.c_char,
+            ctypes.POINTER(ctypes.c_long), ctypes.POINTER(ctypes.c_long),
+        ]
+        lib.fastcsv_parse.restype = ctypes.c_long
+        lib.fastcsv_parse.argtypes = [
+            ctypes.c_char_p, ctypes.c_long, ctypes.c_char,
+            ctypes.POINTER(ctypes.c_float), ctypes.c_long,
+        ]
+        _LIB = lib
+    except OSError:
+        _LIB = None
+    return _LIB
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+def read_csv(path: str, skip_lines: int, delimiter: str, dtype) -> Optional[np.ndarray]:
+    """Decode a numeric CSV via the C++ parser; None if unavailable (caller
+    falls back to numpy)."""
+    if dtype != np.float32 or len(delimiter) != 1:
+        return None
+    lib = _load()
+    if lib is None:
+        return None
+    with open(path, "rb") as f:
+        data = f.read()
+    for _ in range(skip_lines):
+        nl = data.find(b"\n")
+        if nl < 0:
+            return None
+        data = data[nl + 1:]
+    rows = ctypes.c_long()
+    cols = ctypes.c_long()
+    ok = lib.fastcsv_count(
+        data, len(data), delimiter.encode()[0], ctypes.byref(rows), ctypes.byref(cols)
+    )
+    if ok != 0 or rows.value <= 0 or cols.value <= 0:
+        return None
+    out = np.empty((rows.value, cols.value), dtype=np.float32)
+    n = lib.fastcsv_parse(
+        data, len(data), delimiter.encode()[0],
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)), out.size,
+    )
+    if n != out.size:
+        return None
+    return out

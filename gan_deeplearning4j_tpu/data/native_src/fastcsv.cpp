@@ -1,0 +1,163 @@
+// fastcsv — minimal native numeric-CSV decoder.
+//
+// TPU-native stand-in for the reference stack's native IO layer: DataVec's
+// CSV decode runs on the JVM, but the runtime underneath (libnd4j,
+// nd4j-native — reference Java/dl4jGAN.iml:255) is C++; this keeps the
+// framework's hot host-side decode native too.  Exposed to Python via
+// ctypes (no pybind11 in this image).
+//
+// Contract: numeric CSV, single-char delimiter, '\n' rows (optional '\r'),
+// no quoting.  Returns row-major float32.  Fixed-notation numbers take a
+// hand-rolled parse loop; scientific notation falls back to strtod.  Rows
+// are decoded in parallel across hardware threads.
+//
+// Build: python -m gan_deeplearning4j_tpu.data.build_native
+
+#include <cstdlib>
+#include <cstring>
+#include <thread>
+#include <vector>
+
+namespace {
+
+// Parse one number at p (must not pass end); advances p. NaN-free fast path
+// for [-+]?digits[.digits]; falls back to strtod for exponents/inf/nan.
+inline float parse_value(const char*& p, const char* end, bool& ok) {
+    const char* start = p;
+    bool neg = false;
+    if (p < end && (*p == '-' || *p == '+')) { neg = (*p == '-'); p++; }
+    double v = 0.0;
+    const char* digits_start = p;
+    while (p < end && *p >= '0' && *p <= '9') v = v * 10.0 + (*p++ - '0');
+    if (p < end && *p == '.') {
+        p++;
+        double scale = 0.1;
+        while (p < end && *p >= '0' && *p <= '9') { v += (*p++ - '0') * scale; scale *= 0.1; }
+    }
+    if (p == digits_start || (p < end && (*p == 'e' || *p == 'E' ||
+                                          *p == 'n' || *p == 'N' ||
+                                          *p == 'i' || *p == 'I'))) {
+        char* next = nullptr;
+        double sv = strtod(start, &next);
+        if (next == start) { ok = false; return 0.0f; }
+        p = next;
+        ok = true;
+        return (float)sv;
+    }
+    ok = true;
+    return (float)(neg ? -v : v);
+}
+
+// Parse rows whose byte ranges are [begin, end) into out (already offset).
+long parse_range(const char* p, const char* end, char delim, float* out, long capacity) {
+    long n = 0;
+    while (p < end) {
+        while (p < end && (*p == '\n' || *p == '\r')) p++;
+        if (p >= end) break;
+        for (;;) {
+            bool ok = false;
+            float v = parse_value(p, end, ok);
+            if (!ok || n >= capacity) return -1;
+            out[n++] = v;
+            while (p < end && (*p == ' ' || *p == '\t' || *p == '\r')) p++;
+            if (p < end && *p == delim) { p++; continue; }
+            break;
+        }
+        while (p < end && *p != '\n') p++;
+    }
+    return n;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Count rows/cols. Returns 0 on success, nonzero on ragged/invalid input.
+long fastcsv_count(const char* data, long len, char delim, long* rows, long* cols) {
+    long r = 0, c = -1, cur = 1;
+    const char* end = data + len;
+    const char* p = data;
+    bool any = false;
+    while (p < end) {
+        char ch = *p++;
+        if (ch == delim) {
+            cur++;
+        } else if (ch == '\n') {
+            if (any || cur > 1) {
+                if (c < 0) c = cur;
+                else if (c != cur) return 1;
+                r++;
+            }
+            cur = 1;
+            any = false;
+        } else if (ch != '\r' && ch != ' ' && ch != '\t') {
+            any = true;
+        }
+    }
+    if (any) {  // final row without trailing newline
+        if (c < 0) c = cur;
+        else if (c != cur) return 1;
+        r++;
+    }
+    *rows = r;
+    *cols = c < 0 ? 0 : c;
+    return 0;
+}
+
+// Parse into out[capacity]; returns number of values written (or -1 on error).
+// Splits the buffer at line boundaries and decodes chunks across threads;
+// each chunk's output offset is chunk_start_row * cols (cols uniform, as
+// validated by fastcsv_count).
+long fastcsv_parse(const char* data, long len, char delim, float* out, long capacity) {
+    long rows = 0, cols = 0;
+    if (fastcsv_count(data, len, delim, &rows, &cols) != 0) return -1;
+    if (rows * cols > capacity) return -1;
+    if (rows == 0) return 0;
+
+    unsigned hw = std::thread::hardware_concurrency();
+    long nthreads = hw ? (long)hw : 1;
+    if (nthreads > rows) nthreads = rows;
+    if (rows * cols < 1 << 16) nthreads = 1;  // not worth spawning
+
+    // Chunk boundaries: walk to the nearest newline after each even split,
+    // counting rows so far so each chunk knows its output offset.
+    struct Chunk { const char* begin; const char* end; long row0; };
+    std::vector<Chunk> chunks;
+    const char* end = data + len;
+    const char* p = data;
+    long row0 = 0;
+    for (long t = 0; t < nthreads; t++) {
+        const char* target = data + (len * (t + 1)) / nthreads;
+        const char* q = (t == nthreads - 1) ? end : target;
+        while (q < end && *q != '\n') q++;
+        if (q < end) q++;  // include the newline
+        long chunk_rows = 0;
+        for (const char* s = p; s < q; s++) if (*s == '\n') chunk_rows++;
+        if (q == end && len > 0 && end[-1] != '\n') chunk_rows++;  // last row, no trailing \n
+        chunks.push_back({p, q, row0});
+        row0 += chunk_rows;
+        p = q;
+        if (p >= end) break;
+    }
+
+    std::vector<long> results(chunks.size());
+    std::vector<std::thread> threads;
+    for (size_t i = 0; i < chunks.size(); i++) {
+        threads.emplace_back([&, i]() {
+            const Chunk& ck = chunks[i];
+            results[i] = parse_range(ck.begin, ck.end, delim,
+                                     out + ck.row0 * cols,
+                                     rows * cols - ck.row0 * cols);
+        });
+    }
+    for (auto& t : threads) t.join();
+
+    long total = 0;
+    for (long r : results) {
+        if (r < 0) return -1;
+        total += r;
+    }
+    return total;
+}
+
+}  // extern "C"

@@ -280,7 +280,7 @@ class ComputationGraph:
                 if node.preprocessor is not None:
                     x = node.preprocessor(x)
             layer_train = train and name not in self.frozen
-            layer_rng = jax.random.fold_in(rng, _stable_hash(name)) if rng is not None else None
+            layer_rng = prng.stream(rng, name) if rng is not None else None
             y, upd = node.layer.apply(params[name], x, layer_train, layer_rng)
             if upd:
                 state_updates[name] = upd
@@ -392,9 +392,3 @@ class ComputationGraph:
         lines.append(f"Total params: {total}")
         lines.append("=" * 76)
         return "\n".join(lines)
-
-
-def _stable_hash(name: str) -> int:
-    import hashlib
-
-    return int.from_bytes(hashlib.sha256(name.encode()).digest()[:4], "little")

@@ -95,19 +95,38 @@ class TransferLearning:
                 if name == self._feature_extractor:
                     break
 
+        # DL4J removeVertexKeepConnections: consumers of a removed vertex are
+        # rewired to the removed vertex's own inputs (transitively, if several
+        # removed vertices chain).
+        removed_inputs = {
+            name: list(self.source.nodes[name].inputs) for name in self._removed
+        }
+
+        def _rewire(inputs):
+            out: List[str] = []
+            for inp in inputs:
+                if inp in removed_inputs:
+                    out.extend(_rewire(removed_inputs[inp]))
+                else:
+                    out.append(inp)
+            return out
+
         kept: Dict[str, Node] = {}
         for name, node in self.source.nodes.items():
             if name in self._removed:
                 continue
             # Retained layers keep their resolved config (incl. activation) —
             # already resolved, so the new defaults only affect added layers.
-            builder.add_layer(name, node.layer, *node.inputs)
+            builder.add_layer(name, node.layer, *_rewire(node.inputs))
             if node.preprocessor is not None:
                 builder.input_preprocessor(name, node.preprocessor)
             kept[name] = node
 
         for name, layer, inputs in self._added:
-            builder.add_layer(name, layer, *inputs)
+            # A vertex re-added under a removed name (the reference re-adds
+            # "dis_output_layer_7") is a real node again from here on.
+            removed_inputs.pop(name, None)
+            builder.add_layer(name, layer, *_rewire(inputs))
 
         outputs = self._new_outputs
         if outputs is None:

@@ -35,15 +35,25 @@ def conv_transpose2d(
     stride: Sequence[int] = (2, 2),
     padding: Sequence[int] = (0, 0),
 ) -> jax.Array:
-    """Real transposed conv (for roadmap DCGAN variants). w: [O, I, kh, kw]."""
+    """Real transposed conv (for roadmap DCGAN variants). w: [O, I, kh, kw]
+    mapping I input channels to O output channels.
+
+    Implemented as the equivalent input-dilated forward conv (the form XLA
+    lowers best on the MXU): dilate x by ``stride``, pad by ``k-1-p``, and
+    convolve with the spatially-flipped kernel.  Output size per dim:
+    ``(in - 1)*stride - 2*pad + kernel`` (torch ConvTranspose2d arithmetic,
+    matching layers.ConvTranspose2D.out_shape).
+    """
+    sh, sw = stride
     ph, pw = padding
-    out = lax.conv_transpose(
+    kh, kw = w.shape[2], w.shape[3]
+    out = lax.conv_general_dilated(
         x,
-        w,
-        strides=tuple(stride),
-        padding=[(ph, ph), (pw, pw)],
+        w[:, :, ::-1, ::-1],
+        window_strides=(1, 1),
+        padding=[(kh - 1 - ph, kh - 1 - ph), (kw - 1 - pw, kw - 1 - pw)],
+        lhs_dilation=(sh, sw),
         dimension_numbers=DIMENSION_NUMBERS,
-        transpose_kernel=True,
     )
     if b is not None:
         out = out + b.reshape(1, -1, 1, 1)
