@@ -111,8 +111,13 @@ class RecordReaderDataSetIterator:
             raw = table[:, label_index]
             if num_classes >= 2:
                 # one-hot (CV path: numClasses=10 -> softmax labels)
+                idx = raw.astype(np.int64)
+                if idx.min() < 0 or idx.max() >= num_classes:
+                    raise ValueError(
+                        f"label column has values outside [0, {num_classes})"
+                    )
                 labels = np.zeros((table.shape[0], num_classes), dtype=dtype)
-                labels[np.arange(table.shape[0]), raw.astype(np.int64)] = 1.0
+                labels[np.arange(table.shape[0]), idx] = 1.0
                 self._labels = labels
             else:
                 # numClasses=1: raw sigmoid target column (insurance path)

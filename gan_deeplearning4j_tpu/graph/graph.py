@@ -313,13 +313,18 @@ class ComputationGraph:
             total = total + loss_lib.get(loss_name)(outputs[name], labels[name])
         return total
 
-    def _train_step(self, params, opt_state, rng, inputs, labels):
+    def _train_step(self, params, opt_state, rng, inputs, labels, reduce=None):
+        """One optimization step.  ``reduce`` is the cross-replica hook the
+        distributed layer injects (pmean of loss/BN-stats/grads inside
+        shard_map) so single-device and DP steps share one source of truth."""
         def loss_fn(p):
             values, state_updates = self._forward(p, inputs, True, rng)
             outputs = {n: values[n] for n in self.output_names}
             return self._loss(outputs, labels), state_updates
 
         (loss, state_updates), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
+        if reduce is not None:
+            loss, state_updates, grads = reduce(loss, state_updates, grads)
         new_params, new_opt_state = self.updater.apply(params, grads, opt_state)
         for lname, upd in state_updates.items():
             merged = dict(new_params[lname])

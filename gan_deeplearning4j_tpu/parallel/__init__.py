@@ -1,0 +1,26 @@
+"""Distributed training — TPU-native replacement for dl4j-spark / Aeron
+(SURVEY.md §1 L4/L4b/L0b, §2c).
+
+One ``jax.sharding.Mesh`` + XLA collectives over ICI replace the Spark
+cluster runtime, Kryo serialization, parameter-averaging TrainingMaster,
+and the Aeron parameter server.  Long-context sequence parallelism (ring
+attention) lives here too — first-class, per the framework's scope.
+"""
+
+from gan_deeplearning4j_tpu.parallel.mesh import (
+    batch_sharding,
+    data_mesh,
+    make_mesh,
+    replicated,
+    shard_batch,
+)
+from gan_deeplearning4j_tpu.parallel.data_parallel import DataParallelGraph
+
+__all__ = [
+    "DataParallelGraph",
+    "batch_sharding",
+    "data_mesh",
+    "make_mesh",
+    "replicated",
+    "shard_batch",
+]

@@ -1,0 +1,68 @@
+"""Device-mesh construction — the cluster-runtime replacement.
+
+The reference's "cluster" is a Spark context over ``local[4]`` threads
+(dl4jGANComputerVision.java:303-309) with Kryo-serialized INDArrays crossing
+process boundaries.  Here the cluster is a ``jax.sharding.Mesh``: XLA
+partitions one program over the devices and inserts ICI collectives — no
+serialization layer, no driver/executor round trips (SURVEY.md §2c).
+
+Axis conventions used across the framework:
+  ``data``  — batch / data parallelism (the only axis the reference needs)
+  ``model`` — tensor parallelism (roadmap)
+  ``seq``   — sequence/context parallelism, ring attention (long-context)
+
+The reference's clusterless test trick (Spark ``local[4]``) maps to
+``XLA_FLAGS=--xla_force_host_platform_device_count=N`` with a CPU mesh —
+the same collective code paths, no TPU required (SURVEY.md §4.4).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+
+def data_mesh(n_devices: Optional[int] = None, axis: str = "data") -> Mesh:
+    """1-D mesh over the batch axis — the ParameterAveragingTrainingMaster
+    replacement's substrate.  ``n_devices=None`` uses every attached device."""
+    devs = jax.devices()
+    if n_devices is not None:
+        if n_devices > len(devs):
+            raise ValueError(f"asked for {n_devices} devices, have {len(devs)}")
+        devs = devs[:n_devices]
+    return Mesh(np.array(devs), (axis,))
+
+
+def make_mesh(shape: Dict[str, int], devices: Optional[Sequence] = None) -> Mesh:
+    """N-D mesh from {axis_name: size}, e.g. {"data": 4, "model": 2}.
+
+    Axis order follows dict insertion order; put the fastest-varying
+    (innermost, highest-bandwidth ICI) axis last.
+    """
+    devs = list(devices if devices is not None else jax.devices())
+    sizes = list(shape.values())
+    total = int(np.prod(sizes))
+    if total > len(devs):
+        raise ValueError(f"mesh {shape} needs {total} devices, have {len(devs)}")
+    arr = np.array(devs[:total]).reshape(sizes)
+    return Mesh(arr, tuple(shape.keys()))
+
+
+def batch_sharding(mesh: Mesh, axis: str = "data") -> NamedSharding:
+    """Sharding that splits the leading (batch) dim over ``axis``."""
+    return NamedSharding(mesh, PartitionSpec(axis))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, PartitionSpec())
+
+
+def shard_batch(mesh: Mesh, *arrays, axis: str = "data"):
+    """Place host arrays with the batch dim split across ``axis`` —
+    the ``sc.parallelize(trainDataList)`` moment, minus Kryo."""
+    sh = batch_sharding(mesh, axis)
+    out = tuple(jax.device_put(a, sh) for a in arrays)
+    return out if len(out) > 1 else out[0]

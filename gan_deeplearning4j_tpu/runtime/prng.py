@@ -26,6 +26,12 @@ def stream(key: jax.Array, name: str) -> jax.Array:
     return jax.random.fold_in(key, h)
 
 
+def fold_in_index(key: jax.Array, index) -> jax.Array:
+    """Per-replica/per-step stream from a traced integer (e.g.
+    ``lax.axis_index`` inside ``shard_map``)."""
+    return jax.random.fold_in(key, index)
+
+
 class KeySequence:
     """Stateful convenience wrapper: `next(seq)` yields fresh subkeys.
 

@@ -130,10 +130,13 @@ def test_native_csv_matches_numpy(tmp_path):
 
     if not native.available():
         import subprocess, sys
-        subprocess.run(
-            [sys.executable, "-m", "gan_deeplearning4j_tpu.data.build_native"],
-            check=True,
-        )
+        try:
+            subprocess.run(
+                [sys.executable, "-m", "gan_deeplearning4j_tpu.data.build_native"],
+                check=True,
+            )
+        except (subprocess.CalledProcessError, OSError):
+            pytest.skip("native fastcsv not buildable here")
         native._LIB_TRIED = False
         if not native.available():
             pytest.skip("native fastcsv not buildable here")
