@@ -1,0 +1,106 @@
+"""Benchmark: DCGAN-on-MNIST full-protocol training throughput (img/sec).
+
+The BASELINE.json north-star metric: the reference publishes no throughput
+(BASELINE.md), so the baseline is the same three-graph protocol executed on
+the host CPU (the stand-in for the reference's nd4j-native CPU run, which
+cannot execute here).  The CPU number is measured once and cached in
+``BENCH_BASELINE.json``; the benchmark then runs on the default JAX
+platform (the TPU when attached) and reports the ratio.
+
+Prints ONE JSON line:
+  {"metric": "dcgan_mnist_img_per_sec", "value": N, "unit": "img/sec/chip",
+   "vs_baseline": N}
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+BASELINE_PATH = os.path.join(os.path.dirname(__file__), "BENCH_BASELINE.json")
+BATCH = 200          # batchSizePerWorker (dl4jGANComputerVision.java:59)
+WARMUP = 3
+STEPS = 20
+
+
+def protocol_step_time(device) -> float:
+    """Mean seconds per full GAN-protocol iteration (D-step + syncs +
+    G-step + classifier step, batch 200) on the given device."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from gan_deeplearning4j_tpu.models import dcgan_mnist as M
+
+    with jax.default_device(device):
+        dis, gen, gan = (
+            M.build_discriminator(), M.build_generator(), M.build_gan())
+        classifier = M.build_classifier(dis)
+        rng = np.random.RandomState(0)
+        real = jax.device_put(rng.rand(BATCH, 784).astype(np.float32), device)
+        labels = jax.device_put(
+            np.eye(10, dtype=np.float32)[rng.randint(0, 10, BATCH)], device)
+        ones = jnp.ones((BATCH, 1), dtype=jnp.float32)
+        y_dis = jnp.concatenate([ones, jnp.zeros((BATCH, 1), dtype=jnp.float32)])
+        key = jax.random.key(0)
+
+        def one_iter(i):
+            z = jax.random.uniform(
+                jax.random.fold_in(key, i), (BATCH, 2), minval=-1.0, maxval=1.0)
+            fake = gen.output(z)[0].reshape(BATCH, 784)
+            d = dis.fit(jnp.concatenate([real, fake]), y_dis)
+            M.sync_params(gan, dis, M.DIS_TO_GAN)
+            g = gan.fit(z, ones)
+            M.sync_params(gen, gan, M.GAN_TO_GEN)
+            M.sync_params(classifier, dis, M.DIS_TO_CLASSIFIER)
+            c = classifier.fit(real, labels)
+            return d, g, c
+
+        for i in range(WARMUP):
+            d, g, c = one_iter(i)
+        jax.block_until_ready((d, g, c))
+        t0 = time.perf_counter()
+        for i in range(WARMUP, WARMUP + STEPS):
+            d, g, c = one_iter(i)
+        jax.block_until_ready((d, g, c))
+        return (time.perf_counter() - t0) / STEPS
+
+
+def main() -> None:
+    import jax
+
+    default = jax.devices()[0]
+    cpu = jax.devices("cpu")[0]
+
+    # baseline: CPU protocol throughput, measured once and cached
+    baseline = None
+    if os.path.exists(BASELINE_PATH):
+        with open(BASELINE_PATH) as f:
+            baseline = json.load(f).get("cpu_img_per_sec")
+    if not baseline:
+        cpu_step = protocol_step_time(cpu)
+        baseline = BATCH / cpu_step
+        with open(BASELINE_PATH, "w") as f:
+            json.dump({
+                "cpu_img_per_sec": baseline,
+                "note": "three-graph protocol step on host CPU, batch 200 "
+                        "(stand-in for the reference's nd4j-native CPU run)",
+            }, f, indent=1)
+
+    if default.platform == "cpu":
+        value = baseline
+    else:
+        value = BATCH / protocol_step_time(default)
+
+    print(json.dumps({
+        "metric": "dcgan_mnist_img_per_sec",
+        "value": round(value, 2),
+        "unit": "img/sec/chip",
+        "vs_baseline": round(value / baseline, 3),
+    }))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

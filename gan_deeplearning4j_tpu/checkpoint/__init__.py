@@ -1,0 +1,19 @@
+"""Checkpoint / resume — closing the reference's save-only gap.
+
+The reference persists its four models ONCE, at the end of the run
+(``ModelSerializer.writeModel(..., saveUpdater=true)``,
+dl4jGANComputerVision.java:529-533) and has no restore path at all
+(SURVEY.md §5).  This module adds periodic multi-graph training-state
+checkpoints with pruning and resume: all graphs' params + updater state
+(via graph/serialization.py), the step counter, and arbitrary extra state
+(e.g. the pre-loop softened-label noise, which is part of run state
+because the reference samples it once — SURVEY.md appendix).
+
+Layout: ``{dir}/ckpt_{step}/`` with one model zip per graph plus
+``state.json`` / ``state.npz``; written to a temp dir and atomically
+renamed, so a killed run never leaves a half checkpoint.
+"""
+
+from gan_deeplearning4j_tpu.checkpoint.checkpointer import TrainCheckpointer
+
+__all__ = ["TrainCheckpointer"]

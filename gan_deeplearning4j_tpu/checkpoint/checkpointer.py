@@ -1,0 +1,103 @@
+"""Multi-graph training-state checkpointer (see package docstring)."""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import shutil
+import tempfile
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+from gan_deeplearning4j_tpu.graph import serialization
+
+
+class TrainCheckpointer:
+    def __init__(self, directory: str, keep: int = 3):
+        self.directory = directory
+        self.keep = keep
+        os.makedirs(directory, exist_ok=True)
+
+    # -- save ----------------------------------------------------------------
+
+    def save(self, step: int, graphs: Dict[str, object],
+             extra: Optional[Dict] = None) -> str:
+        """Write ``ckpt_{step}`` atomically; prune beyond ``keep``."""
+        final = os.path.join(self.directory, f"ckpt_{step}")
+        tmp = tempfile.mkdtemp(prefix=".ckpt_tmp_", dir=self.directory)
+        try:
+            for name, graph in graphs.items():
+                serialization.write_model(
+                    graph, os.path.join(tmp, f"{name}_model.zip"), save_updater=True
+                )
+            arrays = {}
+            scalars = {"step": step, "graphs": sorted(graphs.keys())}
+            for k, v in (extra or {}).items():
+                if isinstance(v, (int, float, str, bool)) or v is None:
+                    scalars[k] = v
+                else:
+                    arrays[k] = np.asarray(v)
+            with open(os.path.join(tmp, "state.json"), "w") as f:
+                json.dump(scalars, f, indent=1)
+            if arrays:
+                np.savez(os.path.join(tmp, "state.npz"), **arrays)
+            if os.path.exists(final):
+                shutil.rmtree(final)
+            os.rename(tmp, final)
+        except BaseException:
+            shutil.rmtree(tmp, ignore_errors=True)
+            raise
+        self._prune()
+        return final
+
+    def _prune(self) -> None:
+        steps = self.steps()
+        for s in steps[: max(0, len(steps) - self.keep)]:
+            shutil.rmtree(os.path.join(self.directory, f"ckpt_{s}"), ignore_errors=True)
+
+    # -- restore -------------------------------------------------------------
+
+    def steps(self) -> list:
+        out = []
+        for name in os.listdir(self.directory):
+            m = re.fullmatch(r"ckpt_(\d+)", name)
+            if m:
+                out.append(int(m.group(1)))
+        return sorted(out)
+
+    def latest_step(self) -> Optional[int]:
+        steps = self.steps()
+        return steps[-1] if steps else None
+
+    def restore(
+        self, graphs: Dict[str, object], step: Optional[int] = None
+    ) -> Tuple[int, Dict]:
+        """Load params + updater state into the given graphs (in place) from
+        ``ckpt_{step}`` (default: latest).  Returns (step, extra)."""
+        if step is None:
+            step = self.latest_step()
+            if step is None:
+                raise FileNotFoundError(f"no checkpoints in {self.directory}")
+        path = os.path.join(self.directory, f"ckpt_{step}")
+        with open(os.path.join(path, "state.json")) as f:
+            scalars = json.load(f)
+        # Validate BOTH directions before mutating anything, so a mismatch
+        # never leaves the caller with a half-restored graph set.
+        saved, supplied = set(scalars["graphs"]), set(graphs.keys())
+        if saved != supplied:
+            raise ValueError(
+                f"checkpoint graphs {sorted(saved)} != supplied {sorted(supplied)}"
+            )
+        for name, graph in graphs.items():
+            loaded = serialization.read_model(os.path.join(path, f"{name}_model.zip"))
+            graph.params = loaded.params
+            graph.opt_state = loaded.opt_state
+        extra = {k: v for k, v in scalars.items() if k not in ("step", "graphs")}
+        npz_path = os.path.join(path, "state.npz")
+        if os.path.exists(npz_path):
+            with np.load(npz_path) as z:
+                for k in z.files:
+                    extra[k] = z[k]
+        return scalars["step"], extra

@@ -15,10 +15,11 @@ Semantics matched:
   - ``num_classes >= 2`` -> one-hot labels (CV: ``numClasses=10``);
     ``num_classes == 1`` -> raw single-column label (insurance)
   - ``has_next``/``next``/``reset`` wraparound protocol
-    (dl4jGANComputerVision.java:387,524-526): a partial final batch is
-    DROPPED by default (the reference's loop sizes make batches exact);
+    (dl4jGANComputerVision.java:387,524-526): a partial final batch IS
+    served, like DL4J (the insurance test sweep depends on it — 300 test
+    rows iterated with ``batchSizePred=700``, dl4jGANInsurance.java:59);
     pass ``strict=True`` to raise at construction when the row count is
-    not a multiple of the batch size
+    not a multiple of the batch size (train loops want exact batches)
 """
 
 from __future__ import annotations
@@ -136,18 +137,19 @@ class RecordReaderDataSetIterator:
         return self._features.shape[0]
 
     def has_next(self) -> bool:
-        return self._cursor + self.batch_size <= self._features.shape[0]
+        return self._cursor < self._features.shape[0]
 
     def next(self) -> DataSet:
         if not self.has_next():
             raise StopIteration
-        lo, hi = self._cursor, self._cursor + self.batch_size
+        lo = self._cursor
+        hi = min(lo + self.batch_size, self._features.shape[0])
         self._cursor = hi
         feats = self._features[lo:hi]
         labels = (
             self._labels[lo:hi]
             if self._labels is not None
-            else np.zeros((self.batch_size, 0), dtype=feats.dtype)
+            else np.zeros((hi - lo, 0), dtype=feats.dtype)
         )
         return DataSet(feats, labels)
 

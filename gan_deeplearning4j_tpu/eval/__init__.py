@@ -1,0 +1,23 @@
+"""Evaluation — the notebook's scoring cells as code (SURVEY.md §3.5).
+
+The reference's evaluation lives in ``Python/gan.ipynb``: cell 7 recomputes
+MNIST classification accuracy from the Java-dumped prediction CSVs (raw
+lines 925-955) and cell 10 computes the insurance weighted AUROC plus the
+latent-grid lattice renderings (raw lines 1483-1516).
+"""
+
+from gan_deeplearning4j_tpu.eval.metrics import (
+    accuracy_from_predictions,
+    auroc_from_predictions,
+    grid_to_lattices,
+    mnist_accuracy,
+    insurance_auroc,
+)
+
+__all__ = [
+    "accuracy_from_predictions",
+    "auroc_from_predictions",
+    "grid_to_lattices",
+    "mnist_accuracy",
+    "insurance_auroc",
+]

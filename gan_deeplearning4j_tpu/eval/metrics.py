@@ -1,0 +1,55 @@
+"""Metric computations matching the notebook's scoring cells.
+
+``gan.ipynb`` cell 7 (raw lines 925-955): read the test CSV's label column
+and the trainer's ``mnist_test_predictions_{k}.csv``, take argmax over the
+10 softmax columns, compare — the published 97.07% accuracy.  Cell 10
+(raw lines 1483-1516): ``sklearn.metrics.roc_auc_score(y, p,
+average="weighted")`` over ``insurance_test_predictions_{k}.csv`` — the
+published 91.63% AUROC.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from gan_deeplearning4j_tpu.data import read_csv_matrix
+
+
+def accuracy_from_predictions(predictions: np.ndarray, labels: np.ndarray) -> float:
+    """argmax-match accuracy; ``predictions`` [N, C] scores, ``labels`` [N]."""
+    pred = np.asarray(predictions).argmax(axis=1)
+    return float((pred == np.asarray(labels).astype(np.int64)).mean())
+
+
+def auroc_from_predictions(scores: np.ndarray, labels: np.ndarray,
+                           average: str = "weighted") -> float:
+    """Weighted AUROC, the notebook's exact call (cell 10)."""
+    from sklearn.metrics import roc_auc_score
+
+    return float(roc_auc_score(np.asarray(labels).astype(np.int64),
+                               np.asarray(scores).ravel(), average=average))
+
+
+def mnist_accuracy(predictions_csv: str, test_csv: str,
+                   label_index: int = 784) -> float:
+    preds = read_csv_matrix(predictions_csv)
+    labels = read_csv_matrix(test_csv)[:, label_index]
+    return accuracy_from_predictions(preds, labels)
+
+
+def insurance_auroc(predictions_csv: str, test_csv: str,
+                    label_index: int = 12) -> float:
+    scores = read_csv_matrix(predictions_csv)
+    labels = read_csv_matrix(test_csv)[:, label_index]
+    return auroc_from_predictions(scores, labels)
+
+
+def grid_to_lattices(grid_csv_or_array, rows: int, cols: int) -> np.ndarray:
+    """Reshape a latent-grid dump [n^2, rows*cols] into [n^2, rows, cols]
+    lattices (the notebook's plotting layout for 4x3 transaction lattices
+    and 28x28 digit grids)."""
+    arr = (
+        read_csv_matrix(grid_csv_or_array)
+        if isinstance(grid_csv_or_array, str) else np.asarray(grid_csv_or_array)
+    )
+    return arr.reshape(arr.shape[0], rows, cols)

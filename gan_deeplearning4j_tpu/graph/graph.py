@@ -257,11 +257,13 @@ class ComputationGraph:
 
     # -- forward ------------------------------------------------------------
 
-    def _forward(self, params, inputs: Dict[str, jax.Array], train: bool, rng):
+    def _forward(self, params, inputs: Dict[str, jax.Array], train: bool, rng,
+                 axis_name: Optional[str] = None):
         """Pure forward over the DAG in insertion (topological) order.
 
         Returns (values, state_updates): all node outputs by name, plus BN
-        running-stat updates produced by train-mode layers.
+        running-stat updates produced by train-mode layers.  ``axis_name``
+        enables cross-replica sync-BN under shard_map (see ops/batchnorm.py).
         """
         values: Dict[str, jax.Array] = {}
         for inp in self.input_names:
@@ -281,7 +283,8 @@ class ComputationGraph:
                     x = node.preprocessor(x)
             layer_train = train and name not in self.frozen
             layer_rng = prng.stream(rng, name) if rng is not None else None
-            y, upd = node.layer.apply(params[name], x, layer_train, layer_rng)
+            y, upd = node.layer.apply(params[name], x, layer_train, layer_rng,
+                                      axis_name=axis_name)
             if upd:
                 state_updates[name] = upd
             values[name] = y
@@ -313,12 +316,14 @@ class ComputationGraph:
             total = total + loss_lib.get(loss_name)(outputs[name], labels[name])
         return total
 
-    def _train_step(self, params, opt_state, rng, inputs, labels, reduce=None):
+    def _train_step(self, params, opt_state, rng, inputs, labels, reduce=None,
+                    axis_name=None):
         """One optimization step.  ``reduce`` is the cross-replica hook the
         distributed layer injects (pmean of loss/BN-stats/grads inside
-        shard_map) so single-device and DP steps share one source of truth."""
+        shard_map) so single-device and DP steps share one source of truth;
+        ``axis_name`` additionally makes BN use global-batch stats (sync-BN)."""
         def loss_fn(p):
-            values, state_updates = self._forward(p, inputs, True, rng)
+            values, state_updates = self._forward(p, inputs, True, rng, axis_name)
             outputs = {n: values[n] for n in self.output_names}
             return self._loss(outputs, labels), state_updates
 

@@ -92,7 +92,7 @@ class Layer:
     def init(self, key: jax.Array, in_shape: Shape) -> Params:
         return {}
 
-    def apply(self, params: Params, x, train: bool, rng):
+    def apply(self, params: Params, x, train: bool, rng, axis_name=None):
         raise NotImplementedError
 
 
@@ -116,7 +116,7 @@ class Dense(Layer):
             w = initializers.xavier_uniform(k_w, (n_in, self.n_out), n_in, self.n_out)
         return {"W": w, "b": initializers.zeros((self.n_out,))}
 
-    def apply(self, params, x, train, rng):
+    def apply(self, params, x, train, rng, axis_name=None):
         x = _as_ff(x)
         return self._act(dense_op(x, params["W"], params["b"], bf16=self.bf16_matmul)), None
 
@@ -158,7 +158,7 @@ class Conv2D(Layer):
         w = initializers.xavier(k_w, (self.n_out, n_in, kh, kw), fan_in, fan_out)
         return {"W": w, "b": initializers.zeros((self.n_out,))}
 
-    def apply(self, params, x, train, rng):
+    def apply(self, params, x, train, rng, axis_name=None):
         y = conv2d(x, params["W"], params["b"], self.stride, self.padding)
         return self._act(y), None
 
@@ -193,7 +193,7 @@ class ConvTranspose2D(Layer):
         w = initializers.xavier(k_w, (self.n_out, n_in, kh, kw), fan_in, fan_out)
         return {"W": w, "b": initializers.zeros((self.n_out,))}
 
-    def apply(self, params, x, train, rng):
+    def apply(self, params, x, train, rng, axis_name=None):
         y = conv_transpose2d(x, params["W"], params["b"], self.stride, self.padding)
         return self._act(y), None
 
@@ -216,7 +216,7 @@ class MaxPool2D(Layer):
         sh, sw = self.stride
         return (c, (h - kh) // sh + 1, (w - kw) // sw + 1)
 
-    def apply(self, params, x, train, rng):
+    def apply(self, params, x, train, rng, axis_name=None):
         return max_pool2d(x, self.kernel, self.stride), None
 
 
@@ -234,7 +234,7 @@ class Upsampling2D(Layer):
         c, h, w = in_shape
         return (c, h * self.size, w * self.size)
 
-    def apply(self, params, x, train, rng):
+    def apply(self, params, x, train, rng, axis_name=None):
         return upsample2d(x, self.size), None
 
 
@@ -266,11 +266,11 @@ class BatchNorm(Layer):
             "var": initializers.ones((n,)),
         }
 
-    def apply(self, params, x, train, rng):
+    def apply(self, params, x, train, rng, axis_name=None):
         if train:
             y, new_mean, new_var = batch_norm_train(
                 x, params["gamma"], params["beta"], params["mean"], params["var"],
-                self.decay, self.eps,
+                self.decay, self.eps, axis_name=axis_name,
             )
             return self._act(y), {"mean": new_mean, "var": new_var}
         y = batch_norm_inference(
@@ -294,7 +294,7 @@ class Dropout(Layer):
     def out_shape(self, in_shape):
         return in_shape
 
-    def apply(self, params, x, train, rng):
+    def apply(self, params, x, train, rng, axis_name=None):
         return dropout_op(x, self.rate, rng, train), None
 
 
@@ -314,7 +314,7 @@ class Merge(Layer):
         total = sum(s[0] for s in shapes)
         return (total,) + tuple(first[1:])
 
-    def apply(self, params, xs, train, rng):
+    def apply(self, params, xs, train, rng, axis_name=None):
         axis = 1 if xs[0].ndim > 1 else 0
         return jnp.concatenate(xs, axis=axis), None
 

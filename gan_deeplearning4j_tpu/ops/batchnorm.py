@@ -49,11 +49,25 @@ def batch_norm_train(
     running_var: jax.Array,
     decay: float = DEFAULT_DECAY,
     eps: float = DEFAULT_EPS,
+    axis_name: str | None = None,
 ):
-    """Returns (out, new_running_mean, new_running_var)."""
+    """Returns (out, new_running_mean, new_running_var).
+
+    ``axis_name``: cross-replica sync-BN.  Inside ``shard_map`` the batch
+    stats become GLOBAL-batch stats (E and E[x^2] pmean-ed over the mesh
+    axis), making a data-parallel step bitwise-equivalent to the
+    single-device full-batch step — including the between-shard-means
+    variance term a naive per-shard pmean would drop.  None = local batch
+    stats (single device, and the DL4J param-averaging fidelity mode,
+    whose Spark workers each used local stats).
+    """
     axes = _reduce_axes(x)
     mean = jnp.mean(x, axis=axes)
-    var = jnp.var(x, axis=axes)
+    m2 = jnp.mean(jnp.square(x), axis=axes)
+    if axis_name is not None:
+        mean = jax.lax.pmean(mean, axis_name)
+        m2 = jax.lax.pmean(m2, axis_name)
+    var = m2 - jnp.square(mean)
     out = (x - _shaped(mean, x)) * jax.lax.rsqrt(_shaped(var, x) + eps)
     out = out * _shaped(gamma, x) + _shaped(beta, x)
     new_mean = decay * running_mean + (1.0 - decay) * mean

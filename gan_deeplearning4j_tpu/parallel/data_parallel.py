@@ -96,10 +96,13 @@ class DataParallelGraph:
         def step(params, opt_state, rng, inputs, labels):
             # Per-replica stream: dropout masks must be independent across
             # shards (exact single-device equivalence still holds for
-            # deterministic graphs; with dropout the masks differ from the
-            # single-device draw either way).
+            # dropout-free graphs; with dropout the masks differ from the
+            # single-device draw either way).  axis_name turns on sync-BN:
+            # batch stats are global-batch stats, so BN graphs keep the
+            # exact single-device equivalence too (ops/batchnorm.py).
             rng = prng.fold_in_index(rng, lax.axis_index(axis))
-            return graph._train_step(params, opt_state, rng, inputs, labels, reduce)
+            return graph._train_step(params, opt_state, rng, inputs, labels,
+                                     reduce, axis_name=axis)
 
         return jax.jit(shard_map(
             step,

@@ -1,0 +1,64 @@
+"""Multi-host mesh setup — the DCN tier of the communication backend.
+
+The reference scales out with Spark executors + Aeron UDP between JVMs
+(SURVEY.md §2c "Communication backend").  The TPU-native equivalent is
+``jax.distributed``: one process per host, XLA runs collectives over ICI
+within a slice and DCN across slices — no user-visible transport or
+serialization layer.
+
+On a single host (this environment, and any test rig) everything is a
+no-op passthrough: the same mesh-building code serves 1 host or N.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+from gan_deeplearning4j_tpu.parallel.mesh import make_mesh
+
+
+def initialize(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> None:
+    """Join the multi-host job.  With no arguments, uses the standard env
+    (JAX_COORDINATOR_ADDRESS etc.) and is a no-op on a single host."""
+    if coordinator_address is None and "JAX_COORDINATOR_ADDRESS" not in os.environ:
+        return  # single host
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+
+
+def global_mesh(shape: Dict[str, int],
+                dcn_axis: Optional[str] = None) -> Mesh:
+    """Mesh over ALL processes' devices.  If ``dcn_axis`` names an axis, it
+    is laid out across hosts (slices) so only that axis's collectives ride
+    DCN; every other axis stays within a slice on ICI — the layout rule
+    that keeps the bandwidth-hungry collectives on the fast interconnect."""
+    devices = jax.devices()  # all processes' devices, host-major order
+    if dcn_axis is None:
+        return make_mesh(shape, devices=devices)
+    if dcn_axis not in shape:
+        raise ValueError(f"dcn_axis {dcn_axis!r} not in mesh shape {shape}")
+    # host-major order: put the DCN axis outermost so host boundaries fall
+    # on that axis's partitions
+    ordered = {dcn_axis: shape[dcn_axis]}
+    ordered.update({k: v for k, v in shape.items() if k != dcn_axis})
+    mesh = make_mesh(ordered, devices=devices)
+    # reorder axes back to caller's order
+    names = tuple(shape.keys())
+    arr = np.moveaxis(
+        mesh.devices,
+        [list(ordered).index(n) for n in names],
+        range(len(names)),
+    )
+    return Mesh(arr, names)

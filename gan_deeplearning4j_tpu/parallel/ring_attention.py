@@ -1,0 +1,120 @@
+"""Ring attention — sequence/context parallelism over a mesh axis.
+
+The reference has no attention or sequence axis at all (SURVEY.md §2c/§5:
+conv/MLP GANs only), but long-context support is first-class in this
+framework's scope: when a model family with attention lands (roadmap), it
+must scale past single-chip HBM by sharding the SEQUENCE dimension.
+
+Design (Ring Attention with online softmax, a la Liu et al. 2023, built
+from XLA collectives — no torch/NCCL translation):
+
+  - every device holds a sequence shard of Q, K, V: [B, H, T/R, D] under
+    ``shard_map`` over the ``seq`` mesh axis (R = ring size)
+  - R unrolled steps: compute the local Q-shard x current KV-block partial
+    attention with a numerically-stable ONLINE softmax (running max m,
+    denominator l, numerator o — flash-attention's streaming form, which
+    is what makes block-wise accumulation exact, not approximate), then
+    rotate the KV block one hop around the ring via ``lax.ppermute``
+  - compute and ICI transfer overlap: XLA schedules the ppermute of the
+    next block against the matmuls of the current one
+  - causal masking uses global position offsets reconstructed from
+    ``lax.axis_index`` and the (static) step number, so masks stay
+    shard-local and the ring needs no extra communication
+
+Peak memory per device is O(T/R * T/R) for one score block instead of
+O(T^2) — sequence length scales linearly with ring size.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax, shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+
+def attention(q: jax.Array, k: jax.Array, v: jax.Array,
+              causal: bool = False) -> jax.Array:
+    """Vanilla scaled-dot-product attention, [B, H, T, D] — the single
+    -device reference that ring_attention must match exactly."""
+    scale = 1.0 / np.sqrt(q.shape[-1])
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
+    if causal:
+        tq, tk = s.shape[-2], s.shape[-1]
+        mask = jnp.tril(jnp.ones((tq, tk), dtype=bool))
+        s = jnp.where(mask, s, -jnp.inf)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bhkd->bhqd", p, v)
+
+
+def _online_block(q, k, v, m, l, o, scale, mask):
+    """One KV-block accumulation step of the streaming softmax."""
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
+    if mask is not None:
+        s = jnp.where(mask, s, -jnp.inf)
+    m_new = jnp.maximum(m, s.max(axis=-1))
+    # exp(-inf - -inf) guard: fully-masked rows keep m = -inf, p = 0
+    safe_m = jnp.where(jnp.isneginf(m_new), 0.0, m_new)
+    p = jnp.exp(s - safe_m[..., None])
+    if mask is not None:
+        p = jnp.where(mask, p, 0.0)
+    corr = jnp.exp(jnp.where(jnp.isneginf(m), 0.0, m) - safe_m)
+    corr = jnp.where(jnp.isneginf(m), 0.0, corr)
+    l_new = l * corr + p.sum(axis=-1)
+    o_new = o * corr[..., None] + jnp.einsum("bhqk,bhkd->bhqd", p, v)
+    return m_new, l_new, o_new
+
+
+def ring_attention_sharded(q, k, v, axis_name: str, causal: bool = False,
+                           ring_size: Optional[int] = None):
+    """The shard-local body: call inside ``shard_map`` with q/k/v sequence
+    -sharded over ``axis_name``.  Shapes [B, H, T_local, D]."""
+    R = ring_size if ring_size is not None else lax.axis_size(axis_name)
+    scale = 1.0 / np.sqrt(q.shape[-1])
+    t_local = q.shape[2]
+    my_idx = lax.axis_index(axis_name)
+
+    m = jnp.full(q.shape[:-1], -jnp.inf, dtype=q.dtype)
+    l = jnp.zeros(q.shape[:-1], dtype=q.dtype)
+    o = jnp.zeros_like(q)
+
+    perm = [(i, (i + 1) % R) for i in range(R)]
+    q_pos = my_idx * t_local + jnp.arange(t_local)          # global Q positions
+
+    for step in range(R):  # static unroll: masks differ per step
+        kv_idx = (my_idx - step) % R                        # block's origin
+        if causal:
+            k_pos = kv_idx * t_local + jnp.arange(t_local)  # global K positions
+            mask = q_pos[:, None] >= k_pos[None, :]         # [Tq, Tk]
+            mask = mask[None, None]                         # broadcast B, H
+        else:
+            mask = None
+        m, l, o = _online_block(q, k, v, m, l, o, scale, mask)
+        if step + 1 < R:
+            k = lax.ppermute(k, axis_name, perm)
+            v = lax.ppermute(v, axis_name, perm)
+
+    return o / jnp.where(l == 0.0, 1.0, l)[..., None]
+
+
+def ring_attention(q, k, v, mesh: Mesh, axis: str = "seq",
+                   causal: bool = False) -> jax.Array:
+    """Host-level entry: shards [B, H, T, D] over ``axis`` and runs the
+    ring.  T must be divisible by the ring size."""
+    R = mesh.shape[axis]
+    if q.shape[2] % R != 0:
+        raise ValueError(f"sequence length {q.shape[2]} not divisible by ring {R}")
+    spec = P(None, None, axis, None)
+    f = shard_map(
+        partial(ring_attention_sharded, axis_name=axis, causal=causal,
+                ring_size=R),
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+        check_vma=False,
+    )
+    return f(q, k, v)

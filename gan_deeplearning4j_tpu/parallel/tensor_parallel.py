@@ -1,0 +1,70 @@
+"""Tensor parallelism — layer-sharding helpers over a ``model`` mesh axis.
+
+Absent from the reference (SURVEY.md §2c: "TP: ABSENT — not required for
+parity; pjit sharding makes it nearly free if added").  Provided as
+first-class framework capability: the canonical Megatron-style pattern
+with XLA collectives, for model families whose dense layers outgrow one
+chip's HBM.
+
+  - column-parallel: W split on the OUTPUT dim; each device computes a
+    slice of the activations (no communication; activations stay sharded)
+  - row-parallel: W split on the INPUT dim over already-sharded
+    activations; one ``psum`` over ICI completes the contraction
+  - the pair (column -> nonlinearity -> row) costs ONE all-reduce per MLP
+    block — the scaling-book recipe
+
+These are shard-local bodies for ``shard_map``; ``tp_dense_pair`` is the
+host-level convenience wrapper.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax, shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+
+def column_parallel_dense(x, w_shard, b_shard):
+    """x: [B, F] replicated; w_shard: [F, H/R]; -> [B, H/R] sharded out."""
+    return x @ w_shard + b_shard
+
+
+def row_parallel_dense(x_shard, w_shard, b, axis_name: str):
+    """x_shard: [B, H/R]; w_shard: [H/R, F]; psum completes the matmul.
+    Bias is added AFTER the reduce (it is replicated, not sharded)."""
+    partial_out = x_shard @ w_shard
+    return lax.psum(partial_out, axis_name) + b
+
+
+def tp_dense_pair(
+    x: jax.Array,
+    w1: jax.Array, b1: jax.Array,
+    w2: jax.Array, b2: jax.Array,
+    mesh: Mesh,
+    axis: str = "model",
+    activation: Optional[Callable] = jnp.tanh,
+) -> jax.Array:
+    """Megatron MLP block: [B,F] -> column-parallel [B,H/R] -> activation
+    -> row-parallel + psum -> [B,F].  One ICI all-reduce total."""
+    if w1.shape[1] % mesh.shape[axis] != 0:
+        raise ValueError(
+            f"hidden dim {w1.shape[1]} not divisible by TP degree {mesh.shape[axis]}"
+        )
+
+    def body(x, w1, b1, w2, b2):
+        h = column_parallel_dense(x, w1, b1)
+        if activation is not None:
+            h = activation(h)
+        return row_parallel_dense(h, w2, b2, axis)
+
+    return shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(P(), P(None, axis), P(axis), P(axis, None), P()),
+        out_specs=P(),
+        check_vma=False,
+    )(x, w1, b1, w2, b2)

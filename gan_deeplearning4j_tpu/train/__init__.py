@@ -1,0 +1,11 @@
+"""Trainers — the reference's two application mains (SURVEY.md §1 L7)
+re-built on the framework: the three-graph GAN protocol engine plus the
+CV DCGAN and insurance MLP-GAN entry points."""
+
+from gan_deeplearning4j_tpu.train.gan_trainer import (
+    GANTrainer,
+    GANTrainerConfig,
+    Workload,
+)
+
+__all__ = ["GANTrainer", "GANTrainerConfig", "Workload"]

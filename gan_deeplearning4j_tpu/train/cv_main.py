@@ -1,0 +1,107 @@
+"""CV DCGAN-on-MNIST trainer — ``dl4jGANComputerVision`` equivalent.
+
+Reference: ``Java/src/main/java/org/deeplearning4j/dl4jGANComputerVision.java``
+(protocol :387-527, constants :59-85).  The reference's hardcoded constants
+become CLI flags with identical defaults; ``useGpu``/CUDA setup becomes
+device selection owned by JAX/PJRT; Spark ``local[4]`` becomes a device
+mesh (SURVEY.md §5 "Config / flag system").
+
+Run: ``python -m gan_deeplearning4j_tpu.train.cv_main --iterations 10000``
+"""
+
+from __future__ import annotations
+
+import argparse
+from typing import Dict
+
+from gan_deeplearning4j_tpu.data import ensure_mnist_csv
+from gan_deeplearning4j_tpu.models import dcgan_mnist as M
+from gan_deeplearning4j_tpu.train.gan_trainer import (
+    GANTrainer,
+    GANTrainerConfig,
+    Workload,
+)
+
+
+class CVWorkload(Workload):
+    name = "mnist"
+    classifier_model_name = "CV"
+
+    def __init__(self, cfg: M.CVConfig = M.CVConfig(),
+                 n_train: int = 60000, n_test: int = 10000):
+        self.cfg = cfg
+        self.n_train = n_train
+        self.n_test = n_test
+        self.dis_to_gan = M.DIS_TO_GAN
+        self.gan_to_gen = M.GAN_TO_GEN
+        self.dis_to_classifier = M.DIS_TO_CLASSIFIER
+
+    def build_graphs(self) -> Dict[str, object]:
+        dis = M.build_discriminator(self.cfg)
+        return {
+            "dis": dis,
+            "gen": M.build_generator(self.cfg),
+            "gan": M.build_gan(self.cfg),
+            "classifier": M.build_classifier(dis, self.cfg),
+        }
+
+    def ensure_data(self, res_path: str):
+        return ensure_mnist_csv(res_path, self.n_train, self.n_test)
+
+    def grid_extra_dump(self, trainer, grid_out, step):
+        pass  # the CV main dumps only the grid itself
+
+
+def default_config(**overrides) -> GANTrainerConfig:
+    base = dict(
+        dataset_name="mnist",
+        num_features=784,
+        label_index=784,
+        num_classes=10,
+        batch_size=200,
+        batch_size_pred=500,
+        num_iterations=10000,
+        num_gen_samples=10,
+    )
+    base.update(overrides)
+    return GANTrainerConfig(**base)
+
+
+def main(argv=None) -> Dict[str, float]:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--iterations", type=int, default=10000)
+    p.add_argument("--batch-size", type=int, default=200)
+    p.add_argument("--res-path", default="outputs/computer_vision")
+    p.add_argument("--print-every", type=int, default=100)
+    p.add_argument("--save-every", type=int, default=100)
+    p.add_argument("--n-devices", type=int, default=None)
+    p.add_argument("--dp-mode", default="gradient_sync",
+                   choices=["gradient_sync", "param_averaging"])
+    p.add_argument("--averaging-frequency", type=int, default=10)
+    p.add_argument("--checkpoint-every", type=int, default=0)
+    p.add_argument("--resume", action="store_true")
+    p.add_argument("--n-train", type=int, default=60000)
+    p.add_argument("--n-test", type=int, default=10000)
+    args = p.parse_args(argv)
+
+    config = default_config(
+        num_iterations=args.iterations,
+        batch_size=args.batch_size,
+        res_path=args.res_path,
+        print_every=args.print_every,
+        save_every=args.save_every,
+        n_devices=args.n_devices,
+        dp_mode=args.dp_mode,
+        averaging_frequency=args.averaging_frequency,
+        checkpoint_every=args.checkpoint_every,
+        resume=args.resume,
+    )
+    trainer = GANTrainer(CVWorkload(n_train=args.n_train, n_test=args.n_test),
+                         config)
+    result = trainer.train()
+    print(result)
+    return result
+
+
+if __name__ == "__main__":
+    main()

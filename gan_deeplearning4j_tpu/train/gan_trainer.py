@@ -1,0 +1,337 @@
+"""The three-graph GAN training protocol — the reference's mains as an engine.
+
+Reproduces the loop of SURVEY.md §3.2 (dl4jGANComputerVision.java:387-527 /
+dl4jGANInsurance.java:329-469) for any workload that supplies the four
+graphs and their weight-sync maps:
+
+  per iteration:
+    1. D-step: fit dis on [real batch (labels 1+eps), generated batch
+       (labels 0+eps)] — label-softening noise sampled ONCE before the
+       loop and reused (reference quirk, :384-385)
+    2. copy all dis weights + BN stats into the gan graph's frozen tail
+    3. G-step: fit the stacked gan on z ~ U[-1,1]^z labeled "real"
+    4. copy the gan graph's generator weights back into the standalone gen
+    5. copy dis feature weights into the classifier, fit it on the real
+       labeled batch
+    6. every print_every: dump the latent-grid synthesis CSV (+ workload
+       extras); every save_every: dump test-set prediction CSV
+    7. wrap the data iterator on exhaustion (multi-epoch)
+
+Differences from the reference, on purpose (documented, SURVEY.md §7):
+  - every network optionally trains data-parallel over a Mesh
+    (gradient-sync all-reduce or DL4J param-averaging fidelity mode)
+    instead of Spark jobs with per-iteration RDD serialization
+  - the D-step's two minibatches are fed as ONE concatenated batch; under
+    ``dp_mode="param_averaging"`` with 2 replicas this is bitwise the
+    reference's [real-partition, fake-partition] Spark job layout
+  - periodic training-state checkpoints with resume (reference gap)
+  - structured per-step metrics (D/G/classifier loss, examples/sec)
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Callable, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from gan_deeplearning4j_tpu.checkpoint import TrainCheckpointer
+from gan_deeplearning4j_tpu.data import (
+    RecordReaderDataSetIterator,
+    write_csv_matrix,
+)
+from gan_deeplearning4j_tpu.graph import serialization
+from gan_deeplearning4j_tpu.parallel import DataParallelGraph, data_mesh
+from gan_deeplearning4j_tpu.runtime import prng
+from gan_deeplearning4j_tpu.utils import MetricsLogger
+
+
+@dataclasses.dataclass
+class GANTrainerConfig:
+    """The reference's constants block as a config
+    (dl4jGANComputerVision.java:59-85; dl4jGANInsurance.java:58-84)."""
+
+    dataset_name: str
+    num_features: int
+    label_index: int
+    num_classes: int            # classifier label width (10 CV, 1 insurance)
+    batch_size: int             # batchSizePerWorker
+    batch_size_pred: int        # batchSizePred
+    num_iterations: int
+    num_gen_samples: int        # latent grid edge -> n^2 samples
+    z_size: int = 2
+    print_every: int = 100
+    save_every: int = 100
+    seed: int = prng.NUMBER_OF_THE_BEAST
+    res_path: str = "outputs"   # a flag, not a hardcoded absolute path
+    # -- distribution (replaces useGpu/Spark local[4]) --
+    n_devices: Optional[int] = None   # None = all attached; 1 = no mesh
+    dp_mode: str = "gradient_sync"
+    averaging_frequency: int = 1
+    # -- new capabilities over the reference --
+    checkpoint_every: int = 0         # 0 = end-of-run models only
+    checkpoint_keep: int = 3
+    resume: bool = False
+    metrics: bool = True
+
+
+class Workload:
+    """What a model family must supply (models/dcgan_mnist.py and
+    models/mlpgan_insurance.py both do)."""
+
+    name: str
+    classifier_model_name: str  # "CV" / "insurance" in the final zip names
+
+    def build_graphs(self) -> Dict[str, object]:
+        raise NotImplementedError
+
+    # weight-sync maps: lists of (dst_layer, src_layer, param_names)
+    dis_to_gan: list
+    gan_to_gen: list
+    dis_to_classifier: list
+
+    def ensure_data(self, res_path: str):
+        """Return (train_csv, test_csv)."""
+        raise NotImplementedError
+
+    def grid_extra_dump(self, trainer: "GANTrainer", grid_out: np.ndarray,
+                        step: int) -> None:
+        """Workload-specific extra artifact at print_every (the insurance
+        main dumps classifier predictions over the generated grid,
+        dl4jGANInsurance.java:422-437)."""
+
+
+def sync_params(dst, src, mapping) -> None:
+    for dst_layer, src_layer, names in mapping:
+        dst.set_layer_params(
+            dst_layer, {n: src.get_param(src_layer, n) for n in names}
+        )
+
+
+class GANTrainer:
+    def __init__(self, workload: Workload, config: GANTrainerConfig):
+        self.w = workload
+        self.c = config
+        os.makedirs(config.res_path, exist_ok=True)
+
+        graphs = workload.build_graphs()
+        self.dis = graphs["dis"]
+        self.gen = graphs["gen"]
+        self.gan = graphs["gan"]
+        self.classifier = graphs["classifier"]
+
+        # Distribution: fit() through DataParallelGraph when a mesh is used;
+        # gen stays local (it only ever runs inference on the driver).
+        # The mesh size must divide every fitted batch (B and the D-step's
+        # 2B), so auto-selection picks the largest divisor of B that fits
+        # the attached devices (the reference's local[4] with batch 50 has
+        # the same constraint, satisfied as 50 = 4*12+2 only because DL4J
+        # pads partitions; we keep shards exact instead).
+        if config.n_devices is None:
+            avail = len(jax.devices())
+            config.n_devices = max(
+                d for d in range(1, avail + 1) if config.batch_size % d == 0
+            )
+        if config.n_devices == 1:
+            self._fit_dis = self.dis.fit
+            self._fit_gan = self.gan.fit
+            self._fit_clf = self.classifier.fit
+        else:
+            mesh = data_mesh(config.n_devices)
+            kw = dict(mesh=mesh, mode=config.dp_mode,
+                      averaging_frequency=config.averaging_frequency)
+            self.spark_dis = DataParallelGraph(self.dis, **kw)
+            self.spark_gan = DataParallelGraph(self.gan, **kw)
+            self.spark_clf = DataParallelGraph(self.classifier, **kw)
+            self._fit_dis = self.spark_dis.fit
+            self._fit_gan = self.spark_gan.fit
+            self._fit_clf = self.spark_clf.fit
+
+        self.metrics = MetricsLogger(
+            os.path.join(config.res_path, f"{config.dataset_name}_metrics.jsonl")
+            if config.metrics else None
+        )
+        self.checkpointer = (
+            TrainCheckpointer(
+                os.path.join(config.res_path, "checkpoints"),
+                keep=config.checkpoint_keep,
+            )
+            if config.checkpoint_every else None
+        )
+
+        # PRNG streams (seed 666 discipline; see runtime/prng.py)
+        root = prng.root_key(config.seed)
+        self._z_keys = prng.KeySequence(prng.stream(root, "train-z"))
+        # label softening: sampled once, reused every iteration (reference
+        # quirk — dl4jGANComputerVision.java:384-385)
+        B = config.batch_size
+        self.soften_real = 0.05 * jax.random.normal(
+            prng.stream(root, "soften-real"), (B, 1), dtype=jnp.float32)
+        self.soften_fake = 0.05 * jax.random.normal(
+            prng.stream(root, "soften-fake"), (B, 1), dtype=jnp.float32)
+
+        # latent evaluation grid: the cartesian product of linspace(-1,1,n)
+        # per latent dim, row-major with the first dim outermost — reference
+        # order for z_size=2 (:363-370); generalizes to any z_size (n^z
+        # rows, so keep n small for z_size > 2)
+        n = config.num_gen_samples
+        grid = np.linspace(-1.0, 1.0, n, dtype=np.float32)
+        self.z_grid = jnp.asarray(
+            np.stack(
+                np.meshgrid(*([grid] * config.z_size), indexing="ij"), axis=-1
+            ).reshape(-1, config.z_size)
+        )
+
+        self.batch_counter = 0
+
+    # -- artifact dumps ------------------------------------------------------
+
+    def _dump_grid(self) -> None:
+        out = self.gen.output(self.z_grid)[0]
+        out = np.asarray(out).reshape(self.z_grid.shape[0], self.c.num_features)
+        write_csv_matrix(
+            os.path.join(self.c.res_path,
+                         f"{self.c.dataset_name}_out_{self.batch_counter}.csv"),
+            out,
+        )
+        self.w.grid_extra_dump(self, out, self.batch_counter)
+
+    def _dump_predictions(self, iter_test: RecordReaderDataSetIterator) -> None:
+        iter_test.reset()
+        preds = []
+        while iter_test.has_next():
+            ds = iter_test.next()
+            preds.append(np.asarray(
+                self.classifier.output(jnp.asarray(ds.features))[0]))
+        write_csv_matrix(
+            os.path.join(
+                self.c.res_path,
+                f"{self.c.dataset_name}_test_predictions_{self.batch_counter}.csv"),
+            np.vstack(preds),
+        )
+
+    # -- checkpointing -------------------------------------------------------
+
+    def _graphs(self) -> Dict[str, object]:
+        return {"dis": self.dis, "gen": self.gen, "gan": self.gan,
+                "classifier": self.classifier}
+
+    def _maybe_checkpoint(self) -> None:
+        if self.checkpointer and self.batch_counter % self.c.checkpoint_every == 0:
+            self.checkpointer.save(
+                self.batch_counter, self._graphs(),
+                extra={"soften_real": self.soften_real,
+                       "soften_fake": self.soften_fake,
+                       "z_key": jax.random.key_data(self._z_keys._key)},
+            )
+
+    def _maybe_resume(self, iter_train: RecordReaderDataSetIterator) -> None:
+        if not (self.c.resume and self.checkpointer
+                and self.checkpointer.latest_step() is not None):
+            return
+        step, extra = self.checkpointer.restore(self._graphs())
+        self.batch_counter = step
+        self.soften_real = jnp.asarray(extra["soften_real"])
+        self.soften_fake = jnp.asarray(extra["soften_fake"])
+        self._z_keys._key = jax.random.wrap_key_data(jnp.asarray(extra["z_key"]))
+        # Fast-forward the data iterator (views, cheap), replaying the
+        # training loop's exact consumption pattern: partial epoch tails are
+        # consumed-and-skipped WITHOUT counting as a step, and exhaustion
+        # wraps (mirrors train() so a resumed run sees identical batches).
+        steps_done = 0
+        while steps_done < step:
+            if not iter_train.has_next():
+                iter_train.reset()
+            ds = iter_train.next()
+            if ds.num_examples() < self.c.batch_size:
+                iter_train.reset()
+                continue
+            steps_done += 1
+            if not iter_train.has_next():
+                iter_train.reset()
+
+    # -- the loop ------------------------------------------------------------
+
+    def train(self, log: Callable[[str], None] = print) -> Dict[str, float]:
+        c = self.c
+        train_csv, test_csv = self.w.ensure_data(c.res_path)
+        iter_train = RecordReaderDataSetIterator(
+            train_csv, c.batch_size, c.label_index, c.num_classes)
+        iter_test = RecordReaderDataSetIterator(
+            test_csv, c.batch_size_pred, c.label_index, c.num_classes)
+        self._maybe_resume(iter_train)
+
+        B = c.batch_size
+        ones = jnp.ones((B, 1), dtype=jnp.float32)
+        zeros = jnp.zeros((B, 1), dtype=jnp.float32)
+        y_dis = jnp.concatenate([ones + self.soften_real,
+                                 zeros + self.soften_fake])
+
+        while iter_train.has_next() and self.batch_counter < c.num_iterations:
+            ds = iter_train.next()
+            if ds.num_examples() < B:   # partial epoch tail: wrap like :524
+                iter_train.reset()
+                continue
+            real = jnp.asarray(ds.features)
+
+            # (1) D-step on [real(1+eps), fake(0+eps)]
+            z = jax.random.uniform(next(self._z_keys), (B, c.z_size),
+                                   minval=-1.0, maxval=1.0)
+            fake = self.gen.output(z)[0].reshape(B, c.num_features)
+            d_loss = self._fit_dis(jnp.concatenate([real, fake]), y_dis)
+
+            # (2) dis -> gan frozen tail (weights + BN running stats)
+            sync_params(self.gan, self.dis, self.w.dis_to_gan)
+
+            # (3) G-step: fool the frozen discriminator
+            z = jax.random.uniform(next(self._z_keys), (B, c.z_size),
+                                   minval=-1.0, maxval=1.0)
+            g_loss = self._fit_gan(z, ones)
+
+            # (4) gan generator -> standalone gen
+            sync_params(self.gen, self.gan, self.w.gan_to_gen)
+
+            # (5) classifier: dis features in, fit on the real labeled batch
+            sync_params(self.classifier, self.dis, self.w.dis_to_classifier)
+            c_loss = self._fit_clf(real, jnp.asarray(ds.labels))
+
+            self.batch_counter += 1
+            self.metrics.log_step(
+                self.batch_counter, examples=B,
+                d_loss=d_loss, g_loss=g_loss, classifier_loss=c_loss,
+            )
+            if self.batch_counter % 100 == 0:
+                log(f"Completed Batch {self.batch_counter}!")
+
+            if self.batch_counter % c.print_every == 0:
+                self._dump_grid()
+            if self.batch_counter % c.save_every == 0:
+                self._dump_predictions(iter_test)
+            if self.c.checkpoint_every:
+                self._maybe_checkpoint()
+
+            if not iter_train.has_next():
+                iter_train.reset()
+
+        # end-of-run model zips, exactly the reference's four files (:529-533)
+        name = c.dataset_name
+        serialization.write_model(
+            self.dis, os.path.join(c.res_path, f"{name}_dis_model.zip"))
+        serialization.write_model(
+            self.gan, os.path.join(c.res_path, f"{name}_gan_model.zip"))
+        serialization.write_model(
+            self.gen, os.path.join(c.res_path, f"{name}_gen_model.zip"))
+        serialization.write_model(
+            self.classifier,
+            os.path.join(c.res_path,
+                         f"{name}_{self.w.classifier_model_name}_model.zip"))
+        self.metrics.flush()
+        return {
+            "steps": self.batch_counter,
+            "examples_per_sec": self.metrics.throughput(),
+            "d_loss": float(self.dis.score),
+            "g_loss": float(self.gan.score),
+        }

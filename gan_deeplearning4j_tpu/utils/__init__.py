@@ -1,0 +1,5 @@
+"""Utilities: structured metrics/observability (SURVEY.md §5)."""
+
+from gan_deeplearning4j_tpu.utils.metrics import MetricsLogger
+
+__all__ = ["MetricsLogger"]

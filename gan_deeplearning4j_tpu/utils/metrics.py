@@ -1,0 +1,79 @@
+"""Structured training metrics — the observability layer the reference lacks.
+
+The reference's only signals are slf4j step logs ("Completed Batch {}",
+dl4jGANComputerVision.java:477) and the periodic prediction CSVs the
+notebook re-reads (SURVEY.md §5 "Metrics / logging").  Here every step can
+record structured metrics (D-loss, G-loss, classifier loss, examples/sec —
+the BASELINE.json north-star unit) to an in-memory ring + optional JSONL
+file, without ever forcing a device sync: losses are stored as jax.Arrays
+and only materialized when flushed.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from collections import deque
+from typing import Dict, List, Optional
+
+
+class MetricsLogger:
+    def __init__(self, path: Optional[str] = None, flush_every: int = 100,
+                 ring_size: int = 10000):
+        self.path = path
+        self.flush_every = flush_every
+        self._pending: List[Dict] = []
+        # bounded in-memory ring of materialized (host-float) records
+        self._records: "deque" = deque(maxlen=ring_size)
+        self._t0 = time.perf_counter()
+        self._last_step_t = self._t0
+        if path:
+            os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+            # truncate: one file per run
+            open(path, "w").close()
+
+    def log_step(self, step: int, examples: int = 0, **metrics) -> None:
+        """Record one step.  ``metrics`` values may be jax.Arrays — they are
+        kept lazy until flush so logging never blocks the device."""
+        now = time.perf_counter()
+        rec = {
+            "step": step,
+            "wall_s": now - self._t0,
+            "step_s": now - self._last_step_t,
+        }
+        if examples:
+            rec["examples_per_sec"] = examples / max(rec["step_s"], 1e-9)
+        rec.update(metrics)
+        self._last_step_t = now
+        self._pending.append(rec)
+        # Flush on cadence even without a file: materializing releases the
+        # pending records' live device buffers into the bounded ring.
+        if len(self._pending) >= self.flush_every:
+            self.flush()
+
+    def flush(self) -> None:
+        if not self._pending:
+            return
+        materialized = []
+        for rec in self._pending:
+            materialized.append(
+                {k: (float(v) if hasattr(v, "dtype") else v) for k, v in rec.items()}
+            )
+        if self.path:
+            with open(self.path, "a") as f:
+                for rec in materialized:
+                    f.write(json.dumps(rec) + "\n")
+        self._records.extend(materialized)
+        self._pending = []
+
+    def records(self) -> List[Dict]:
+        self.flush()
+        return list(self._records)
+
+    def throughput(self, last_n: int = 100) -> float:
+        """Mean examples/sec over the last n recorded steps (ring-bounded)."""
+        self.flush()
+        recs = list(self._records)[-last_n:]
+        vals = [r["examples_per_sec"] for r in recs if "examples_per_sec" in r]
+        return sum(vals) / len(vals) if vals else 0.0

@@ -1,0 +1,72 @@
+"""Ring attention + tensor parallelism tests on the virtual CPU mesh.
+
+The correctness bar: sequence-parallel ring attention must match vanilla
+full-sequence attention EXACTLY (online softmax is exact, not
+approximate), causal and non-causal, and the Megatron TP pair must match
+the unsharded matmul chain.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from gan_deeplearning4j_tpu.parallel import make_mesh
+from gan_deeplearning4j_tpu.parallel.ring_attention import (
+    attention,
+    ring_attention,
+)
+from gan_deeplearning4j_tpu.parallel.tensor_parallel import tp_dense_pair
+
+
+def _qkv(b=2, h=3, t=32, d=8, seed=0):
+    rng = np.random.RandomState(seed)
+    mk = lambda: jnp.asarray(rng.randn(b, h, t, d).astype(np.float32))
+    return mk(), mk(), mk()
+
+
+@pytest.mark.parametrize("causal", [False, True])
+@pytest.mark.parametrize("ring", [2, 4, 8])
+def test_ring_attention_matches_vanilla(cpu_devices, causal, ring):
+    mesh = make_mesh({"seq": ring})
+    q, k, v = _qkv(t=32)
+    ref = attention(q, k, v, causal=causal)
+    out = ring_attention(q, k, v, mesh, axis="seq", causal=causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_ring_attention_rejects_ragged_seq(cpu_devices):
+    mesh = make_mesh({"seq": 4})
+    q, k, v = _qkv(t=30)
+    with pytest.raises(ValueError):
+        ring_attention(q, k, v, mesh)
+
+
+def test_ring_attention_long_context_memory_shape(cpu_devices):
+    """The point of the ring: per-device score blocks are (T/R)^2, so a
+    longer sequence over a bigger ring still runs. Just exercises T=256
+    over R=8 and checks exactness."""
+    mesh = make_mesh({"seq": 8})
+    q, k, v = _qkv(b=1, h=1, t=256, d=4, seed=3)
+    ref = attention(q, k, v, causal=True)
+    out = ring_attention(q, k, v, mesh, axis="seq", causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_tp_dense_pair_matches_unsharded(cpu_devices):
+    mesh = make_mesh({"model": 4})
+    rng = np.random.RandomState(0)
+    B, F, H = 8, 12, 32
+    x = jnp.asarray(rng.randn(B, F).astype(np.float32))
+    w1 = jnp.asarray(rng.randn(F, H).astype(np.float32) * 0.1)
+    b1 = jnp.asarray(rng.randn(H).astype(np.float32) * 0.1)
+    w2 = jnp.asarray(rng.randn(H, F).astype(np.float32) * 0.1)
+    b2 = jnp.asarray(rng.randn(F).astype(np.float32) * 0.1)
+    ref = jnp.tanh(x @ w1 + b1) @ w2 + b2
+    out = tp_dense_pair(x, w1, b1, w2, b2, mesh)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+    with pytest.raises(ValueError):
+        tp_dense_pair(x, w1[:, :30], b1[:30], w2[:30], b2, mesh)
