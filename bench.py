@@ -23,21 +23,32 @@ BASELINE_PATH = os.path.join(os.path.dirname(__file__), "BENCH_BASELINE.json")
 BATCH = 200          # batchSizePerWorker (dl4jGANComputerVision.java:59)
 WARMUP = 3
 STEPS = 20
+# Bump when the measured step's methodology changes; a cached baseline
+# from another version is discarded and re-measured (apples to apples).
+METHODOLOGY_VERSION = 2  # v2: fused one-XLA-program protocol step
 
 
 def protocol_step_time(device) -> float:
     """Mean seconds per full GAN-protocol iteration (D-step + syncs +
-    G-step + classifier step, batch 200) on the given device."""
+    G-step + classifier step, batch 200) on the given device, using the
+    framework's fused one-XLA-program step (train/fused_step.py)."""
     import jax
     import jax.numpy as jnp
     import numpy as np
 
     from gan_deeplearning4j_tpu.models import dcgan_mnist as M
+    from gan_deeplearning4j_tpu.train import fused_step as fused
 
     with jax.default_device(device):
         dis, gen, gan = (
             M.build_discriminator(), M.build_generator(), M.build_gan())
         classifier = M.build_classifier(dis)
+        step = fused.make_protocol_step(
+            dis, gen, gan, classifier,
+            M.DIS_TO_GAN, M.GAN_TO_GEN, M.DIS_TO_CLASSIFIER,
+            z_size=2, num_features=784,
+        )
+        state = fused.state_from_graphs(dis, gen, gan, classifier)
         rng = np.random.RandomState(0)
         real = jax.device_put(rng.rand(BATCH, 784).astype(np.float32), device)
         labels = jax.device_put(
@@ -46,25 +57,15 @@ def protocol_step_time(device) -> float:
         y_dis = jnp.concatenate([ones, jnp.zeros((BATCH, 1), dtype=jnp.float32)])
         key = jax.random.key(0)
 
-        def one_iter(i):
-            z = jax.random.uniform(
-                jax.random.fold_in(key, i), (BATCH, 2), minval=-1.0, maxval=1.0)
-            fake = gen.output(z)[0].reshape(BATCH, 784)
-            d = dis.fit(jnp.concatenate([real, fake]), y_dis)
-            M.sync_params(gan, dis, M.DIS_TO_GAN)
-            g = gan.fit(z, ones)
-            M.sync_params(gen, gan, M.GAN_TO_GEN)
-            M.sync_params(classifier, dis, M.DIS_TO_CLASSIFIER)
-            c = classifier.fit(real, labels)
-            return d, g, c
-
         for i in range(WARMUP):
-            d, g, c = one_iter(i)
-        jax.block_until_ready((d, g, c))
+            state, losses = step(state, jax.random.fold_in(key, i),
+                                 real, labels, y_dis, ones)
+        jax.block_until_ready(losses)
         t0 = time.perf_counter()
         for i in range(WARMUP, WARMUP + STEPS):
-            d, g, c = one_iter(i)
-        jax.block_until_ready((d, g, c))
+            state, losses = step(state, jax.random.fold_in(key, i),
+                                 real, labels, y_dis, ones)
+        jax.block_until_ready(losses)
         return (time.perf_counter() - t0) / STEPS
 
 
@@ -78,15 +79,18 @@ def main() -> None:
     baseline = None
     if os.path.exists(BASELINE_PATH):
         with open(BASELINE_PATH) as f:
-            baseline = json.load(f).get("cpu_img_per_sec")
+            cached = json.load(f)
+        if cached.get("version") == METHODOLOGY_VERSION:
+            baseline = cached.get("cpu_img_per_sec")
     if not baseline:
         cpu_step = protocol_step_time(cpu)
         baseline = BATCH / cpu_step
         with open(BASELINE_PATH, "w") as f:
             json.dump({
+                "version": METHODOLOGY_VERSION,
                 "cpu_img_per_sec": baseline,
-                "note": "three-graph protocol step on host CPU, batch 200 "
-                        "(stand-in for the reference's nd4j-native CPU run)",
+                "note": "fused three-graph protocol step on host CPU, batch "
+                        "200 (stand-in for the reference's nd4j-native CPU run)",
             }, f, indent=1)
 
     if default.platform == "cpu":

@@ -268,6 +268,17 @@ class BatchNorm(Layer):
 
     def apply(self, params, x, train, rng, axis_name=None):
         if train:
+            from gan_deeplearning4j_tpu.ops import pallas as pallas_lib
+
+            if x.ndim == 2 and axis_name is None and pallas_lib.enabled():
+                # fused Pallas path: BN + activation in one VMEM pass
+                y, bmean, bvar = pallas_lib.fused_bn_act_train(
+                    x, params["gamma"], params["beta"], self.eps,
+                    self.activation or "identity")
+                return y, {
+                    "mean": self.decay * params["mean"] + (1 - self.decay) * bmean,
+                    "var": self.decay * params["var"] + (1 - self.decay) * bvar,
+                }
             y, new_mean, new_var = batch_norm_train(
                 x, params["gamma"], params["beta"], params["mean"], params["var"],
                 self.decay, self.eps, axis_name=axis_name,

@@ -1,1 +1,7 @@
-from gan_deeplearning4j_tpu.models import dcgan_mnist, mlpgan_insurance  # noqa: F401
+from gan_deeplearning4j_tpu.models import (  # noqa: F401
+    cgan_cifar10,
+    dcgan_celeba,
+    dcgan_mnist,
+    mlpgan_insurance,
+    wgan_gp,
+)

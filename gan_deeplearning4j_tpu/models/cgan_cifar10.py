@@ -1,0 +1,108 @@
+"""Conditional GAN on CIFAR-10 32x32x3 — roadmap config 3 (BASELINE.json:
+"Conditional GAN on CIFAR-10 32x32 (color conv/deconv stack on TPU)").
+
+Not in the reference's code; designed TPU-first for the two-pytree
+``train.gan_pair.GANPair`` engine (no stacked graph):
+
+  - generator: Merge(z, one-hot label) -> dense 4*4*256 -> BN -> reshape
+    -> ConvTranspose x3 (256->128->64->3, stride 2) -> 32x32x3 tanh.
+    Real transposed convs (ops/upsample.py conv_transpose2d, lowered as
+    input-dilated convs the MXU likes), not the reference's
+    upsample+conv workaround (SURVEY.md §3.3).
+  - discriminator: conv stride-2 stack (3->64->128->256, LeakyReLU) ->
+    flatten -> Merge with the label -> dense -> sigmoid XENT.  Label
+    conditioning merges at the feature level (projection-free cGAN).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+from gan_deeplearning4j_tpu.graph import (
+    BatchNorm,
+    Conv2D,
+    ConvTranspose2D,
+    Dense,
+    GraphBuilder,
+    InputSpec,
+    Merge,
+    Output,
+)
+from gan_deeplearning4j_tpu.optim.rmsprop import RmsProp
+from gan_deeplearning4j_tpu.runtime import prng
+
+
+@dataclasses.dataclass(frozen=True)
+class CGANConfig:
+    seed: int = prng.NUMBER_OF_THE_BEAST
+    height: int = 32
+    width: int = 32
+    channels: int = 3
+    num_classes: int = 10
+    z_size: int = 64
+    base_filters: int = 64
+    learning_rate: float = 0.0002
+    l2: float = 0.0
+    clip: float = 1.0
+
+
+def build_generator(cfg: CGANConfig = CGANConfig()):
+    lr = RmsProp(cfg.learning_rate, 1e-8, 1e-8)
+    f = cfg.base_filters
+    b = GraphBuilder(seed=cfg.seed, l2=cfg.l2, activation="relu",
+                     weight_init="xavier", clip_threshold=cfg.clip)
+    b.add_inputs("z", "label")
+    b.set_input_types(InputSpec.feed_forward(cfg.z_size),
+                      InputSpec.feed_forward(cfg.num_classes))
+    b.add_layer("gen_merge", Merge(), "z", "label")
+    b.add_layer("gen_dense", Dense(n_out=4 * 4 * (4 * f), updater=lr), "gen_merge")
+    b.add_layer("gen_bn0", BatchNorm(updater=lr), "gen_dense")
+    from gan_deeplearning4j_tpu.graph import FeedForwardToCnn
+
+    b.add_layer("gen_deconv1",
+                ConvTranspose2D(kernel=(4, 4), stride=(2, 2), padding=(1, 1),
+                                n_in=4 * f, n_out=2 * f, updater=lr),
+                "gen_bn0")
+    b.input_preprocessor("gen_deconv1", FeedForwardToCnn(4, 4, 4 * f))
+    b.add_layer("gen_bn1", BatchNorm(updater=lr), "gen_deconv1")
+    b.add_layer("gen_deconv2",
+                ConvTranspose2D(kernel=(4, 4), stride=(2, 2), padding=(1, 1),
+                                n_in=2 * f, n_out=f, updater=lr),
+                "gen_bn1")
+    b.add_layer("gen_bn2", BatchNorm(updater=lr), "gen_deconv2")
+    b.add_layer("gen_deconv3",
+                ConvTranspose2D(kernel=(4, 4), stride=(2, 2), padding=(1, 1),
+                                n_in=f, n_out=cfg.channels, activation="tanh",
+                                updater=lr),
+                "gen_bn2")
+    b.set_outputs("gen_deconv3")
+    return b.build().init()
+
+
+def build_discriminator(cfg: CGANConfig = CGANConfig()):
+    lr = RmsProp(cfg.learning_rate, 1e-8, 1e-8)
+    f = cfg.base_filters
+    b = GraphBuilder(seed=cfg.seed, l2=cfg.l2, activation="leakyrelu",
+                     weight_init="xavier", clip_threshold=cfg.clip)
+    b.add_inputs("image", "label")
+    b.set_input_types(
+        InputSpec.convolutional_flat(cfg.height, cfg.width, cfg.channels),
+        InputSpec.feed_forward(cfg.num_classes))
+    b.add_layer("dis_conv1",
+                Conv2D(kernel=(4, 4), stride=(2, 2), padding=(1, 1),
+                       n_in=cfg.channels, n_out=f, updater=lr), "image")
+    b.add_layer("dis_conv2",
+                Conv2D(kernel=(4, 4), stride=(2, 2), padding=(1, 1),
+                       n_in=f, n_out=2 * f, updater=lr), "dis_conv1")
+    b.add_layer("dis_bn2", BatchNorm(updater=lr), "dis_conv2")
+    b.add_layer("dis_conv3",
+                Conv2D(kernel=(4, 4), stride=(2, 2), padding=(1, 1),
+                       n_in=2 * f, n_out=4 * f, updater=lr), "dis_bn2")
+    b.add_layer("dis_dense", Dense(n_out=512, updater=lr), "dis_conv3")
+    b.add_layer("dis_merge", Merge(), "dis_dense", "label")
+    b.add_layer("dis_out",
+                Output(n_out=1, n_in=512 + cfg.num_classes, loss="xent",
+                       activation="sigmoid", updater=lr),
+                "dis_merge")
+    b.set_outputs("dis_out")
+    return b.build().init()

@@ -1,0 +1,108 @@
+"""CelebA 64x64 DCGAN — roadmap config 5 (BASELINE.json: "CelebA 64x64
+DCGAN multi-replica (ParallelWrapper GradientSharing over v5e-8 ICI)").
+
+The reference's classpath carries dormant multi-GPU machinery
+(deeplearning4j-parallel-wrapper + Aeron gradient sharing, SURVEY.md §2c)
+it never invokes; here "multi-replica" is the same one-line pmean the
+whole framework uses: pass a ``Mesh`` to ``GANPair`` and the D/G steps
+run SPMD over the replica axis.
+
+Standard 64x64 DCGAN shapes (Radford et al. 2015): z(100) -> 4x4x(8f) ->
+four stride-2 transposed convs -> 64x64x3 tanh; mirror conv stack with
+LeakyReLU + BN for the discriminator.  ``bf16=True`` runs the dense
+matmuls in bfloat16 on the MXU (params stay float32).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+from gan_deeplearning4j_tpu.graph import (
+    BatchNorm,
+    Conv2D,
+    ConvTranspose2D,
+    Dense,
+    FeedForwardToCnn,
+    GraphBuilder,
+    InputSpec,
+    Output,
+)
+from gan_deeplearning4j_tpu.optim.rmsprop import RmsProp
+from gan_deeplearning4j_tpu.runtime import prng
+
+
+@dataclasses.dataclass(frozen=True)
+class CelebAConfig:
+    seed: int = prng.NUMBER_OF_THE_BEAST
+    height: int = 64
+    width: int = 64
+    channels: int = 3
+    z_size: int = 100
+    base_filters: int = 64
+    learning_rate: float = 0.0002
+    clip: float = 1.0
+    bf16: bool = False
+
+
+def build_generator(cfg: CelebAConfig = CelebAConfig()):
+    lr = RmsProp(cfg.learning_rate, 1e-8, 1e-8)
+    f = cfg.base_filters
+    b = GraphBuilder(seed=cfg.seed, activation="relu", weight_init="xavier",
+                     clip_threshold=cfg.clip)
+    b.add_inputs("z")
+    b.set_input_types(InputSpec.feed_forward(cfg.z_size))
+    b.add_layer("gen_dense",
+                Dense(n_out=4 * 4 * 8 * f, updater=lr, bf16_matmul=cfg.bf16),
+                "z")
+    b.add_layer("gen_bn0", BatchNorm(updater=lr), "gen_dense")
+    chans = [8 * f, 4 * f, 2 * f, f]
+    prev = "gen_bn0"
+    for i in range(3):
+        name = f"gen_deconv{i + 1}"
+        b.add_layer(name,
+                    ConvTranspose2D(kernel=(4, 4), stride=(2, 2), padding=(1, 1),
+                                    n_in=chans[i], n_out=chans[i + 1],
+                                    updater=lr),
+                    prev)
+        if i == 0:
+            b.input_preprocessor(name, FeedForwardToCnn(4, 4, 8 * f))
+        bn = f"gen_bn{i + 1}"
+        b.add_layer(bn, BatchNorm(updater=lr), name)
+        prev = bn
+    b.add_layer("gen_deconv4",
+                ConvTranspose2D(kernel=(4, 4), stride=(2, 2), padding=(1, 1),
+                                n_in=f, n_out=cfg.channels, activation="tanh",
+                                updater=lr),
+                prev)
+    b.set_outputs("gen_deconv4")
+    return b.build().init()
+
+
+def build_discriminator(cfg: CelebAConfig = CelebAConfig()):
+    lr = RmsProp(cfg.learning_rate, 1e-8, 1e-8)
+    f = cfg.base_filters
+    b = GraphBuilder(seed=cfg.seed, activation="leakyrelu",
+                     weight_init="xavier", clip_threshold=cfg.clip)
+    b.add_inputs("image")
+    b.set_input_types(
+        InputSpec.convolutional_flat(cfg.height, cfg.width, cfg.channels))
+    chans = [cfg.channels, f, 2 * f, 4 * f, 8 * f]
+    prev = "image"
+    for i in range(4):
+        name = f"dis_conv{i + 1}"
+        b.add_layer(name,
+                    Conv2D(kernel=(4, 4), stride=(2, 2), padding=(1, 1),
+                           n_in=chans[i], n_out=chans[i + 1], updater=lr),
+                    prev)
+        prev = name
+        if i > 0:
+            bn = f"dis_bn{i + 1}"
+            b.add_layer(bn, BatchNorm(updater=lr), name)
+            prev = bn
+    b.add_layer("dis_out",
+                Output(n_out=1, n_in=8 * f * 4 * 4, loss="xent",
+                       activation="sigmoid", updater=lr,
+                       bf16_matmul=cfg.bf16),
+                prev)
+    b.set_outputs("dis_out")
+    return b.build().init()

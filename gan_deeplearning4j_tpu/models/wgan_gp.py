@@ -1,0 +1,97 @@
+"""WGAN-GP — roadmap config 4 (BASELINE.json: "WGAN-GP (gradient penalty —
+stresses SameDiff second-order -> XLA)").
+
+The reference's DL4J/SameDiff stack could not express grad-of-grad
+(BASELINE.json lists WGAN-GP precisely as the second-order stress test);
+here the penalty is ordinary composed autodiff: every op in ops/ keeps a
+JVP, so ``jax.grad`` through ``jax.grad`` of the critic's conv stack just
+works (ops/losses.py gradient_penalty, used by train.gan_pair.GANPair
+with ``mode="wgan-gp"``).
+
+Critic design notes (Gulrajani et al. 2017 conventions): NO BatchNorm in
+the critic (the penalty is per-example; batch coupling breaks it), linear
+output head, ``wasserstein`` loss with +1/-1 labels, generator identical
+to a DCGAN generator.  Defaults target MNIST 28x28 so the workload plugs
+into the same data pipeline as the CV main.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+from gan_deeplearning4j_tpu.graph import (
+    BatchNorm,
+    Conv2D,
+    ConvTranspose2D,
+    Dense,
+    FeedForwardToCnn,
+    GraphBuilder,
+    InputSpec,
+    Output,
+)
+from gan_deeplearning4j_tpu.optim.rmsprop import RmsProp
+from gan_deeplearning4j_tpu.runtime import prng
+
+
+@dataclasses.dataclass(frozen=True)
+class WGANGPConfig:
+    seed: int = prng.NUMBER_OF_THE_BEAST
+    height: int = 28
+    width: int = 28
+    channels: int = 1
+    z_size: int = 64
+    base_filters: int = 32
+    learning_rate: float = 0.0001
+    gp_weight: float = 10.0
+    n_critic: int = 5            # critic steps per generator step
+    clip: float = 0.0            # no grad clipping; GP regularizes instead
+
+
+def build_critic(cfg: WGANGPConfig = WGANGPConfig()):
+    """Conv critic, NO BatchNorm, linear head, Wasserstein loss."""
+    lr = RmsProp(cfg.learning_rate, 1e-8, 1e-8)
+    f = cfg.base_filters
+    b = GraphBuilder(seed=cfg.seed, activation="leakyrelu",
+                     weight_init="xavier",
+                     clip_threshold=cfg.clip or None)
+    b.add_inputs("image")
+    b.set_input_types(
+        InputSpec.convolutional_flat(cfg.height, cfg.width, cfg.channels))
+    b.add_layer("crit_conv1",
+                Conv2D(kernel=(5, 5), stride=(2, 2), padding=(2, 2),
+                       n_in=cfg.channels, n_out=f, updater=lr), "image")
+    b.add_layer("crit_conv2",
+                Conv2D(kernel=(5, 5), stride=(2, 2), padding=(2, 2),
+                       n_in=f, n_out=2 * f, updater=lr), "crit_conv1")
+    b.add_layer("crit_dense", Dense(n_out=256, updater=lr), "crit_conv2")
+    b.add_layer("crit_out",
+                Output(n_out=1, n_in=256, loss="wasserstein",
+                       activation="identity", updater=lr),
+                "crit_dense")
+    b.set_outputs("crit_out")
+    return b.build().init()
+
+
+def build_generator(cfg: WGANGPConfig = WGANGPConfig()):
+    """DCGAN-style generator: z -> dense 7*7*4f -> BN -> deconv x2 -> 28x28."""
+    lr = RmsProp(cfg.learning_rate, 1e-8, 1e-8)
+    f = cfg.base_filters
+    b = GraphBuilder(seed=cfg.seed, activation="relu", weight_init="xavier",
+                     clip_threshold=cfg.clip or None)
+    b.add_inputs("z")
+    b.set_input_types(InputSpec.feed_forward(cfg.z_size))
+    b.add_layer("gen_dense", Dense(n_out=7 * 7 * 4 * f, updater=lr), "z")
+    b.add_layer("gen_bn0", BatchNorm(updater=lr), "gen_dense")
+    b.add_layer("gen_deconv1",
+                ConvTranspose2D(kernel=(4, 4), stride=(2, 2), padding=(1, 1),
+                                n_in=4 * f, n_out=2 * f, updater=lr),
+                "gen_bn0")
+    b.input_preprocessor("gen_deconv1", FeedForwardToCnn(7, 7, 4 * f))
+    b.add_layer("gen_bn1", BatchNorm(updater=lr), "gen_deconv1")
+    b.add_layer("gen_deconv2",
+                ConvTranspose2D(kernel=(4, 4), stride=(2, 2), padding=(1, 1),
+                                n_in=2 * f, n_out=cfg.channels,
+                                activation="sigmoid", updater=lr),
+                "gen_bn1")
+    b.set_outputs("gen_deconv2")
+    return b.build().init()

@@ -1,0 +1,40 @@
+"""Pallas TPU kernels — hand-written fusions where XLA's stock lowering
+leaves HBM bandwidth on the table (SURVEY.md §7 step 2: "Pallas only
+where profiling says so"; the north-star names batchnorm and conv).
+
+Currently: fused train-mode BatchNorm+activation (bn_act.py).  Kernels
+are opt-in (``enable(True)`` or env GAN4J_PALLAS=1) and TPU-only at
+runtime; tests exercise them anywhere via ``interpret=True``.
+"""
+
+from __future__ import annotations
+
+import os
+
+import jax
+
+from gan_deeplearning4j_tpu.ops.pallas.bn_act import fused_bn_act_train
+
+_ENABLED = os.environ.get("GAN4J_PALLAS", "0") == "1"
+
+
+def enable(on: bool = True) -> None:
+    """Toggle Pallas kernels.  The flag is read at TRACE time: call this
+    (or set GAN4J_PALLAS=1) BEFORE the first fit/compile of a graph —
+    already-jitted executables keep whichever path they were traced with
+    (jit caches are keyed on code, not on this flag)."""
+    global _ENABLED
+    _ENABLED = on
+
+
+def enabled() -> bool:
+    """Pallas kernels active: opted in AND running on a TPU backend."""
+    if not _ENABLED:
+        return False
+    try:
+        return jax.default_backend() in ("tpu", "axon")
+    except RuntimeError:
+        return False
+
+
+__all__ = ["fused_bn_act_train", "enable", "enabled"]

@@ -1,0 +1,125 @@
+"""Pallas TPU kernel: fused train-mode BatchNorm + activation.
+
+The north-star calls out batchnorm as a candidate for hand kernels where
+stock XLA lowering isn't enough (BASELINE.json; SURVEY.md §7 step 2).
+Train-mode BN is three HBM passes when unfused (reduce for mean, reduce
+for var, elementwise normalize); XLA usually fuses the elementwise tail
+but keeps separate reduction passes.  This kernel does the whole thing —
+E[x], E[x^2], normalize, scale/shift, activation — in ONE VMEM-resident
+pass per feature tile: the batch column block is loaded once, reduced and
+transformed in registers/VMEM, written once.
+
+Scope: 2-D [B, F] inputs (the models' heavy BNs — the generator's
+6272-wide and the dense 1024-wide layers — are 2-D; 4-D per-channel BN
+stays on the XLA path).  F is tiled in 128-lane blocks; B and F are
+padded to tile multiples and the result sliced back.
+
+Gradients: ``jax.custom_vjp`` with a rematerializing backward through the
+plain-jnp reference composition — forward speed from Pallas, backward
+correctness from autodiff (Patterns: Custom VJP in the Pallas guide).
+
+Enable via ``ops.pallas.enable(True)`` or env GAN4J_PALLAS=1; runs only
+on TPU (or anywhere with ``interpret=True`` for tests).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+
+from gan_deeplearning4j_tpu.ops import activations as act_lib
+
+LANE = 128
+SUBLANE = 8
+
+
+def _kernel(x_ref, gamma_ref, beta_ref, y_ref, mean_ref, var_ref, *,
+            eps: float, act_name: str, n_valid_rows: int):
+    x = x_ref[:]                                   # [B_pad, TILE_F]
+    # padded rows are zero; correct the moments by the true row count
+    inv_n = 1.0 / n_valid_rows
+    mean = jnp.sum(x, axis=0, keepdims=True) * inv_n
+    m2 = jnp.sum(x * x, axis=0, keepdims=True) * inv_n
+    var = m2 - mean * mean
+    y = (x - mean) * lax.rsqrt(var + eps)
+    y = y * gamma_ref[:] + beta_ref[:]
+    y_ref[:] = act_lib.get(act_name)(y)
+    mean_ref[:] = mean
+    var_ref[:] = var
+
+
+def _pad_to(x, rows, cols):
+    pr, pc = rows - x.shape[0], cols - x.shape[1]
+    if pr or pc:
+        x = jnp.pad(x, ((0, pr), (0, pc)))
+    return x
+
+
+def _reference(x, gamma, beta, eps, act_name):
+    mean = jnp.mean(x, axis=0)
+    var = jnp.mean(jnp.square(x), axis=0) - jnp.square(mean)
+    y = (x - mean[None]) * lax.rsqrt(var[None] + eps)
+    y = y * gamma[None] + beta[None]
+    return act_lib.get(act_name)(y), mean, var
+
+
+def _fused_fwd_impl(x, gamma, beta, eps: float, act_name: str,
+                    interpret: bool):
+    B, F = x.shape
+    B_pad = -(-B // SUBLANE) * SUBLANE
+    F_pad = -(-F // LANE) * LANE
+    xp = _pad_to(x, B_pad, F_pad)
+    gp = _pad_to(gamma[None], 1, F_pad)
+    bp = _pad_to(beta[None], 1, F_pad)
+    grid = (F_pad // LANE,)
+    kernel = functools.partial(_kernel, eps=eps, act_name=act_name,
+                               n_valid_rows=B)
+    y, mean, var = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((B_pad, LANE), lambda i: (0, i)),
+            pl.BlockSpec((1, LANE), lambda i: (0, i)),
+            pl.BlockSpec((1, LANE), lambda i: (0, i)),
+        ],
+        out_specs=[
+            pl.BlockSpec((B_pad, LANE), lambda i: (0, i)),
+            pl.BlockSpec((1, LANE), lambda i: (0, i)),
+            pl.BlockSpec((1, LANE), lambda i: (0, i)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((B_pad, F_pad), x.dtype),
+            jax.ShapeDtypeStruct((1, F_pad), x.dtype),
+            jax.ShapeDtypeStruct((1, F_pad), x.dtype),
+        ],
+        interpret=interpret,
+    )(xp, gp, bp)
+    return y[:B, :F], mean[0, :F], var[0, :F]
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def fused_bn_act_train(x, gamma, beta, eps: float = 1e-5,
+                       act_name: str = "identity",
+                       interpret: bool = False):
+    """-> (act(bn(x)), batch_mean, batch_var); one fused pass on TPU."""
+    return _fused_fwd_impl(x, gamma, beta, eps, act_name, interpret)
+
+
+def _fwd(x, gamma, beta, eps, act_name, interpret):
+    out = _fused_fwd_impl(x, gamma, beta, eps, act_name, interpret)
+    return out, (x, gamma, beta)
+
+
+def _bwd(eps, act_name, interpret, residuals, cotangents):
+    x, gamma, beta = residuals
+    _, vjp = jax.vjp(lambda a, g, b: _reference(a, g, b, eps, act_name),
+                     x, gamma, beta)
+    return vjp(cotangents)
+
+
+fused_bn_act_train.defvjp(_fwd, _bwd)

@@ -1,0 +1,147 @@
+"""Fused protocol step — the whole GAN iteration as ONE XLA program.
+
+The reference's iteration (SURVEY.md §3.2) is a host-driven dance: three
+separate Spark fit jobs with an RDD serialization round trip each, plus
+30+ ``setParam`` copies between them.  The unfused GANTrainer already
+removes the serialization; this module removes the remaining per-fit
+dispatch entirely: D-step, dis->gan sync, G-step, gan->gen sync,
+dis->classifier sync, and classifier-step compile into a single jitted
+(optionally shard_map-ed SPMD) program.  Inside XLA the "weight copies"
+are pure aliasing — zero ops, zero HBM traffic — and the compiler can
+overlap the three backward passes' HBM streams.  State buffers are
+donated, so parameters update in place in HBM.
+
+Under a mesh, every gradient/BN reduce is the same pmean-over-ICI as
+parallel/data_parallel.py (sync-BN included); per-replica z draws fold in
+``lax.axis_index``.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Dict, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax, shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+from gan_deeplearning4j_tpu.runtime import prng
+
+
+class ProtocolState(NamedTuple):
+    """All four graphs' learnable state, one donated pytree."""
+
+    dis_params: Dict
+    dis_opt: Dict
+    gan_params: Dict
+    gan_opt: Dict
+    clf_params: Dict
+    clf_opt: Dict
+    gen_params: Dict
+
+
+def _apply_sync(dst_params: Dict, src_params: Dict, mapping) -> Dict:
+    """The reference's setParam block as a pure pytree merge (free in XLA)."""
+    out = dict(dst_params)
+    for dst_layer, src_layer, names in mapping:
+        out[dst_layer] = {
+            **out[dst_layer],
+            **{n: src_params[src_layer][n] for n in names},
+        }
+    return out
+
+
+def make_protocol_step(
+    dis, gen, gan, classifier,
+    dis_to_gan, gan_to_gen, dis_to_classifier,
+    z_size: int,
+    num_features: int,
+    mesh: Optional[Mesh] = None,
+    axis: str = "data",
+    donate: bool = True,
+):
+    """Build the fused step:
+    (state, rng, real, labels, z1, z2, y_real, y_fake, ones) ->
+    (state', (d_loss, g_loss, clf_loss)).
+
+    ``real``/``labels`` are the per-iteration batch; ``z1``/``z2`` the
+    host-drawn latent batches for the D- and G-steps (drawn outside so the
+    fused and unfused paths share PRNG semantics and single-device ==
+    multi-device parity holds exactly); ``y_real``/``y_fake``/``ones`` the
+    (pre-softened, loop-invariant) target vectors.  ``rng`` only feeds
+    dropout streams.
+    """
+    axis_name = axis if mesh is not None else None
+
+    def reduce(loss, updates, grads):
+        if axis_name is None:
+            return loss, updates, grads
+        return (lax.pmean(loss, axis_name), lax.pmean(updates, axis_name),
+                lax.pmean(grads, axis_name))
+
+    def step(state: ProtocolState, rng, real, labels, z1, z2, y_real, y_fake,
+             ones):
+        B = real.shape[0]
+        if axis_name is not None:
+            rng = prng.fold_in_index(rng, lax.axis_index(axis_name))
+        # (1) D-step on [real; G(z)] — generator runs inference mode.
+        # y_real/y_fake are sharded separately and concatenated LOCALLY so
+        # each shard's label halves align with its own [real; fake] halves
+        # (a globally pre-concatenated label vector would misalign).
+        fake_vals, _ = gen._forward(
+            state.gen_params, {gen.input_names[0]: z1}, False, None)
+        fake = fake_vals[gen.output_names[0]].reshape(B, num_features)
+        x = jnp.concatenate([real, fake])
+        y_dis = jnp.concatenate([y_real, y_fake])
+        dis_params, dis_opt, d_loss = dis._train_step(
+            state.dis_params, state.dis_opt, prng.stream(rng, "d"),
+            {dis.input_names[0]: x}, {dis.output_names[0]: y_dis},
+            reduce, axis_name)
+        # (2) dis -> gan frozen tail: pure aliasing
+        gan_params = _apply_sync(state.gan_params, dis_params, dis_to_gan)
+        # (3) G-step through the stacked graph
+        gan_params, gan_opt, g_loss = gan._train_step(
+            gan_params, state.gan_opt, prng.stream(rng, "g"),
+            {gan.input_names[0]: z2}, {gan.output_names[0]: ones},
+            reduce, axis_name)
+        # (4) gan generator -> standalone gen
+        gen_params = _apply_sync(state.gen_params, gan_params, gan_to_gen)
+        # (5) classifier on the labeled real batch
+        clf_params = _apply_sync(state.clf_params, dis_params, dis_to_classifier)
+        clf_params, clf_opt, c_loss = classifier._train_step(
+            clf_params, state.clf_opt, prng.stream(rng, "clf"),
+            {classifier.input_names[0]: real},
+            {classifier.output_names[0]: labels},
+            reduce, axis_name)
+        new_state = ProtocolState(
+            dis_params, dis_opt, gan_params, gan_opt,
+            clf_params, clf_opt, gen_params)
+        return new_state, (d_loss, g_loss, c_loss)
+
+    if mesh is None:
+        return jax.jit(step, donate_argnums=(0,) if donate else ())
+
+    sharded = shard_map(
+        step,
+        mesh=mesh,
+        # state + rng replicated; real, labels, z1, z2, y_real, y_fake,
+        # ones batch-sharded
+        in_specs=(P(), P()) + (P(axis),) * 7,
+        out_specs=(P(), P()),
+        check_vma=False,
+    )
+    return jax.jit(sharded, donate_argnums=(0,) if donate else ())
+
+
+def state_from_graphs(dis, gen, gan, classifier) -> ProtocolState:
+    return ProtocolState(
+        dis.params, dis.opt_state, gan.params, gan.opt_state,
+        classifier.params, classifier.opt_state, gen.params)
+
+
+def state_to_graphs(state: ProtocolState, dis, gen, gan, classifier) -> None:
+    dis.params, dis.opt_state = state.dis_params, state.dis_opt
+    gan.params, gan.opt_state = state.gan_params, state.gan_opt
+    classifier.params, classifier.opt_state = state.clf_params, state.clf_opt
+    gen.params = state.gen_params

@@ -1,0 +1,201 @@
+"""Two-pytree GAN training — the idiomatic alternative to the three-graph
+protocol, for the roadmap model families.
+
+The reference needs THREE graphs (dis, stacked gan, standalone gen) plus
+30+ per-iteration setParam copies because DL4J cannot differentiate
+through a frozen submodel (SURVEY.md §3.2, §7 "hard parts").  JAX can:
+``jax.grad`` flows through D(G(z)) with D's params held constant, so one
+generator graph + one discriminator/critic graph suffice and weight sync
+disappears entirely.  This engine powers the BASELINE.json roadmap
+configs (conditional GAN CIFAR-10, WGAN-GP, CelebA-64 DCGAN) while the
+fidelity-exact three-graph GANTrainer covers the reference's own two
+workloads.
+
+Mechanics:
+  - D-step: fake = G(z) (inference mode, stop-gradient by construction —
+    G's params aren't differentiated), D trains on [real; fake] in one
+    concatenated batch; for WGAN-GP the gradient penalty (grad-of-grad
+    through the conv stack) is added — ``mode="wgan-gp"``
+  - G-step: loss backprops through D∘G with D frozen (inference mode,
+    running BN stats — standard practice)
+  - optional label conditioning: extra inputs forwarded to both graphs
+  - optional data parallelism: the same pmean-reduce as
+    parallel/data_parallel.py, applied inside shard_map over a mesh
+  - each step is ONE jitted XLA program; with a mesh, ONE SPMD program
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Dict, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax, shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+from gan_deeplearning4j_tpu.graph.graph import ComputationGraph
+from gan_deeplearning4j_tpu.ops import losses as loss_lib
+from gan_deeplearning4j_tpu.parallel import mesh as mesh_lib
+from gan_deeplearning4j_tpu.runtime import prng
+
+
+class GANPair:
+    def __init__(
+        self,
+        gen: ComputationGraph,
+        dis: ComputationGraph,
+        mode: str = "gan",
+        gp_weight: float = 10.0,
+        mesh: Optional[Mesh] = None,
+        axis: str = "data",
+    ):
+        if mode not in ("gan", "wgan-gp"):
+            raise ValueError(f"unknown mode {mode!r}")
+        self.gen = gen
+        self.dis = dis
+        self.mode = mode
+        self.gp_weight = gp_weight
+        self.mesh = mesh
+        self.axis = axis
+        self._step_rng = prng.stream(prng.root_key(gen.seed), "gan-pair")
+        self._count = 0
+        self._jit_d = self._build(self._d_step)
+        self._jit_g = self._build(self._g_step)
+
+    # -- pure forwards -------------------------------------------------------
+
+    def _gen_forward(self, params_g, z_inputs, train, rng, axis_name=None):
+        values, updates = self.gen._forward(params_g, z_inputs, train, rng,
+                                            axis_name)
+        out = values[self.gen.output_names[0]]
+        return out.reshape(out.shape[0], -1), updates  # flat, dis-input layout
+
+    def _dis_forward(self, params_d, x, cond, train, rng, axis_name=None):
+        inputs = {self.dis.input_names[0]: x}
+        if cond:
+            inputs.update(cond)
+        values, updates = self.dis._forward(params_d, inputs, train, rng,
+                                            axis_name)
+        return values[self.dis.output_names[0]], updates
+
+    def _dis_loss(self, out, labels):
+        name = getattr(self.dis.nodes[self.dis.output_names[0]].layer, "loss", "xent")
+        return loss_lib.get(name)(out, labels)
+
+    # -- steps ---------------------------------------------------------------
+
+    def _d_step(self, params_d, opt_d, params_g, rng, real, z_inputs,
+                cond_real, cond_fake, y_real, y_fake, axis_name=None):
+        fake, _ = self._gen_forward(params_g, z_inputs, False, None)
+        x = jnp.concatenate([real, fake])
+        cond = {
+            k: jnp.concatenate([cond_real[k], cond_fake[k]]) for k in cond_real
+        }
+        y = jnp.concatenate([y_real, y_fake])
+
+        def loss_fn(p):
+            out, updates = self._dis_forward(p, x, cond, True, rng, axis_name)
+            loss = self._dis_loss(out, y)
+            if self.mode == "wgan-gp":
+                def critic(xi):
+                    # GP critic: inference mode (per-example vmap makes
+                    # batch stats meaningless), labels from the real batch
+                    n = xi.shape[0]
+                    c = {k: v[:n] for k, v in cond_real.items()}
+                    o, _ = self._dis_forward(p, xi, c, False, None)
+                    return o
+                gp = loss_lib.gradient_penalty(
+                    critic, real, fake, prng.stream(rng, "gp"))
+                loss = loss + self.gp_weight * gp
+            return loss, updates
+
+        (loss, updates), grads = jax.value_and_grad(loss_fn, has_aux=True)(params_d)
+        if axis_name is not None:
+            loss = lax.pmean(loss, axis_name)
+            grads = lax.pmean(grads, axis_name)
+            updates = lax.pmean(updates, axis_name)
+        new_params, new_opt = self.dis.updater.apply(params_d, grads, opt_d)
+        for lname, upd in updates.items():
+            new_params[lname] = {**new_params[lname], **upd}
+        return new_params, new_opt, loss
+
+    def _g_step(self, params_g, opt_g, params_d, rng, z_inputs, cond_fake,
+                y_gen, axis_name=None):
+        def loss_fn(p):
+            # sync-BN for the generator too: global-batch stats under a mesh
+            fake, updates = self._gen_forward(p, z_inputs, True,
+                                              prng.stream(rng, "gen"),
+                                              axis_name)
+            out, _ = self._dis_forward(params_d, fake, cond_fake, False, None)
+            return self._dis_loss(out, y_gen), updates
+
+        (loss, updates), grads = jax.value_and_grad(loss_fn, has_aux=True)(params_g)
+        if axis_name is not None:
+            loss = lax.pmean(loss, axis_name)
+            grads = lax.pmean(grads, axis_name)
+            updates = lax.pmean(updates, axis_name)
+        new_params, new_opt = self.gen.updater.apply(params_g, grads, opt_g)
+        for lname, upd in updates.items():
+            new_params[lname] = {**new_params[lname], **upd}
+        return new_params, new_opt, loss
+
+    def _build(self, fn):
+        if self.mesh is None:
+            return jax.jit(partial(fn, axis_name=None))
+        axis = self.axis
+        # batched args after (params, opt, other_params, rng):
+        #   d: real, z_inputs, cond_real, cond_fake, y_real, y_fake
+        #   g: z_inputs, cond_fake, y_gen
+        n_extra = {self._d_step: 6, self._g_step: 3}[fn]
+        # specs: (params, opt, other_params, rng) replicated; the batched
+        # args (real/z/cond/labels) sharded over the data axis
+        in_specs = (P(), P(), P(), P()) + (P(axis),) * n_extra
+        return jax.jit(shard_map(
+            partial(fn, axis_name=axis),
+            mesh=self.mesh,
+            in_specs=in_specs,
+            out_specs=(P(), P(), P()),
+            check_vma=False,
+        ))
+
+    # -- public API ----------------------------------------------------------
+
+    def _rng(self):
+        self._count += 1
+        return jax.random.fold_in(self._step_rng, self._count)
+
+    def _place(self, tree):
+        if self.mesh is None:
+            return tree
+        sh = mesh_lib.batch_sharding(self.mesh, self.axis)
+        return jax.tree.map(lambda x: jax.device_put(jnp.asarray(x), sh), tree)
+
+    def d_step(self, real, z_inputs: Dict, cond_real: Optional[Dict] = None,
+               cond_fake: Optional[Dict] = None,
+               y_real=None, y_fake=None) -> jax.Array:
+        B = real.shape[0]
+        if y_real is None:
+            y_real = jnp.ones((B, 1), dtype=jnp.float32)
+            y_fake = (-jnp.ones((B, 1), dtype=jnp.float32)
+                      if self.mode == "wgan-gp"
+                      else jnp.zeros((B, 1), dtype=jnp.float32))
+        args = self._place((real, z_inputs, cond_real or {}, cond_fake or {},
+                            y_real, y_fake))
+        self.dis.params, self.dis.opt_state, loss = self._jit_d(
+            self.dis.params, self.dis.opt_state, self.gen.params, self._rng(),
+            *args)
+        self.dis.score = loss
+        return loss
+
+    def g_step(self, z_inputs: Dict, cond_fake: Optional[Dict] = None,
+               y_gen=None) -> jax.Array:
+        B = next(iter(z_inputs.values())).shape[0]
+        if y_gen is None:
+            y_gen = jnp.ones((B, 1), dtype=jnp.float32)
+        args = self._place((z_inputs, cond_fake or {}, y_gen))
+        self.gen.params, self.gen.opt_state, loss = self._jit_g(
+            self.gen.params, self.gen.opt_state, self.dis.params, self._rng(),
+            *args)
+        self.gen.score = loss
+        return loss

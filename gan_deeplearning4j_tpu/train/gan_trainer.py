@@ -45,6 +45,7 @@ from gan_deeplearning4j_tpu.data import (
 )
 from gan_deeplearning4j_tpu.graph import serialization
 from gan_deeplearning4j_tpu.parallel import DataParallelGraph, data_mesh
+from gan_deeplearning4j_tpu.parallel import mesh as mesh_lib
 from gan_deeplearning4j_tpu.runtime import prng
 from gan_deeplearning4j_tpu.utils import MetricsLogger
 
@@ -71,6 +72,7 @@ class GANTrainerConfig:
     n_devices: Optional[int] = None   # None = all attached; 1 = no mesh
     dp_mode: str = "gradient_sync"
     averaging_frequency: int = 1
+    fused: bool = True                # one-XLA-program protocol iteration
     # -- new capabilities over the reference --
     checkpoint_every: int = 0         # 0 = end-of-run models only
     checkpoint_keep: int = 3
@@ -135,12 +137,31 @@ class GANTrainer:
             config.n_devices = max(
                 d for d in range(1, avail + 1) if config.batch_size % d == 0
             )
-        if config.n_devices == 1:
+        # Fused mode (default for gradient_sync): the whole protocol
+        # iteration is ONE jitted/SPMD program (train/fused_step.py) —
+        # cross-graph syncs are free aliasing, state buffers donated.
+        # param_averaging keeps the unfused per-fit path (its job-level
+        # broadcast/average semantics are inherently per-network).
+        self._fused_step = None
+        mesh = data_mesh(config.n_devices) if config.n_devices > 1 else None
+        if config.fused and config.dp_mode == "gradient_sync":
+            from gan_deeplearning4j_tpu.train import fused_step as fused
+
+            self._fused_lib = fused
+            self._fused_step = fused.make_protocol_step(
+                self.dis, self.gen, self.gan, self.classifier,
+                workload.dis_to_gan, workload.gan_to_gen,
+                workload.dis_to_classifier,
+                z_size=config.z_size, num_features=config.num_features,
+                mesh=mesh,
+            )
+            self._batch_sharding = (
+                mesh_lib.batch_sharding(mesh) if mesh is not None else None)
+        elif config.n_devices == 1:
             self._fit_dis = self.dis.fit
             self._fit_gan = self.gan.fit
             self._fit_clf = self.classifier.fit
         else:
-            mesh = data_mesh(config.n_devices)
             kw = dict(mesh=mesh, mode=config.dp_mode,
                       averaging_frequency=config.averaging_frequency)
             self.spark_dis = DataParallelGraph(self.dis, **kw)
@@ -165,6 +186,7 @@ class GANTrainer:
         # PRNG streams (seed 666 discipline; see runtime/prng.py)
         root = prng.root_key(config.seed)
         self._z_keys = prng.KeySequence(prng.stream(root, "train-z"))
+        self._fused_rng = prng.stream(root, "fused-step")
         # label softening: sampled once, reused every iteration (reference
         # quirk — dl4jGANComputerVision.java:384-385)
         B = config.batch_size
@@ -270,33 +292,56 @@ class GANTrainer:
         y_dis = jnp.concatenate([ones + self.soften_real,
                                  zeros + self.soften_fake])
 
+        fused_state = None
+        start_counter = self.batch_counter
+        if self._fused_step is not None:
+            fused_state = self._fused_lib.state_from_graphs(
+                self.dis, self.gen, self.gan, self.classifier)
+
         while iter_train.has_next() and self.batch_counter < c.num_iterations:
             ds = iter_train.next()
             if ds.num_examples() < B:   # partial epoch tail: wrap like :524
                 iter_train.reset()
                 continue
             real = jnp.asarray(ds.features)
+            labels = jnp.asarray(ds.labels)
 
-            # (1) D-step on [real(1+eps), fake(0+eps)]
-            z = jax.random.uniform(next(self._z_keys), (B, c.z_size),
-                                   minval=-1.0, maxval=1.0)
-            fake = self.gen.output(z)[0].reshape(B, c.num_features)
-            d_loss = self._fit_dis(jnp.concatenate([real, fake]), y_dis)
+            if self._fused_step is not None:
+                # the whole iteration — D-step, syncs, G-step, classifier —
+                # is one donated-state XLA program; z drawn host-side from
+                # the same stream as the unfused path
+                z1 = jax.random.uniform(next(self._z_keys), (B, c.z_size),
+                                        minval=-1.0, maxval=1.0)
+                z2 = jax.random.uniform(next(self._z_keys), (B, c.z_size),
+                                        minval=-1.0, maxval=1.0)
+                if self._batch_sharding is not None:
+                    real = jax.device_put(real, self._batch_sharding)
+                    labels = jax.device_put(labels, self._batch_sharding)
+                rng = jax.random.fold_in(self._fused_rng, self.batch_counter + 1)
+                fused_state, (d_loss, g_loss, c_loss) = self._fused_step(
+                    fused_state, rng, real, labels, z1, z2,
+                    ones + self.soften_real, zeros + self.soften_fake, ones)
+            else:
+                # (1) D-step on [real(1+eps), fake(0+eps)]
+                z = jax.random.uniform(next(self._z_keys), (B, c.z_size),
+                                       minval=-1.0, maxval=1.0)
+                fake = self.gen.output(z)[0].reshape(B, c.num_features)
+                d_loss = self._fit_dis(jnp.concatenate([real, fake]), y_dis)
 
-            # (2) dis -> gan frozen tail (weights + BN running stats)
-            sync_params(self.gan, self.dis, self.w.dis_to_gan)
+                # (2) dis -> gan frozen tail (weights + BN running stats)
+                sync_params(self.gan, self.dis, self.w.dis_to_gan)
 
-            # (3) G-step: fool the frozen discriminator
-            z = jax.random.uniform(next(self._z_keys), (B, c.z_size),
-                                   minval=-1.0, maxval=1.0)
-            g_loss = self._fit_gan(z, ones)
+                # (3) G-step: fool the frozen discriminator
+                z = jax.random.uniform(next(self._z_keys), (B, c.z_size),
+                                       minval=-1.0, maxval=1.0)
+                g_loss = self._fit_gan(z, ones)
 
-            # (4) gan generator -> standalone gen
-            sync_params(self.gen, self.gan, self.w.gan_to_gen)
+                # (4) gan generator -> standalone gen
+                sync_params(self.gen, self.gan, self.w.gan_to_gen)
 
-            # (5) classifier: dis features in, fit on the real labeled batch
-            sync_params(self.classifier, self.dis, self.w.dis_to_classifier)
-            c_loss = self._fit_clf(real, jnp.asarray(ds.labels))
+                # (5) classifier: dis features, fit on the real labeled batch
+                sync_params(self.classifier, self.dis, self.w.dis_to_classifier)
+                c_loss = self._fit_clf(real, labels)
 
             self.batch_counter += 1
             self.metrics.log_step(
@@ -305,6 +350,15 @@ class GANTrainer:
             )
             if self.batch_counter % 100 == 0:
                 log(f"Completed Batch {self.batch_counter}!")
+
+            if self._fused_step is not None and (
+                self.batch_counter % c.print_every == 0
+                or self.batch_counter % c.save_every == 0
+                or (c.checkpoint_every
+                    and self.batch_counter % c.checkpoint_every == 0)):
+                # artifact/checkpoint points read through the graph objects
+                self._fused_lib.state_to_graphs(
+                    fused_state, self.dis, self.gen, self.gan, self.classifier)
 
             if self.batch_counter % c.print_every == 0:
                 self._dump_grid()
@@ -315,6 +369,13 @@ class GANTrainer:
 
             if not iter_train.has_next():
                 iter_train.reset()
+
+        if self._fused_step is not None and fused_state is not None:
+            self._fused_lib.state_to_graphs(
+                fused_state, self.dis, self.gen, self.gan, self.classifier)
+            if self.batch_counter > start_counter:
+                self.dis.score, self.gan.score = d_loss, g_loss
+                self.classifier.score = c_loss
 
         # end-of-run model zips, exactly the reference's four files (:529-533)
         name = c.dataset_name
