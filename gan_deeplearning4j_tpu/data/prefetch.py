@@ -1,0 +1,94 @@
+"""Prefetching batch iterator — overlap host IO with device compute.
+
+The reference decodes CSV rows on the training thread every iteration
+(``iterTrain.next()`` inside the hot loop, dl4jGANComputerVision.java:389
+— disk IO each iteration, SURVEY.md §3.2).  Here a background thread
+stays ``prefetch_depth`` batches ahead: it pulls from the underlying
+iterator, converts, and (optionally) starts the host->device transfer via
+``jax.device_put``, so when the training loop asks for batch k the
+transfer of batch k is already in flight while the device still computes
+batch k-1.  JAX's async dispatch does the rest.
+
+Wraps any iterator with the ``has_next``/``next``/``reset`` protocol.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Optional
+
+import jax
+
+
+class PrefetchIterator:
+    """Double (or deeper) buffered wrapper around a DataSet iterator.
+
+    ``sharding``: optional jax sharding — batches are device_put with it
+    on the prefetch thread.  ``loop``: wrap around on exhaustion forever
+    (the GAN trainers' multi-epoch semantics); otherwise one pass.
+    """
+
+    def __init__(self, source, prefetch_depth: int = 2,
+                 sharding=None, loop: bool = False):
+        if prefetch_depth < 1:
+            raise ValueError("prefetch_depth must be >= 1")
+        self.source = source
+        self.sharding = sharding
+        self.loop = loop
+        self._q: queue.Queue = queue.Queue(maxsize=prefetch_depth)
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._worker, daemon=True)
+        self._thread.start()
+
+    def _convert(self, ds):
+        if self.sharding is not None:
+            return (jax.device_put(ds.features, self.sharding),
+                    jax.device_put(ds.labels, self.sharding))
+        return (ds.features, ds.labels)
+
+    def _worker(self):
+        try:
+            while not self._stop.is_set():
+                if not self.source.has_next():
+                    if self.loop:
+                        self.source.reset()
+                        continue
+                    break
+                item = self._convert(self.source.next())
+                while not self._stop.is_set():
+                    try:
+                        self._q.put(item, timeout=0.1)
+                        break
+                    except queue.Full:
+                        continue
+            self._q.put(None)  # sentinel: exhausted
+        except BaseException as e:  # surface decode errors to the consumer
+            self._q.put(e)
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        item = self._q.get()
+        if item is None:
+            raise StopIteration
+        if isinstance(item, BaseException):
+            raise item
+        return item
+
+    def close(self):
+        self._stop.set()
+        # drain so the worker's blocked put can finish
+        try:
+            while True:
+                self._q.get_nowait()
+        except queue.Empty:
+            pass
+        self._thread.join(timeout=5)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()

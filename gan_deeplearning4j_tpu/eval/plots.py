@@ -1,0 +1,59 @@
+"""Grid renderings — the notebook's visual-inspection artifacts as code.
+
+``gan.ipynb`` cells 7/10 render the generator's latent-grid samples as
+PNG mosaics: the 10x10 MNIST digit grid (``DCGAN_Generated_Images.png``)
+and the 50x50 insurance transaction-lattice grid
+(``DCGAN_Generated_Lattices.png``) — SURVEY.md §4.3 "visual inspection".
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+
+def tile_grid(samples: np.ndarray, rows: int, cols: int,
+              pad: int = 1) -> np.ndarray:
+    """[n, H, W] -> one [rows*(H+pad), cols*(W+pad)] mosaic (row-major)."""
+    n, h, w = samples.shape
+    if n < rows * cols:
+        raise ValueError(f"need {rows * cols} samples, got {n}")
+    out = np.zeros((rows * (h + pad) - pad, cols * (w + pad) - pad),
+                   dtype=samples.dtype)
+    for i in range(rows):
+        for j in range(cols):
+            out[i * (h + pad): i * (h + pad) + h,
+                j * (w + pad): j * (w + pad) + w] = samples[i * cols + j]
+    return out
+
+
+def save_grid_png(path: str, grid_csv_or_array, sample_shape,
+                  grid_edge: Optional[int] = None) -> str:
+    """Render a trainer grid dump (``{name}_out_{k}.csv``) to a PNG mosaic.
+
+    ``sample_shape``: (H, W) of one sample (28, 28 for MNIST; 4, 3 for the
+    insurance lattices).  ``grid_edge``: mosaic edge length (defaults to
+    sqrt of the sample count — the trainers dump n^2 rows).
+    """
+    import matplotlib
+
+    matplotlib.use("Agg")
+    import matplotlib.pyplot as plt
+
+    from gan_deeplearning4j_tpu.data import read_csv_matrix
+
+    arr = (read_csv_matrix(grid_csv_or_array)
+           if isinstance(grid_csv_or_array, str)
+           else np.asarray(grid_csv_or_array))
+    h, w = sample_shape
+    n = arr.shape[0]
+    edge = grid_edge or int(round(np.sqrt(n)))
+    mosaic = tile_grid(arr.reshape(n, h, w), edge, edge)
+    plt.figure(figsize=(max(4, edge * w / 28), max(4, edge * h / 28)))
+    plt.imshow(mosaic, cmap="gray", interpolation="nearest")
+    plt.axis("off")
+    plt.tight_layout(pad=0)
+    plt.savefig(path, dpi=150, bbox_inches="tight")
+    plt.close()
+    return path
