@@ -25,7 +25,7 @@ WARMUP = 3
 STEPS = 20
 # Bump when the measured step's methodology changes; a cached baseline
 # from another version is discarded and re-measured (apples to apples).
-METHODOLOGY_VERSION = 2  # v2: fused one-XLA-program protocol step
+METHODOLOGY_VERSION = 3  # v3: per-step host latent draws in the timed loop
 
 
 def protocol_step_time(device) -> float:
@@ -54,17 +54,27 @@ def protocol_step_time(device) -> float:
         labels = jax.device_put(
             np.eye(10, dtype=np.float32)[rng.randint(0, 10, BATCH)], device)
         ones = jnp.ones((BATCH, 1), dtype=jnp.float32)
-        y_dis = jnp.concatenate([ones, jnp.zeros((BATCH, 1), dtype=jnp.float32)])
+        # pre-softened target vectors (label softening is loop-invariant,
+        # dl4jGANComputerVision.java:384-385)
+        y_real = ones + 0.05 * jnp.asarray(rng.randn(BATCH, 1), jnp.float32)
+        y_fake = 0.05 * jnp.asarray(rng.randn(BATCH, 1), jnp.float32)
         key = jax.random.key(0)
 
+        def run_step(i, state):
+            # per-step latent draws, z ~ U[-1,1] (dl4jGANComputerVision.java:397,425)
+            z1 = jax.random.uniform(jax.random.fold_in(key, 2 * i), (BATCH, 2),
+                                    minval=-1.0, maxval=1.0)
+            z2 = jax.random.uniform(jax.random.fold_in(key, 2 * i + 1),
+                                    (BATCH, 2), minval=-1.0, maxval=1.0)
+            return step(state, jax.random.fold_in(key, 10_000 + i),
+                        real, labels, z1, z2, y_real, y_fake, ones)
+
         for i in range(WARMUP):
-            state, losses = step(state, jax.random.fold_in(key, i),
-                                 real, labels, y_dis, ones)
+            state, losses = run_step(i, state)
         jax.block_until_ready(losses)
         t0 = time.perf_counter()
         for i in range(WARMUP, WARMUP + STEPS):
-            state, losses = step(state, jax.random.fold_in(key, i),
-                                 real, labels, y_dis, ones)
+            state, losses = run_step(i, state)
         jax.block_until_ready(losses)
         return (time.perf_counter() - t0) / STEPS
 

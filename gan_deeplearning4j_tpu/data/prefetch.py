@@ -56,15 +56,23 @@ class PrefetchIterator:
                         continue
                     break
                 item = self._convert(self.source.next())
-                while not self._stop.is_set():
-                    try:
-                        self._q.put(item, timeout=0.1)
-                        break
-                    except queue.Full:
-                        continue
-            self._q.put(None)  # sentinel: exhausted
+                if not self._put_stop_aware(item):
+                    return
+            self._put_stop_aware(None)  # sentinel: exhausted
         except BaseException as e:  # surface decode errors to the consumer
-            self._q.put(e)
+            self._put_stop_aware(e)
+
+    def _put_stop_aware(self, item) -> bool:
+        """put() that gives up once close() sets the stop flag, so the
+        worker can never block forever on a full queue after the consumer
+        has stopped reading.  Returns False if stopped before enqueue."""
+        while not self._stop.is_set():
+            try:
+                self._q.put(item, timeout=0.1)
+                return True
+            except queue.Full:
+                continue
+        return False
 
     def __iter__(self):
         return self
@@ -84,6 +92,12 @@ class PrefetchIterator:
             while True:
                 self._q.get_nowait()
         except queue.Empty:
+            pass
+        # release any reader blocked in __next__ (the stopped worker will
+        # no longer deliver its exhaustion sentinel)
+        try:
+            self._q.put_nowait(None)
+        except queue.Full:
             pass
         self._thread.join(timeout=5)
 

@@ -134,9 +134,20 @@ class GANTrainer:
         # pads partitions; we keep shards exact instead).
         if config.n_devices is None:
             avail = len(jax.devices())
-            config.n_devices = max(
+            resolved = max(
                 d for d in range(1, avail + 1) if config.batch_size % d == 0
             )
+            if resolved < avail:
+                import logging
+
+                logging.getLogger(__name__).warning(
+                    "batch_size %d is not divisible by the %d attached "
+                    "devices; using a %d-device mesh (%d idle)",
+                    config.batch_size, avail, resolved, avail - resolved)
+            # don't mutate the caller's config object (a reused config would
+            # silently inherit this host's resolution)
+            config = dataclasses.replace(config, n_devices=resolved)
+            self.c = config
         # Fused mode (default for gradient_sync): the whole protocol
         # iteration is ONE jitted/SPMD program (train/fused_step.py) —
         # cross-graph syncs are free aliasing, state buffers donated.

@@ -72,8 +72,12 @@ class MetricsLogger:
         return list(self._records)
 
     def throughput(self, last_n: int = 100) -> float:
-        """Mean examples/sec over the last n recorded steps (ring-bounded)."""
+        """Steady-state examples/sec over the last n recorded steps: the
+        median, so the first step's XLA compile (orders of magnitude slower
+        than a steady step) cannot drag the estimate down."""
+        import statistics
+
         self.flush()
         recs = list(self._records)[-last_n:]
         vals = [r["examples_per_sec"] for r in recs if "examples_per_sec" in r]
-        return sum(vals) / len(vals) if vals else 0.0
+        return statistics.median(vals) if vals else 0.0
