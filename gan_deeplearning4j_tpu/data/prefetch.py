@@ -53,8 +53,9 @@ class PrefetchIterator:
                 if not self.source.has_next():
                     if self.loop:
                         self.source.reset()
-                        continue
-                    break
+                        if self.source.has_next():
+                            continue
+                    break  # exhausted (or empty even after reset)
                 item = self._convert(self.source.next())
                 if not self._put_stop_aware(item):
                     return

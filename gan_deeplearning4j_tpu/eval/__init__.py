@@ -6,6 +6,12 @@ lines 925-955) and cell 10 computes the insurance weighted AUROC plus the
 latent-grid lattice renderings (raw lines 1483-1516).
 """
 
+from gan_deeplearning4j_tpu.eval.fid import (
+    compute_fid,
+    fid_from_features,
+    frechet_distance,
+    generator_fid,
+)
 from gan_deeplearning4j_tpu.eval.metrics import (
     accuracy_from_predictions,
     auroc_from_predictions,
@@ -17,6 +23,10 @@ from gan_deeplearning4j_tpu.eval.metrics import (
 __all__ = [
     "accuracy_from_predictions",
     "auroc_from_predictions",
+    "compute_fid",
+    "fid_from_features",
+    "frechet_distance",
+    "generator_fid",
     "grid_to_lattices",
     "mnist_accuracy",
     "insurance_auroc",

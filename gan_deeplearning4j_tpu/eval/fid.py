@@ -1,0 +1,123 @@
+"""Fréchet distance between real and generated feature distributions.
+
+The BASELINE.json north-star metric names "generator FID at 10k steps".
+The standard FID recipe embeds both sets in an InceptionV3 pool3 space —
+unavailable offline — so this uses the accepted classifier-feature
+fallback: features from the penultimate layer of the trained transfer
+classifier (the reference's own evaluation network,
+dl4jGANComputerVision.java:322-351), Gaussian moments per set, Fréchet
+distance between the Gaussians:
+
+    FID = ||mu_r - mu_g||^2 + Tr(C_r + C_g - 2 (C_r C_g)^(1/2))
+
+The feature layer defaults to ``dis_dense_layer_6`` — the 1024-wide dense
+the classifier transfers from the discriminator (the same features the
+97.07% accuracy claim rests on, gan.ipynb raw line 373).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import numpy as np
+
+DEFAULT_FEATURE_LAYER = "dis_dense_layer_6"
+
+
+def _feature_fn(graph, layer: str):
+    """Per-(graph, layer) jitted forward, cached on the graph so repeated
+    extractions (real set then generated set) compile once."""
+    cache = graph.__dict__.setdefault("_fid_feature_jits", {})
+    if layer not in cache:
+        @jax.jit
+        def feats(params, xb):
+            values, _ = graph._forward(
+                params, {graph.input_names[0]: xb}, False, None)
+            return values[layer]
+
+        cache[layer] = feats
+    return cache[layer]
+
+
+def extract_features(graph, x: np.ndarray, layer: str = DEFAULT_FEATURE_LAYER,
+                     batch_size: int = 500) -> np.ndarray:
+    """Inference-mode activations of ``layer`` over ``x``, batched so the
+    whole set never has to be device-resident at once."""
+    import jax.numpy as jnp
+
+    feats = _feature_fn(graph, layer)
+    out = []
+    n = x.shape[0]
+    # fixed batch so one compile serves every slice; remainder pads + trims
+    for i in range(0, n, batch_size):
+        xb = np.asarray(x[i:i + batch_size], dtype=np.float32)
+        k = xb.shape[0]
+        if k < batch_size:
+            xb = np.concatenate(
+                [xb, np.zeros((batch_size - k, *xb.shape[1:]), np.float32)])
+        out.append(np.asarray(feats(graph.params, jnp.asarray(xb)))[:k])
+    return np.concatenate(out)
+
+
+def frechet_distance(mu1: np.ndarray, cov1: np.ndarray,
+                     mu2: np.ndarray, cov2: np.ndarray,
+                     eps: float = 1e-6) -> float:
+    """Fréchet distance between N(mu1, cov1) and N(mu2, cov2).
+
+    Tr((C1 C2)^1/2) is computed symmetrically as
+    Tr((C1^1/2 C2 C1^1/2)^1/2) via two Hermitian eigendecompositions —
+    numerically stable for PSD covariances and free of scipy.sqrtm's
+    non-symmetric iteration (and its deprecation churn)."""
+    diff = mu1 - mu2
+    # C1^1/2 by eigendecomposition (clip tiny negative eigenvalues)
+    w1, v1 = np.linalg.eigh(cov1 + np.eye(cov1.shape[0]) * eps)
+    sqrt_c1 = (v1 * np.sqrt(np.clip(w1, 0.0, None))) @ v1.T
+    inner = sqrt_c1 @ (cov2 + np.eye(cov2.shape[0]) * eps) @ sqrt_c1
+    # inner is PSD up to round-off; symmetrize before eigh
+    w2 = np.linalg.eigvalsh((inner + inner.T) / 2.0)
+    tr_sqrt = np.sqrt(np.clip(w2, 0.0, None)).sum()
+    return float(diff @ diff + np.trace(cov1) + np.trace(cov2)
+                 - 2.0 * tr_sqrt)
+
+
+def fid_from_features(feat_real: np.ndarray, feat_gen: np.ndarray) -> float:
+    mu_r = feat_real.mean(axis=0)
+    mu_g = feat_gen.mean(axis=0)
+    cov_r = np.cov(feat_real, rowvar=False)
+    cov_g = np.cov(feat_gen, rowvar=False)
+    return frechet_distance(mu_r, cov_r, mu_g, cov_g)
+
+
+def compute_fid(classifier, real: np.ndarray, generated: np.ndarray,
+                layer: str = DEFAULT_FEATURE_LAYER,
+                batch_size: int = 500) -> float:
+    """FID of ``generated`` against ``real`` in the classifier's feature
+    space.  Both arrays are [N, num_features] in the data domain ([0,1]
+    pixels for MNIST)."""
+    f_r = extract_features(classifier, real, layer, batch_size)
+    f_g = extract_features(classifier, generated, layer, batch_size)
+    return fid_from_features(f_r, f_g)
+
+
+def generator_fid(gen, classifier, real: np.ndarray, n_samples: int,
+                  z_size: int = 2, seed: int = 666,
+                  layer: str = DEFAULT_FEATURE_LAYER,
+                  batch_size: int = 500,
+                  rng: Optional[np.random.RandomState] = None) -> float:
+    """End-to-end generator FID: synthesize ``n_samples`` images from
+    z ~ U[-1,1]^z (the training latent law, dl4jGANComputerVision.java:397)
+    and score them against ``real``."""
+    import jax.numpy as jnp
+
+    rng = rng or np.random.RandomState(seed)
+    num_features = int(np.prod(real.shape[1:]))
+    chunks = []
+    for i in range(0, n_samples, batch_size):
+        k = min(batch_size, n_samples - i)
+        z = rng.rand(batch_size, z_size).astype(np.float32) * 2.0 - 1.0
+        out = gen.output(jnp.asarray(z))[0]
+        chunks.append(np.asarray(out).reshape(batch_size, num_features)[:k])
+    generated = np.concatenate(chunks)
+    return compute_fid(classifier, real.reshape(-1, num_features), generated,
+                       layer, batch_size)

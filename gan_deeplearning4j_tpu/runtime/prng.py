@@ -30,23 +30,3 @@ def fold_in_index(key: jax.Array, index) -> jax.Array:
     """Per-replica/per-step stream from a traced integer (e.g.
     ``lax.axis_index`` inside ``shard_map``)."""
     return jax.random.fold_in(key, index)
-
-
-class KeySequence:
-    """Stateful convenience wrapper: `next(seq)` yields fresh subkeys.
-
-    Host-side only (do not use inside jit); inside jitted steps thread keys
-    explicitly.
-    """
-
-    def __init__(self, key: jax.Array):
-        self._key = key
-
-    def __next__(self) -> jax.Array:
-        self._key, sub = jax.random.split(self._key)
-        return sub
-
-    def take(self, n: int):
-        keys = jax.random.split(self._key, n + 1)
-        self._key = keys[0]
-        return list(keys[1:])

@@ -82,6 +82,11 @@ def main(argv=None) -> Dict[str, float]:
     p.add_argument("--resume", action="store_true")
     p.add_argument("--n-train", type=int, default=60000)
     p.add_argument("--n-test", type=int, default=10000)
+    p.add_argument("--profile", default=None, metavar="DIR",
+                   help="capture a jax.profiler trace of the run into DIR")
+    p.add_argument("--fid-samples", type=int, default=10000,
+                   help="generator samples for the end-of-run FID "
+                        "(0 disables)")
     args = p.parse_args(argv)
 
     config = default_config(
@@ -98,9 +103,46 @@ def main(argv=None) -> Dict[str, float]:
     )
     trainer = GANTrainer(CVWorkload(n_train=args.n_train, n_test=args.n_test),
                          config)
-    result = trainer.train()
+    from gan_deeplearning4j_tpu.utils import maybe_trace
+
+    with maybe_trace(args.profile):
+        result = trainer.train()
+    result.update(evaluate(trainer, fid_samples=args.fid_samples))
     print(result)
     return result
+
+
+def evaluate(trainer: GANTrainer, fid_samples: int = 10000) -> Dict[str, float]:
+    """End-of-run evaluation: the notebook's cell-7 accuracy over the final
+    prediction dump, generator FID (BASELINE.json metric), and the 10x10
+    digit-grid PNG (gan.ipynb cell 7's visual artifact)."""
+    import os
+
+    from gan_deeplearning4j_tpu.data import datasets
+    from gan_deeplearning4j_tpu.eval import fid as fid_lib
+    from gan_deeplearning4j_tpu.eval import metrics as metrics_lib
+    from gan_deeplearning4j_tpu.eval.plots import save_grid_png
+
+    c = trainer.c
+    out: Dict[str, float] = {}
+    step = trainer.batch_counter
+    pred_csv = os.path.join(
+        c.res_path, f"{c.dataset_name}_test_predictions_{step}.csv")
+    test_csv = os.path.join(c.res_path, "mnist_test.csv")
+    if os.path.exists(pred_csv) and os.path.exists(test_csv):
+        out["test_accuracy"] = metrics_lib.mnist_accuracy(pred_csv, test_csv)
+    grid_csv = os.path.join(c.res_path, f"{c.dataset_name}_out_{step}.csv")
+    if os.path.exists(grid_csv):
+        save_grid_png(
+            os.path.join(c.res_path, "DCGAN_Generated_Images.png"),
+            grid_csv, (28, 28))
+    if fid_samples and os.path.exists(test_csv):
+        real, _ = datasets.load_split(test_csv, c.label_index)
+        out["fid"] = fid_lib.generator_fid(
+            trainer.gen, trainer.classifier,
+            real[:fid_samples].astype("float32"), n_samples=fid_samples,
+            z_size=c.z_size)
+    return out
 
 
 if __name__ == "__main__":

@@ -30,7 +30,13 @@ from gan_deeplearning4j_tpu.runtime import prng
 
 
 class ProtocolState(NamedTuple):
-    """All four graphs' learnable state, one donated pytree."""
+    """All four graphs' learnable state, one donated pytree.
+
+    ``it`` is the step counter AS A DEVICE SCALAR: the fused step derives
+    its per-step PRNG streams from it and increments it in-place, so the
+    host never ships a scalar argument per step (a host->device scalar
+    transfer costs milliseconds over a tunneled PJRT link — it would
+    dominate the step)."""
 
     dis_params: Dict
     dis_opt: Dict
@@ -39,6 +45,7 @@ class ProtocolState(NamedTuple):
     clf_params: Dict
     clf_opt: Dict
     gen_params: Dict
+    it: jax.Array
 
 
 def _apply_sync(dst_params: Dict, src_params: Dict, mapping) -> Dict:
@@ -60,19 +67,38 @@ def make_protocol_step(
     mesh: Optional[Mesh] = None,
     axis: str = "data",
     donate: bool = True,
+    data_on_device: bool = False,
 ):
     """Build the fused step:
-    (state, rng, real, labels, z1, z2, y_real, y_fake, ones) ->
+    (state, real, labels, z_key, rng_key, y_real, y_fake, ones) ->
     (state', (d_loss, g_loss, clf_loss)).
 
-    ``real``/``labels`` are the per-iteration batch; ``z1``/``z2`` the
-    host-drawn latent batches for the D- and G-steps (drawn outside so the
-    fused and unfused paths share PRNG semantics and single-device ==
-    multi-device parity holds exactly); ``y_real``/``y_fake``/``ones`` the
-    (pre-softened, loop-invariant) target vectors.  ``rng`` only feeds
-    dropout streams.
+    The per-iteration host work is ONE dispatch: the step index lives in
+    ``state.it`` (device scalar, incremented by the program itself), and
+    the latent draws and all per-step key folding happen inside the XLA
+    program, derived from it (z1 = U[-1,1] under fold_in(z_key, 2*it),
+    z2 under fold_in(z_key, 2*it+1) — the same counter-based stream the
+    unfused trainer path uses, so fused == unfused numerically).
+    ``y_real``/``y_fake``/``ones`` are the loop-invariant (pre-softened)
+    GLOBAL-batch target vectors and ``z_key``/``rng_key`` (dropout) the
+    base keys — all loop-invariant, but passed as ARGUMENTS, not closed
+    over: on a tunneled PJRT backend, closure-captured device constants
+    cost milliseconds per execution, argument buffers microseconds.
+    Python scalars must never be per-step arguments for the same reason.
+
+    ``data_on_device``: ``real``/``labels`` are the ENTIRE device-resident
+    training set; the program slices batch ``it % (rows // batch)`` itself
+    (HBM is the right home for a dataset that fits — no per-step
+    host->device traffic at all).  The floor-division drops the partial
+    epoch tail, which is exactly the streaming loop's skip-and-wrap
+    semantics (dl4jGANComputerVision.java:524-526).
+
+    Under a mesh, every replica draws the full global z and slices its
+    own shard — bitwise identical to the single-device draw, so
+    single-device == multi-device parity holds exactly.
     """
     axis_name = axis if mesh is not None else None
+    n_shards = mesh.shape[axis] if mesh is not None else 1
 
     def reduce(loss, updates, grads):
         if axis_name is None:
@@ -80,20 +106,44 @@ def make_protocol_step(
         return (lax.pmean(loss, axis_name), lax.pmean(updates, axis_name),
                 lax.pmean(grads, axis_name))
 
-    def step(state: ProtocolState, rng, real, labels, z1, z2, y_real, y_fake,
-             ones):
-        B = real.shape[0]
+    def step(state: ProtocolState, real, labels, z_key, rng_key,
+             y_real, y_fake, ones):
+        global_batch = ones.shape[0]  # ones is replicated, so global
+        step_idx = state.it
+        if data_on_device:
+            # slice this step's (local) batch out of the resident dataset
+            n_batches = real.shape[0] // global_batch
+            local_b = global_batch // n_shards
+            off = (step_idx % n_batches) * global_batch
+            if axis_name is not None:
+                off = off + lax.axis_index(axis_name) * local_b
+            real = lax.dynamic_slice_in_dim(real, off, local_b)
+            labels = lax.dynamic_slice_in_dim(labels, off, local_b)
+        B = real.shape[0]  # local shard under a mesh, global otherwise
+        rng = jax.random.fold_in(rng_key, step_idx + 1)
+        z1 = jax.random.uniform(
+            jax.random.fold_in(z_key, 2 * step_idx),
+            (global_batch, z_size), minval=-1.0, maxval=1.0)
+        z2 = jax.random.uniform(
+            jax.random.fold_in(z_key, 2 * step_idx + 1),
+            (global_batch, z_size), minval=-1.0, maxval=1.0)
+        yr, yf, on = y_real, y_fake, ones
         if axis_name is not None:
-            rng = prng.fold_in_index(rng, lax.axis_index(axis_name))
+            idx = lax.axis_index(axis_name)
+            rng = prng.fold_in_index(rng, idx)
+            off = idx * B
+            z1, z2, yr, yf, on = (
+                lax.dynamic_slice_in_dim(a, off, B)
+                for a in (z1, z2, yr, yf, on))
         # (1) D-step on [real; G(z)] — generator runs inference mode.
-        # y_real/y_fake are sharded separately and concatenated LOCALLY so
+        # y_real/y_fake are sliced per shard and concatenated LOCALLY so
         # each shard's label halves align with its own [real; fake] halves
         # (a globally pre-concatenated label vector would misalign).
         fake_vals, _ = gen._forward(
             state.gen_params, {gen.input_names[0]: z1}, False, None)
         fake = fake_vals[gen.output_names[0]].reshape(B, num_features)
         x = jnp.concatenate([real, fake])
-        y_dis = jnp.concatenate([y_real, y_fake])
+        y_dis = jnp.concatenate([yr, yf])
         dis_params, dis_opt, d_loss = dis._train_step(
             state.dis_params, state.dis_opt, prng.stream(rng, "d"),
             {dis.input_names[0]: x}, {dis.output_names[0]: y_dis},
@@ -103,7 +153,7 @@ def make_protocol_step(
         # (3) G-step through the stacked graph
         gan_params, gan_opt, g_loss = gan._train_step(
             gan_params, state.gan_opt, prng.stream(rng, "g"),
-            {gan.input_names[0]: z2}, {gan.output_names[0]: ones},
+            {gan.input_names[0]: z2}, {gan.output_names[0]: on},
             reduce, axis_name)
         # (4) gan generator -> standalone gen
         gen_params = _apply_sync(state.gen_params, gan_params, gan_to_gen)
@@ -116,28 +166,33 @@ def make_protocol_step(
             reduce, axis_name)
         new_state = ProtocolState(
             dis_params, dis_opt, gan_params, gan_opt,
-            clf_params, clf_opt, gen_params)
+            clf_params, clf_opt, gen_params, step_idx + 1)
         return new_state, (d_loss, g_loss, c_loss)
 
     if mesh is None:
         return jax.jit(step, donate_argnums=(0,) if donate else ())
 
+    # with a device-resident dataset every replica holds the full table and
+    # slices its own shard; streaming batches arrive pre-sharded
+    data_spec = P() if data_on_device else P(axis)
     sharded = shard_map(
         step,
         mesh=mesh,
-        # state + rng replicated; real, labels, z1, z2, y_real, y_fake,
-        # ones batch-sharded
-        in_specs=(P(), P()) + (P(axis),) * 7,
+        # state (incl. device step counter), keys and global target
+        # vectors replicated; real, labels batch-sharded (or resident)
+        in_specs=(P(), data_spec, data_spec, P(), P(), P(), P(), P()),
         out_specs=(P(), P()),
         check_vma=False,
     )
     return jax.jit(sharded, donate_argnums=(0,) if donate else ())
 
 
-def state_from_graphs(dis, gen, gan, classifier) -> ProtocolState:
+def state_from_graphs(dis, gen, gan, classifier,
+                      start_step: int = 0) -> ProtocolState:
     return ProtocolState(
         dis.params, dis.opt_state, gan.params, gan.opt_state,
-        classifier.params, classifier.opt_state, gen.params)
+        classifier.params, classifier.opt_state, gen.params,
+        jnp.asarray(start_step, jnp.int32))
 
 
 def state_to_graphs(state: ProtocolState, dis, gen, gan, classifier) -> None:

@@ -32,6 +32,7 @@ from __future__ import annotations
 
 import dataclasses
 import os
+import time
 from typing import Callable, Dict, Optional
 
 import jax
@@ -73,6 +74,11 @@ class GANTrainerConfig:
     dp_mode: str = "gradient_sync"
     averaging_frequency: int = 1
     fused: bool = True                # one-XLA-program protocol iteration
+    # Keep the whole training set in HBM and let the fused step slice its
+    # own batches from the device counter — zero per-step host->device
+    # traffic.  None = auto: on when fused and the table fits comfortably.
+    data_on_device: Optional[bool] = None
+    data_on_device_max_bytes: int = 2 << 30
     # -- new capabilities over the reference --
     checkpoint_every: int = 0         # 0 = end-of-run models only
     checkpoint_keep: int = 3
@@ -148,24 +154,40 @@ class GANTrainer:
             # silently inherit this host's resolution)
             config = dataclasses.replace(config, n_devices=resolved)
             self.c = config
+        # PRNG streams (seed 666 discipline; see runtime/prng.py).  The
+        # training z-stream is COUNTER-BASED — z1 under fold_in(base, 2i),
+        # z2 under fold_in(base, 2i+1) for step i — so the fused step can
+        # derive it on-device from the step index alone and resume needs no
+        # saved RNG state.
+        root = prng.root_key(config.seed)
+        self._z_base = prng.stream(root, "train-z")
+        self._fused_rng = prng.stream(root, "fused-step")
+        # label softening: sampled once, reused every iteration (reference
+        # quirk — dl4jGANComputerVision.java:384-385)
+        B = config.batch_size
+        self.soften_real = 0.05 * jax.random.normal(
+            prng.stream(root, "soften-real"), (B, 1), dtype=jnp.float32)
+        self.soften_fake = 0.05 * jax.random.normal(
+            prng.stream(root, "soften-fake"), (B, 1), dtype=jnp.float32)
+        self._ones = jnp.ones((B, 1), dtype=jnp.float32)
+
         # Fused mode (default for gradient_sync): the whole protocol
         # iteration is ONE jitted/SPMD program (train/fused_step.py) —
-        # cross-graph syncs are free aliasing, state buffers donated.
+        # cross-graph syncs are free aliasing, state buffers donated, and
+        # the per-step host work is a single dispatch on the step index.
         # param_averaging keeps the unfused per-fit path (its job-level
         # broadcast/average semantics are inherently per-network).
         self._fused_step = None
+        self._fused_enabled = (
+            config.fused and config.dp_mode == "gradient_sync")
         mesh = data_mesh(config.n_devices) if config.n_devices > 1 else None
-        if config.fused and config.dp_mode == "gradient_sync":
+        self._mesh = mesh
+        if self._fused_enabled:
             from gan_deeplearning4j_tpu.train import fused_step as fused
 
             self._fused_lib = fused
-            self._fused_step = fused.make_protocol_step(
-                self.dis, self.gen, self.gan, self.classifier,
-                workload.dis_to_gan, workload.gan_to_gen,
-                workload.dis_to_classifier,
-                z_size=config.z_size, num_features=config.num_features,
-                mesh=mesh,
-            )
+            # the step itself is built in train(): it is specialized on the
+            # data_on_device residency decision, which needs the dataset
             self._batch_sharding = (
                 mesh_lib.batch_sharding(mesh) if mesh is not None else None)
         elif config.n_devices == 1:
@@ -193,18 +215,6 @@ class GANTrainer:
             )
             if config.checkpoint_every else None
         )
-
-        # PRNG streams (seed 666 discipline; see runtime/prng.py)
-        root = prng.root_key(config.seed)
-        self._z_keys = prng.KeySequence(prng.stream(root, "train-z"))
-        self._fused_rng = prng.stream(root, "fused-step")
-        # label softening: sampled once, reused every iteration (reference
-        # quirk — dl4jGANComputerVision.java:384-385)
-        B = config.batch_size
-        self.soften_real = 0.05 * jax.random.normal(
-            prng.stream(root, "soften-real"), (B, 1), dtype=jnp.float32)
-        self.soften_fake = 0.05 * jax.random.normal(
-            prng.stream(root, "soften-fake"), (B, 1), dtype=jnp.float32)
 
         # latent evaluation grid: the cartesian product of linspace(-1,1,n)
         # per latent dim, row-major with the first dim outermost — reference
@@ -254,11 +264,12 @@ class GANTrainer:
 
     def _maybe_checkpoint(self) -> None:
         if self.checkpointer and self.batch_counter % self.c.checkpoint_every == 0:
+            # no RNG state needed: the z-stream is counter-based, derived
+            # from batch_counter (the checkpoint step) alone
             self.checkpointer.save(
                 self.batch_counter, self._graphs(),
                 extra={"soften_real": self.soften_real,
-                       "soften_fake": self.soften_fake,
-                       "z_key": jax.random.key_data(self._z_keys._key)},
+                       "soften_fake": self.soften_fake},
             )
 
     def _maybe_resume(self, iter_train: RecordReaderDataSetIterator) -> None:
@@ -269,7 +280,8 @@ class GANTrainer:
         self.batch_counter = step
         self.soften_real = jnp.asarray(extra["soften_real"])
         self.soften_fake = jnp.asarray(extra["soften_fake"])
-        self._z_keys._key = jax.random.wrap_key_data(jnp.asarray(extra["z_key"]))
+        # (older checkpoints carried a "z_key" entry; the z-stream is now
+        # counter-based and needs no restored state)
         # Fast-forward the data iterator (views, cheap), replaying the
         # training loop's exact consumption pattern: partial epoch tails are
         # consumed-and-skipped WITHOUT counting as a step, and exhaustion
@@ -297,96 +309,90 @@ class GANTrainer:
             test_csv, c.batch_size_pred, c.label_index, c.num_classes)
         self._maybe_resume(iter_train)
 
-        B = c.batch_size
-        ones = jnp.ones((B, 1), dtype=jnp.float32)
-        zeros = jnp.zeros((B, 1), dtype=jnp.float32)
-        y_dis = jnp.concatenate([ones + self.soften_real,
-                                 zeros + self.soften_fake])
+        ones = self._ones
+        y_dis = jnp.concatenate([ones + self.soften_real, self.soften_fake])
 
         fused_state = None
         start_counter = self.batch_counter
-        if self._fused_step is not None:
+        self._steady_t0 = None
+        self._steady_start_step = start_counter
+        resident = self._fused_enabled and self._resident_data_ok(iter_train)
+        if self._fused_enabled:
+            if self._fused_step is None:
+                self._fused_step = self._fused_lib.make_protocol_step(
+                    self.dis, self.gen, self.gan, self.classifier,
+                    self.w.dis_to_gan, self.w.gan_to_gen,
+                    self.w.dis_to_classifier,
+                    z_size=c.z_size, num_features=c.num_features,
+                    mesh=self._mesh, data_on_device=resident,
+                )
+            # loop-invariant step arguments, device-resident once
+            self._fused_invariants = (
+                self._z_base, self._fused_rng,
+                ones + self.soften_real, self.soften_fake, ones)
             fused_state = self._fused_lib.state_from_graphs(
-                self.dis, self.gen, self.gan, self.classifier)
+                self.dis, self.gen, self.gan, self.classifier,
+                start_step=self.batch_counter)
 
-        while iter_train.has_next() and self.batch_counter < c.num_iterations:
-            ds = iter_train.next()
-            if ds.num_examples() < B:   # partial epoch tail: wrap like :524
-                iter_train.reset()
-                continue
-            real = jnp.asarray(ds.features)
-            labels = jnp.asarray(ds.labels)
-
-            if self._fused_step is not None:
-                # the whole iteration — D-step, syncs, G-step, classifier —
-                # is one donated-state XLA program; z drawn host-side from
-                # the same stream as the unfused path
-                z1 = jax.random.uniform(next(self._z_keys), (B, c.z_size),
-                                        minval=-1.0, maxval=1.0)
-                z2 = jax.random.uniform(next(self._z_keys), (B, c.z_size),
-                                        minval=-1.0, maxval=1.0)
-                if self._batch_sharding is not None:
-                    real = jax.device_put(real, self._batch_sharding)
-                    labels = jax.device_put(labels, self._batch_sharding)
-                rng = jax.random.fold_in(self._fused_rng, self.batch_counter + 1)
-                fused_state, (d_loss, g_loss, c_loss) = self._fused_step(
-                    fused_state, rng, real, labels, z1, z2,
-                    ones + self.soften_real, zeros + self.soften_fake, ones)
+        if resident:
+            # the whole training table lives in HBM; the fused step slices
+            # its own batches from the device counter — no per-step
+            # host->device traffic and no host data loop at all.  Under a
+            # mesh, place it replicated ONCE (an uncommitted single-device
+            # array would be re-broadcast by jit every step).
+            if self._mesh is not None:
+                rep = jax.sharding.NamedSharding(
+                    self._mesh, jax.sharding.PartitionSpec())
+                dev_features = jax.device_put(iter_train.features, rep)
+                dev_labels = jax.device_put(iter_train.labels, rep)
             else:
-                # (1) D-step on [real(1+eps), fake(0+eps)]
-                z = jax.random.uniform(next(self._z_keys), (B, c.z_size),
-                                       minval=-1.0, maxval=1.0)
-                fake = self.gen.output(z)[0].reshape(B, c.num_features)
-                d_loss = self._fit_dis(jnp.concatenate([real, fake]), y_dis)
+                dev_features = jnp.asarray(iter_train.features)
+                dev_labels = jnp.asarray(iter_train.labels)
+            self._resident_loop(dev_features, dev_labels, iter_test,
+                                fused_state, log)
+        else:
+            # Background prefetch (SURVEY.md §3.2 hot-loop note: the
+            # reference decodes CSV on the training thread every iteration
+            # — here a worker thread decodes AND starts the host->device
+            # transfer for batch k+depth while the device computes batch
+            # k).  The fused path transfers straight to its batch
+            # sharding; other paths keep host arrays (DataParallelGraph
+            # owns their placement).
+            from gan_deeplearning4j_tpu.data.prefetch import PrefetchIterator
 
-                # (2) dis -> gan frozen tail (weights + BN running stats)
-                sync_params(self.gan, self.dis, self.w.dis_to_gan)
+            sharding = None
+            if self._fused_step is not None:
+                sharding = self._batch_sharding
+                if sharding is None:
+                    sharding = jax.sharding.SingleDeviceSharding(
+                        jax.devices()[0])
+            prefetch = PrefetchIterator(
+                iter_train, prefetch_depth=2, sharding=sharding, loop=True)
+            try:
+                self._train_loop(prefetch, iter_test, fused_state, ones,
+                                 y_dis, log)
+            finally:
+                prefetch.close()
 
-                # (3) G-step: fool the frozen discriminator
-                z = jax.random.uniform(next(self._z_keys), (B, c.z_size),
-                                       minval=-1.0, maxval=1.0)
-                g_loss = self._fit_gan(z, ones)
-
-                # (4) gan generator -> standalone gen
-                sync_params(self.gen, self.gan, self.w.gan_to_gen)
-
-                # (5) classifier: dis features, fit on the real labeled batch
-                sync_params(self.classifier, self.dis, self.w.dis_to_classifier)
-                c_loss = self._fit_clf(real, labels)
-
-            self.batch_counter += 1
-            self.metrics.log_step(
-                self.batch_counter, examples=B,
-                d_loss=d_loss, g_loss=g_loss, classifier_loss=c_loss,
-            )
-            if self.batch_counter % 100 == 0:
-                log(f"Completed Batch {self.batch_counter}!")
-
-            if self._fused_step is not None and (
-                self.batch_counter % c.print_every == 0
-                or self.batch_counter % c.save_every == 0
-                or (c.checkpoint_every
-                    and self.batch_counter % c.checkpoint_every == 0)):
-                # artifact/checkpoint points read through the graph objects
-                self._fused_lib.state_to_graphs(
-                    fused_state, self.dis, self.gen, self.gan, self.classifier)
-
-            if self.batch_counter % c.print_every == 0:
-                self._dump_grid()
-            if self.batch_counter % c.save_every == 0:
-                self._dump_predictions(iter_test)
-            if self.c.checkpoint_every:
-                self._maybe_checkpoint()
-
-            if not iter_train.has_next():
-                iter_train.reset()
-
-        if self._fused_step is not None and fused_state is not None:
+        if self._fused_step is not None and self._final_state is not None:
             self._fused_lib.state_to_graphs(
-                fused_state, self.dis, self.gen, self.gan, self.classifier)
+                self._final_state, self.dis, self.gen, self.gan,
+                self.classifier)
             if self.batch_counter > start_counter:
+                d_loss, g_loss, c_loss = self._final_losses
                 self.dis.score, self.gan.score = d_loss, g_loss
                 self.classifier.score = c_loss
+
+        # steady-state throughput: wall clock from the post-compile mark to
+        # the last step's completion (async per-step timestamps measure
+        # dispatch, not the device)
+        if self._final_losses is not None:
+            jax.block_until_ready(self._final_losses)
+        steady = None
+        steps_timed = self.batch_counter - self._steady_start_step
+        if self._steady_t0 is not None and steps_timed > 0:
+            steady = steps_timed * c.batch_size / (
+                time.perf_counter() - self._steady_t0)
 
         # end-of-run model zips, exactly the reference's four files (:529-533)
         name = c.dataset_name
@@ -403,7 +409,126 @@ class GANTrainer:
         self.metrics.flush()
         return {
             "steps": self.batch_counter,
-            "examples_per_sec": self.metrics.throughput(),
+            "examples_per_sec": (
+                steady if steady is not None else self.metrics.throughput()),
             "d_loss": float(self.dis.score),
             "g_loss": float(self.gan.score),
         }
+
+    def _z(self, i: int, which: int) -> jax.Array:
+        """Counter-based training latent: z ~ U[-1,1]^z for step ``i``
+        (``which`` 0 = D-step draw, 1 = G-step draw) — the same stream the
+        fused step derives on-device from the step index."""
+        key = jax.random.fold_in(self._z_base, 2 * i + which)
+        return jax.random.uniform(
+            key, (self.c.batch_size, self.c.z_size), minval=-1.0, maxval=1.0)
+
+    def _resident_data_ok(self, iter_train) -> bool:
+        """Decide the device-resident data path (config override, else
+        auto: the table must hold at least one full batch and fit the
+        byte budget)."""
+        c = self.c
+        if iter_train.num_examples() < c.batch_size:
+            return False
+        if c.data_on_device is not None:
+            return bool(c.data_on_device)
+        size = iter_train.features.nbytes + iter_train.labels.nbytes
+        return size <= c.data_on_device_max_bytes
+
+    def _resident_loop(self, features, labels, iter_test, fused_state,
+                       log) -> None:
+        """Hot loop of the device-resident data path: nothing per step but
+        the fused-step dispatch — batch slicing, latent draws and the step
+        counter all live on device."""
+        self._final_state, self._final_losses = fused_state, None
+        while self.batch_counter < self.c.num_iterations:
+            fused_state, (d_loss, g_loss, c_loss) = self._fused_step(
+                fused_state, features, labels, *self._fused_invariants)
+            self._final_state = fused_state
+            self._final_losses = (d_loss, g_loss, c_loss)
+            self._mark_steady(d_loss)
+            self._step_bookkeeping(iter_test, d_loss, g_loss, c_loss, log)
+
+    def _mark_steady(self, loss) -> None:
+        """After the FIRST step of a run (the one that pays the XLA
+        compile), block once and start the steady-state wall clock —
+        per-step host timestamps in an async-dispatch loop measure
+        dispatch, not device time."""
+        if self._steady_t0 is None:
+            jax.block_until_ready(loss)
+            self._steady_t0 = time.perf_counter()
+            self._steady_start_step = self.batch_counter + 1
+
+    def _train_loop(self, prefetch, iter_test, fused_state, ones, y_dis,
+                    log) -> None:
+        c = self.c
+        B = c.batch_size
+        self._final_state, self._final_losses = fused_state, None
+        while self.batch_counter < c.num_iterations:
+            try:
+                features, labels = next(prefetch)
+            except StopIteration:   # dataset empty even after reset
+                break
+            if features.shape[0] < B:  # partial epoch tail: wrap like :524
+                continue
+            real = jnp.asarray(features)
+            labels = jnp.asarray(labels)
+
+            if self._fused_step is not None:
+                # the whole iteration — D-step, syncs, G-step, classifier,
+                # latent draws, step-counter bump — is one donated-state
+                # XLA program; the only per-step host work is this dispatch
+                fused_state, (d_loss, g_loss, c_loss) = self._fused_step(
+                    fused_state, real, labels, *self._fused_invariants)
+                self._final_state = fused_state
+                self._final_losses = (d_loss, g_loss, c_loss)
+                self._mark_steady(d_loss)
+            else:
+                # (1) D-step on [real(1+eps), fake(0+eps)]
+                z = self._z(self.batch_counter, 0)
+                fake = self.gen.output(z)[0].reshape(B, c.num_features)
+                d_loss = self._fit_dis(jnp.concatenate([real, fake]), y_dis)
+
+                # (2) dis -> gan frozen tail (weights + BN running stats)
+                sync_params(self.gan, self.dis, self.w.dis_to_gan)
+
+                # (3) G-step: fool the frozen discriminator
+                z = self._z(self.batch_counter, 1)
+                g_loss = self._fit_gan(z, ones)
+
+                # (4) gan generator -> standalone gen
+                sync_params(self.gen, self.gan, self.w.gan_to_gen)
+
+                # (5) classifier: dis features, fit on the real labeled batch
+                sync_params(self.classifier, self.dis, self.w.dis_to_classifier)
+                c_loss = self._fit_clf(real, labels)
+                self._mark_steady(c_loss)
+
+            self._step_bookkeeping(iter_test, d_loss, g_loss, c_loss, log)
+
+    def _step_bookkeeping(self, iter_test, d_loss, g_loss, c_loss, log) -> None:
+        c = self.c
+        self.batch_counter += 1
+        self.metrics.log_step(
+            self.batch_counter, examples=c.batch_size,
+            d_loss=d_loss, g_loss=g_loss, classifier_loss=c_loss,
+        )
+        if self.batch_counter % 100 == 0:
+            log(f"Completed Batch {self.batch_counter}!")
+
+        if self._fused_step is not None and (
+            self.batch_counter % c.print_every == 0
+            or self.batch_counter % c.save_every == 0
+            or (c.checkpoint_every
+                and self.batch_counter % c.checkpoint_every == 0)):
+            # artifact/checkpoint points read through the graph objects
+            self._fused_lib.state_to_graphs(
+                self._final_state, self.dis, self.gen, self.gan,
+                self.classifier)
+
+        if self.batch_counter % c.print_every == 0:
+            self._dump_grid()
+        if self.batch_counter % c.save_every == 0:
+            self._dump_predictions(iter_test)
+        if c.checkpoint_every:
+            self._maybe_checkpoint()

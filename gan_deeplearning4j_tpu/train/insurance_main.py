@@ -86,6 +86,8 @@ def main(argv=None) -> Dict[str, float]:
     p.add_argument("--averaging-frequency", type=int, default=5)
     p.add_argument("--checkpoint-every", type=int, default=0)
     p.add_argument("--resume", action="store_true")
+    p.add_argument("--profile", default=None, metavar="DIR",
+                   help="capture a jax.profiler trace of the run into DIR")
     args = p.parse_args(argv)
 
     config = default_config(
@@ -101,9 +103,36 @@ def main(argv=None) -> Dict[str, float]:
         resume=args.resume,
     )
     trainer = GANTrainer(InsuranceWorkload(), config)
-    result = trainer.train()
+    from gan_deeplearning4j_tpu.utils import maybe_trace
+
+    with maybe_trace(args.profile):
+        result = trainer.train()
+    result.update(evaluate(trainer))
     print(result)
     return result
+
+
+def evaluate(trainer: GANTrainer) -> Dict[str, float]:
+    """End-of-run evaluation: the notebook's cell-10 weighted AUROC over
+    the final prediction dump plus the lattice-grid PNG (gan.ipynb raw
+    lines 1483-1516)."""
+    from gan_deeplearning4j_tpu.eval import metrics as metrics_lib
+    from gan_deeplearning4j_tpu.eval.plots import save_grid_png
+
+    c = trainer.c
+    out: Dict[str, float] = {}
+    step = trainer.batch_counter
+    pred_csv = os.path.join(
+        c.res_path, f"insurance_test_predictions_{step}.csv")
+    test_csv = os.path.join(c.res_path, "insurance_test.csv")
+    if os.path.exists(pred_csv) and os.path.exists(test_csv):
+        out["test_auroc"] = metrics_lib.insurance_auroc(pred_csv, test_csv)
+    grid_csv = os.path.join(c.res_path, f"insurance_out_{step}.csv")
+    if os.path.exists(grid_csv):
+        save_grid_png(
+            os.path.join(c.res_path, "DCGAN_Generated_Lattices.png"),
+            grid_csv, (4, 3))
+    return out
 
 
 if __name__ == "__main__":

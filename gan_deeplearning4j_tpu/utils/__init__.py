@@ -1,5 +1,6 @@
-"""Utilities: structured metrics/observability (SURVEY.md §5)."""
+"""Utilities: structured metrics/observability + tracing (SURVEY.md §5)."""
 
 from gan_deeplearning4j_tpu.utils.metrics import MetricsLogger
+from gan_deeplearning4j_tpu.utils.profiling import maybe_trace, summarize_trace
 
-__all__ = ["MetricsLogger"]
+__all__ = ["MetricsLogger", "maybe_trace", "summarize_trace"]

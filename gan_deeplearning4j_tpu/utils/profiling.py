@@ -1,0 +1,74 @@
+"""Tracing/profiling — the SURVEY.md §5 tracing row.
+
+The reference's only profiling hooks are ``CudaEnvironment...setVerbose(true)``
+(dl4jGANComputerVision.java:104) and the Spark UI that comes with the
+SparkContext (:309).  The TPU-native equivalent is a first-class
+jax.profiler integration: wrap any region in ``maybe_trace(dir)`` and a
+TensorBoard-loadable trace (XLA op timeline, HBM usage, host/device
+overlap) lands in ``dir``.  Every main and the benchmark expose it as a
+``--profile DIR`` flag.
+
+``summarize_trace(dir)`` extracts the top time sinks from the captured
+``.trace.json.gz`` so a run can report where its step time goes without
+leaving the terminal.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import glob
+import gzip
+import json
+import os
+from collections import defaultdict
+from typing import List, Optional, Tuple
+
+
+@contextlib.contextmanager
+def maybe_trace(trace_dir: Optional[str]):
+    """jax.profiler.trace(trace_dir) when a directory is given; no-op
+    (zero overhead) otherwise — so the flag can always be plumbed."""
+    if not trace_dir:
+        yield
+        return
+    import jax
+
+    os.makedirs(trace_dir, exist_ok=True)
+    with jax.profiler.trace(trace_dir):
+        yield
+
+
+def _trace_events(trace_dir: str) -> List[dict]:
+    """Load all chrome-trace events jax.profiler wrote under trace_dir."""
+    pattern = os.path.join(trace_dir, "**", "*.trace.json.gz")
+    events: List[dict] = []
+    for path in sorted(glob.glob(pattern, recursive=True)):
+        with gzip.open(path, "rt") as f:
+            events.extend(json.load(f).get("traceEvents", []))
+    return events
+
+
+def summarize_trace(trace_dir: str, top: int = 10,
+                    device_only: bool = True) -> List[Tuple[str, float]]:
+    """Top-``top`` (event name, total milliseconds) sinks in a captured
+    trace.  ``device_only`` keeps accelerator lanes when any exist (drops
+    host python); a pure-host trace falls back to all lanes."""
+    events = _trace_events(trace_dir)
+    pid_names = {}
+    for ev in events:
+        if ev.get("ph") == "M" and ev.get("name") == "process_name":
+            pid_names[ev.get("pid")] = ev.get("args", {}).get("name", "")
+
+    def is_device(lane: str) -> bool:
+        return any(k in lane.lower() for k in ("tpu", "/device", "gpu"))
+
+    have_device = any(is_device(n) for n in pid_names.values())
+    totals: "defaultdict[str, float]" = defaultdict(float)
+    for ev in events:
+        if ev.get("ph") != "X" or "dur" not in ev:
+            continue
+        lane = pid_names.get(ev.get("pid"), "")
+        if device_only and have_device and not is_device(lane):
+            continue
+        totals[ev["name"]] += ev["dur"] / 1000.0  # us -> ms
+    return sorted(totals.items(), key=lambda kv: -kv[1])[:top]

@@ -26,29 +26,23 @@ def _build():
 
 def _run(mesh, steps=3):
     dis, gen, gan, clf = _build()
+    B = 40
+    ones = jnp.ones((B, 1), dtype=jnp.float32)
+    zeros = jnp.zeros((B, 1), dtype=jnp.float32)
+    key = jax.random.key(7)
     step = fused.make_protocol_step(
         dis, gen, gan, clf,
         M.DIS_TO_GAN, M.GAN_TO_GEN, M.DIS_TO_CLASSIFIER,
         z_size=2, num_features=12, mesh=mesh, donate=False,
     )
+    # asymmetric softening so label misalignment cannot cancel out
+    inv = (key, jax.random.fold_in(key, 100), ones + 0.03, zeros - 0.01, ones)
     state = fused.state_from_graphs(dis, gen, gan, clf)
     rng_np = np.random.RandomState(0)
-    B = 40
-    ones = jnp.ones((B, 1), dtype=jnp.float32)
-    zeros = jnp.zeros((B, 1), dtype=jnp.float32)
-    # asymmetric softening so label misalignment cannot cancel out
-    y_real = ones + 0.03
-    y_fake = zeros - 0.01
-    key = jax.random.key(7)
-    for i in range(steps):
+    for _ in range(steps):
         real = jnp.asarray(rng_np.rand(B, 12).astype(np.float32))
         labels = jnp.asarray((rng_np.rand(B, 1) > 0.5).astype(np.float32))
-        z1 = jax.random.uniform(jax.random.fold_in(key, 2 * i), (B, 2),
-                                minval=-1.0, maxval=1.0)
-        z2 = jax.random.uniform(jax.random.fold_in(key, 2 * i + 1), (B, 2),
-                                minval=-1.0, maxval=1.0)
-        state, losses = step(state, jax.random.fold_in(key, 100 + i),
-                             real, labels, z1, z2, y_real, y_fake, ones)
+        state, losses = step(state, real, labels, *inv)
     return state, losses
 
 
