@@ -27,15 +27,21 @@ class PrefetchIterator:
     ``sharding``: optional jax sharding — batches are device_put with it
     on the prefetch thread.  ``loop``: wrap around on exhaustion forever
     (the GAN trainers' multi-epoch semantics); otherwise one pass.
+    ``min_rows``: skip batches with fewer rows BEFORE any device_put —
+    a partial epoch tail is not divisible by a mesh's batch sharding, so
+    it must be dropped on the host side (the reference's skip-and-wrap
+    tail semantics, dl4jGANComputerVision.java:524-526).
     """
 
     def __init__(self, source, prefetch_depth: int = 2,
-                 sharding=None, loop: bool = False):
+                 sharding=None, loop: bool = False,
+                 min_rows: Optional[int] = None):
         if prefetch_depth < 1:
             raise ValueError("prefetch_depth must be >= 1")
         self.source = source
         self.sharding = sharding
         self.loop = loop
+        self.min_rows = min_rows
         self._q: queue.Queue = queue.Queue(maxsize=prefetch_depth)
         self._stop = threading.Event()
         self._thread = threading.Thread(target=self._worker, daemon=True)
@@ -49,16 +55,24 @@ class PrefetchIterator:
 
     def _worker(self):
         try:
+            emitted_this_pass = 0
             while not self._stop.is_set():
                 if not self.source.has_next():
-                    if self.loop:
+                    # loop only if the pass produced something — a dataset
+                    # with no full batch must end in the sentinel, not spin
+                    if self.loop and emitted_this_pass:
                         self.source.reset()
+                        emitted_this_pass = 0
                         if self.source.has_next():
                             continue
-                    break  # exhausted (or empty even after reset)
-                item = self._convert(self.source.next())
+                    break  # exhausted (or empty/filtered-empty after reset)
+                ds = self.source.next()
+                if self.min_rows and ds.num_examples() < self.min_rows:
+                    continue  # partial tail: skip (wraps via has_next above)
+                item = self._convert(ds)
                 if not self._put_stop_aware(item):
                     return
+                emitted_this_pass += 1
             self._put_stop_aware(None)  # sentinel: exhausted
         except BaseException as e:  # surface decode errors to the consumer
             self._put_stop_aware(e)

@@ -47,7 +47,7 @@ def extract_features(graph, x: np.ndarray, layer: str = DEFAULT_FEATURE_LAYER,
     import jax.numpy as jnp
 
     feats = _feature_fn(graph, layer)
-    out = []
+    pending = []
     n = x.shape[0]
     # fixed batch so one compile serves every slice; remainder pads + trims
     for i in range(0, n, batch_size):
@@ -56,8 +56,12 @@ def extract_features(graph, x: np.ndarray, layer: str = DEFAULT_FEATURE_LAYER,
         if k < batch_size:
             xb = np.concatenate(
                 [xb, np.zeros((batch_size - k, *xb.shape[1:]), np.float32)])
-        out.append(np.asarray(feats(graph.params, jnp.asarray(xb)))[:k])
-    return np.concatenate(out)
+        pending.append((feats(graph.params, jnp.asarray(xb)), k))
+    # all batches dispatched; one overlapped readback
+    from gan_deeplearning4j_tpu.utils import overlap_device_get
+
+    pending = overlap_device_get(pending)
+    return np.concatenate([np.asarray(o)[:k] for o, k in pending])
 
 
 def frechet_distance(mu1: np.ndarray, cov1: np.ndarray,
@@ -112,12 +116,17 @@ def generator_fid(gen, classifier, real: np.ndarray, n_samples: int,
 
     rng = rng or np.random.RandomState(seed)
     num_features = int(np.prod(real.shape[1:]))
-    chunks = []
+    pending = []
     for i in range(0, n_samples, batch_size):
         k = min(batch_size, n_samples - i)
         z = rng.rand(batch_size, z_size).astype(np.float32) * 2.0 - 1.0
-        out = gen.output(jnp.asarray(z))[0]
-        chunks.append(np.asarray(out).reshape(batch_size, num_features)[:k])
-    generated = np.concatenate(chunks)
+        pending.append((gen.output(jnp.asarray(z))[0], k))
+    # all synthesis batches dispatched; one overlapped readback
+    from gan_deeplearning4j_tpu.utils import overlap_device_get
+
+    pending = overlap_device_get(pending)
+    generated = np.concatenate(
+        [np.asarray(o).reshape(batch_size, num_features)[:k]
+         for o, k in pending])
     return compute_fid(classifier, real.reshape(-1, num_features), generated,
                        layer, batch_size)

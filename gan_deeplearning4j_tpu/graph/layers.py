@@ -270,11 +270,14 @@ class BatchNorm(Layer):
         if train:
             from gan_deeplearning4j_tpu.ops import pallas as pallas_lib
 
-            if x.ndim == 2 and axis_name is None and pallas_lib.enabled():
+            if x.ndim == 2 and pallas_lib.enabled():
                 # fused Pallas path: BN + activation in one VMEM pass
+                # (under SPMD the moments pmean across the mesh axis
+                # between a moments kernel and an apply kernel — same
+                # sync-BN semantics as the XLA path below)
                 y, bmean, bvar = pallas_lib.fused_bn_act_train(
                     x, params["gamma"], params["beta"], self.eps,
-                    self.activation or "identity")
+                    self.activation or "identity", False, axis_name)
                 return y, {
                     "mean": self.decay * params["mean"] + (1 - self.decay) * bmean,
                     "var": self.decay * params["var"] + (1 - self.decay) * bvar,

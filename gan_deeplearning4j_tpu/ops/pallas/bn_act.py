@@ -1,22 +1,38 @@
-"""Pallas TPU kernel: fused train-mode BatchNorm + activation.
+"""Pallas TPU kernels: fused train-mode BatchNorm + activation.
 
 The north-star calls out batchnorm as a candidate for hand kernels where
 stock XLA lowering isn't enough (BASELINE.json; SURVEY.md §7 step 2).
 Train-mode BN is three HBM passes when unfused (reduce for mean, reduce
 for var, elementwise normalize); XLA usually fuses the elementwise tail
-but keeps separate reduction passes.  This kernel does the whole thing —
-E[x], E[x^2], normalize, scale/shift, activation — in ONE VMEM-resident
-pass per feature tile: the batch column block is loaded once, reduced and
-transformed in registers/VMEM, written once.
+but keeps separate reduction passes.
+
+Two execution paths, selected by ``axis_name``:
+
+* **Single device (axis_name=None)** — ONE kernel does everything:
+  E[x], E[x^2], normalize, scale/shift, activation in a single
+  VMEM-resident pass per feature tile.  The batch column block is loaded
+  from HBM once, reduced and transformed in registers/VMEM, written once.
+
+* **SPMD (axis_name given)** — batch moments are GLOBAL (sync-BN,
+  matching ops/batchnorm.py), so one fused pass is impossible: a
+  cross-replica ``pmean`` must sit between the moment reduction and the
+  normalization.  The kernel pair brackets it: ``_moments_kernel`` (one
+  pass: local E[x] and E[x^2] together — XLA tends to emit separate
+  reduce passes), then ``lax.pmean``, then ``_apply_kernel`` (one pass:
+  normalize + scale/shift + activation).  Two reads + one write of x —
+  the SPMD lower bound.
 
 Scope: 2-D [B, F] inputs (the models' heavy BNs — the generator's
 6272-wide and the dense 1024-wide layers — are 2-D; 4-D per-channel BN
-stays on the XLA path).  F is tiled in 128-lane blocks; B and F are
-padded to tile multiples and the result sliced back.
+stays on the XLA path: the flagship models' 4-D BNs are C=1 over
+28x28 maps, a shape XLA's column reduce already handles at bandwidth).
+F is tiled in 128-lane blocks; B and F are padded to tile multiples and
+the result sliced back.
 
 Gradients: ``jax.custom_vjp`` with a rematerializing backward through the
-plain-jnp reference composition — forward speed from Pallas, backward
-correctness from autodiff (Patterns: Custom VJP in the Pallas guide).
+plain-jnp reference composition (pmean included under SPMD) — forward
+speed from Pallas, backward correctness from autodiff (Patterns: Custom
+VJP in the Pallas guide).
 
 Enable via ``ops.pallas.enable(True)`` or env GAN4J_PALLAS=1; runs only
 on TPU (or anywhere with ``interpret=True`` for tests).
@@ -25,7 +41,6 @@ on TPU (or anywhere with ``interpret=True`` for tests).
 from __future__ import annotations
 
 import functools
-from typing import Tuple
 
 import jax
 import jax.numpy as jnp
@@ -38,8 +53,8 @@ LANE = 128
 SUBLANE = 8
 
 
-def _kernel(x_ref, gamma_ref, beta_ref, y_ref, mean_ref, var_ref, *,
-            eps: float, act_name: str, n_valid_rows: int):
+def _fused_kernel(x_ref, gamma_ref, beta_ref, y_ref, mean_ref, var_ref, *,
+                  eps: float, act_name: str, n_valid_rows: int):
     x = x_ref[:]                                   # [B_pad, TILE_F]
     # padded rows are zero; correct the moments by the true row count
     inv_n = 1.0 / n_valid_rows
@@ -53,6 +68,22 @@ def _kernel(x_ref, gamma_ref, beta_ref, y_ref, mean_ref, var_ref, *,
     var_ref[:] = var
 
 
+def _moments_kernel(x_ref, mean_ref, m2_ref, *, n_valid_rows: int):
+    """One pass: local E[x] and E[x^2] per feature lane (x read ONCE)."""
+    x = x_ref[:]
+    inv_n = 1.0 / n_valid_rows
+    mean_ref[:] = jnp.sum(x, axis=0, keepdims=True) * inv_n
+    m2_ref[:] = jnp.sum(x * x, axis=0, keepdims=True) * inv_n
+
+
+def _apply_kernel(x_ref, mean_ref, var_ref, gamma_ref, beta_ref, y_ref, *,
+                  eps: float, act_name: str):
+    """One pass: normalize by (given) global moments + scale/shift + act."""
+    y = (x_ref[:] - mean_ref[:]) * lax.rsqrt(var_ref[:] + eps)
+    y = y * gamma_ref[:] + beta_ref[:]
+    y_ref[:] = act_lib.get(act_name)(y)
+
+
 def _pad_to(x, rows, cols):
     pr, pc = rows - x.shape[0], cols - x.shape[1]
     if pr or pc:
@@ -60,65 +91,111 @@ def _pad_to(x, rows, cols):
     return x
 
 
-def _reference(x, gamma, beta, eps, act_name):
+def _reference(x, gamma, beta, eps, act_name, axis_name=None):
     mean = jnp.mean(x, axis=0)
-    var = jnp.mean(jnp.square(x), axis=0) - jnp.square(mean)
+    m2 = jnp.mean(jnp.square(x), axis=0)
+    if axis_name is not None:
+        mean = lax.pmean(mean, axis_name)
+        m2 = lax.pmean(m2, axis_name)
+    var = m2 - jnp.square(mean)
     y = (x - mean[None]) * lax.rsqrt(var[None] + eps)
     y = y * gamma[None] + beta[None]
     return act_lib.get(act_name)(y), mean, var
 
 
+def _row_spec(B_pad):
+    return pl.BlockSpec((B_pad, LANE), lambda i: (0, i))
+
+
+def _vec_spec():
+    return pl.BlockSpec((1, LANE), lambda i: (0, i))
+
+
+def _local_moments(xp, B, B_pad, F_pad, interpret: bool):
+    grid = (F_pad // LANE,)
+    mean, m2 = pl.pallas_call(
+        functools.partial(_moments_kernel, n_valid_rows=B),
+        grid=grid,
+        in_specs=[_row_spec(B_pad)],
+        out_specs=[_vec_spec(), _vec_spec()],
+        out_shape=[jax.ShapeDtypeStruct((1, F_pad), xp.dtype)] * 2,
+        interpret=interpret,
+    )(xp)
+    return mean, m2
+
+
+def _apply(xp, mean, var, gp, bp, B_pad, F_pad, eps, act_name,
+           interpret: bool):
+    grid = (F_pad // LANE,)
+    return pl.pallas_call(
+        functools.partial(_apply_kernel, eps=eps, act_name=act_name),
+        grid=grid,
+        in_specs=[_row_spec(B_pad), _vec_spec(), _vec_spec(), _vec_spec(),
+                  _vec_spec()],
+        out_specs=[_row_spec(B_pad)],
+        out_shape=[jax.ShapeDtypeStruct((B_pad, F_pad), xp.dtype)],
+        interpret=interpret,
+    )(xp, mean, var, gp, bp)[0]
+
+
 def _fused_fwd_impl(x, gamma, beta, eps: float, act_name: str,
-                    interpret: bool):
+                    interpret: bool, axis_name):
     B, F = x.shape
     B_pad = -(-B // SUBLANE) * SUBLANE
     F_pad = -(-F // LANE) * LANE
     xp = _pad_to(x, B_pad, F_pad)
     gp = _pad_to(gamma[None], 1, F_pad)
     bp = _pad_to(beta[None], 1, F_pad)
-    grid = (F_pad // LANE,)
-    kernel = functools.partial(_kernel, eps=eps, act_name=act_name,
-                               n_valid_rows=B)
-    y, mean, var = pl.pallas_call(
-        kernel,
-        grid=grid,
-        in_specs=[
-            pl.BlockSpec((B_pad, LANE), lambda i: (0, i)),
-            pl.BlockSpec((1, LANE), lambda i: (0, i)),
-            pl.BlockSpec((1, LANE), lambda i: (0, i)),
-        ],
-        out_specs=[
-            pl.BlockSpec((B_pad, LANE), lambda i: (0, i)),
-            pl.BlockSpec((1, LANE), lambda i: (0, i)),
-            pl.BlockSpec((1, LANE), lambda i: (0, i)),
-        ],
-        out_shape=[
-            jax.ShapeDtypeStruct((B_pad, F_pad), x.dtype),
-            jax.ShapeDtypeStruct((1, F_pad), x.dtype),
-            jax.ShapeDtypeStruct((1, F_pad), x.dtype),
-        ],
-        interpret=interpret,
-    )(xp, gp, bp)
+    if axis_name is None:
+        grid = (F_pad // LANE,)
+        kernel = functools.partial(_fused_kernel, eps=eps, act_name=act_name,
+                                   n_valid_rows=B)
+        y, mean, var = pl.pallas_call(
+            kernel,
+            grid=grid,
+            in_specs=[_row_spec(B_pad), _vec_spec(), _vec_spec()],
+            out_specs=[_row_spec(B_pad), _vec_spec(), _vec_spec()],
+            out_shape=[
+                jax.ShapeDtypeStruct((B_pad, F_pad), x.dtype),
+                jax.ShapeDtypeStruct((1, F_pad), x.dtype),
+                jax.ShapeDtypeStruct((1, F_pad), x.dtype),
+            ],
+            interpret=interpret,
+        )(xp, gp, bp)
+        return y[:B, :F], mean[0, :F], var[0, :F]
+    # SPMD: local one-pass moments -> global pmean -> one-pass apply
+    mean, m2 = _local_moments(xp, B, B_pad, F_pad, interpret)
+    mean = lax.pmean(mean, axis_name)
+    m2 = lax.pmean(m2, axis_name)
+    var = m2 - mean * mean
+    y = _apply(xp, mean, var, gp, bp, B_pad, F_pad, eps, act_name, interpret)
     return y[:B, :F], mean[0, :F], var[0, :F]
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
 def fused_bn_act_train(x, gamma, beta, eps: float = 1e-5,
                        act_name: str = "identity",
-                       interpret: bool = False):
-    """-> (act(bn(x)), batch_mean, batch_var); one fused pass on TPU."""
-    return _fused_fwd_impl(x, gamma, beta, eps, act_name, interpret)
+                       interpret: bool = False,
+                       axis_name=None):
+    """-> (act(bn(x)), batch_mean, batch_var).
+
+    One fused VMEM pass on TPU; under SPMD (``axis_name``) the moments are
+    pmean-ed across the mesh axis (sync-BN) between a one-pass moments
+    kernel and a one-pass normalize+activation kernel."""
+    return _fused_fwd_impl(x, gamma, beta, eps, act_name, interpret,
+                           axis_name)
 
 
-def _fwd(x, gamma, beta, eps, act_name, interpret):
-    out = _fused_fwd_impl(x, gamma, beta, eps, act_name, interpret)
+def _fwd(x, gamma, beta, eps, act_name, interpret, axis_name):
+    out = _fused_fwd_impl(x, gamma, beta, eps, act_name, interpret, axis_name)
     return out, (x, gamma, beta)
 
 
-def _bwd(eps, act_name, interpret, residuals, cotangents):
+def _bwd(eps, act_name, interpret, axis_name, residuals, cotangents):
     x, gamma, beta = residuals
-    _, vjp = jax.vjp(lambda a, g, b: _reference(a, g, b, eps, act_name),
-                     x, gamma, beta)
+    _, vjp = jax.vjp(
+        lambda a, g, b: _reference(a, g, b, eps, act_name, axis_name),
+        x, gamma, beta)
     return vjp(cotangents)
 
 

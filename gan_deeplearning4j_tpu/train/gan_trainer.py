@@ -48,7 +48,7 @@ from gan_deeplearning4j_tpu.graph import serialization
 from gan_deeplearning4j_tpu.parallel import DataParallelGraph, data_mesh
 from gan_deeplearning4j_tpu.parallel import mesh as mesh_lib
 from gan_deeplearning4j_tpu.runtime import prng
-from gan_deeplearning4j_tpu.utils import MetricsLogger
+from gan_deeplearning4j_tpu.utils import MetricsLogger, device_fence
 
 
 @dataclasses.dataclass
@@ -229,6 +229,7 @@ class GANTrainer:
         )
 
         self.batch_counter = 0
+        self._test_batches = None
 
     # -- artifact dumps ------------------------------------------------------
 
@@ -243,12 +244,21 @@ class GANTrainer:
         self.w.grid_extra_dump(self, out, self.batch_counter)
 
     def _dump_predictions(self, iter_test: RecordReaderDataSetIterator) -> None:
-        iter_test.reset()
-        preds = []
-        while iter_test.has_next():
-            ds = iter_test.next()
-            preds.append(np.asarray(
-                self.classifier.output(jnp.asarray(ds.features))[0]))
+        # the test set is loop-invariant: transfer it once and reuse the
+        # device-resident batches across every save_every dump (a per-dump
+        # re-upload over a tunneled PJRT link would dominate the dump)
+        if self._test_batches is None:
+            iter_test.reset()
+            batches = []
+            while iter_test.has_next():
+                batches.append(jnp.asarray(iter_test.next().features))
+            self._test_batches = batches
+        # dispatch every batch, then one overlapped readback — per-batch
+        # round trips would serialize on a tunneled link
+        from gan_deeplearning4j_tpu.utils import overlap_device_get
+
+        preds = overlap_device_get(
+            [self.classifier.output(xb)[0] for xb in self._test_batches])
         write_csv_matrix(
             os.path.join(
                 self.c.res_path,
@@ -367,7 +377,8 @@ class GANTrainer:
                     sharding = jax.sharding.SingleDeviceSharding(
                         jax.devices()[0])
             prefetch = PrefetchIterator(
-                iter_train, prefetch_depth=2, sharding=sharding, loop=True)
+                iter_train, prefetch_depth=2, sharding=sharding, loop=True,
+                min_rows=c.batch_size)
             try:
                 self._train_loop(prefetch, iter_test, fused_state, ones,
                                  y_dis, log)
@@ -385,9 +396,10 @@ class GANTrainer:
 
         # steady-state throughput: wall clock from the post-compile mark to
         # the last step's completion (async per-step timestamps measure
-        # dispatch, not the device)
+        # dispatch, not the device; device_fence documents why
+        # block_until_ready is not enough here)
         if self._final_losses is not None:
-            jax.block_until_ready(self._final_losses)
+            device_fence(self._final_losses)
         steady = None
         steps_timed = self.batch_counter - self._steady_start_step
         if self._steady_t0 is not None and steps_timed > 0:
@@ -451,11 +463,11 @@ class GANTrainer:
 
     def _mark_steady(self, loss) -> None:
         """After the FIRST step of a run (the one that pays the XLA
-        compile), block once and start the steady-state wall clock —
+        compile), fence once and start the steady-state wall clock —
         per-step host timestamps in an async-dispatch loop measure
         dispatch, not device time."""
         if self._steady_t0 is None:
-            jax.block_until_ready(loss)
+            device_fence(loss)
             self._steady_t0 = time.perf_counter()
             self._steady_start_step = self.batch_counter + 1
 
@@ -502,6 +514,7 @@ class GANTrainer:
                 # (5) classifier: dis features, fit on the real labeled batch
                 sync_params(self.classifier, self.dis, self.w.dis_to_classifier)
                 c_loss = self._fit_clf(real, labels)
+                self._final_losses = (d_loss, g_loss, c_loss)
                 self._mark_steady(c_loss)
 
             self._step_bookkeeping(iter_test, d_loss, g_loss, c_loss, log)

@@ -135,5 +135,11 @@ def evaluate(trainer: GANTrainer) -> Dict[str, float]:
     return out
 
 
+def cli(argv=None) -> None:
+    """Console-script entry point: swallow main()'s result dict so the
+    setuptools wrapper's sys.exit() sees None (exit status 0)."""
+    main(argv)
+
+
 if __name__ == "__main__":
     main()

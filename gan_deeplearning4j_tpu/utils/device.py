@@ -1,0 +1,44 @@
+"""Device↔host transfer helpers for high-latency (tunneled) PJRT links.
+
+Two backend quirks this module centralizes (discovered on the axon TPU
+tunnel, ~70ms round trip):
+
+* ``jax.block_until_ready`` returns immediately with work still queued —
+  the only reliable device fence is an actual readback (``device_fence``).
+* A ``float()``/``np.asarray()`` per array serializes one full round trip
+  each; starting every copy with ``copy_to_host_async`` first overlaps
+  them into roughly one round trip total (``overlap_device_get``).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import numpy as np
+
+
+def overlap_device_get(tree: Any) -> Any:
+    """Materialize every jax.Array leaf of ``tree`` to numpy with
+    overlapped transfers: async-start ALL host copies, then read.
+    Non-array leaves pass through unchanged."""
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    for a in leaves:
+        if hasattr(a, "copy_to_host_async"):
+            try:
+                a.copy_to_host_async()
+            except Exception:
+                pass  # fall back to the synchronous read below
+    return jax.tree_util.tree_unflatten(
+        treedef,
+        [np.asarray(a) if hasattr(a, "dtype") else a for a in leaves])
+
+
+def device_fence(tree: Any) -> None:
+    """Wait for completion of every program producing a leaf of ``tree``
+    (plus, by in-order execution, everything dispatched before them):
+    overlapped readback of ALL array leaves — block_until_ready is NOT a
+    fence on tunneled backends, and reading a single leaf would not fence
+    later-dispatched programs producing the other leaves."""
+    overlap_device_get([a for a in jax.tree_util.tree_leaves(tree)
+                        if hasattr(a, "dtype")])

@@ -55,8 +55,14 @@ class MetricsLogger:
     def flush(self) -> None:
         if not self._pending:
             return
+        # Overlapped readback: a naive float() per value is a full device
+        # round trip each — on a tunneled PJRT link that is ~70ms * 3
+        # losses * flush_every per flush, which would dominate a real run.
+        from gan_deeplearning4j_tpu.utils.device import overlap_device_get
+
+        pending = overlap_device_get(self._pending)
         materialized = []
-        for rec in self._pending:
+        for rec in pending:
             materialized.append(
                 {k: (float(v) if hasattr(v, "dtype") else v) for k, v in rec.items()}
             )

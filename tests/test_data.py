@@ -164,3 +164,30 @@ def test_ensure_refuses_half_present_pair(tmp_path):
     (tmp_path / "mnist_train.csv").write_text("0,1\n")
     with pytest.raises(FileExistsError):
         ensure_mnist_csv(str(tmp_path), n_train=5, n_test=5)
+
+
+def test_prefetch_min_rows_skips_partial_tail():
+    """min_rows drops the partial epoch tail on the HOST side (a partial
+    batch is not divisible by a mesh's batch sharding, so it must never
+    reach device_put), wrapping like the reference's skip-and-reset."""
+    from gan_deeplearning4j_tpu.data.prefetch import PrefetchIterator
+
+    table = np.arange(22 * 3, dtype=np.float32).reshape(22, 3)
+    it = RecordReaderDataSetIterator(
+        table, batch_size=8, label_index=2, num_classes=1)
+    with PrefetchIterator(it, sharding=None, loop=True, min_rows=8) as pf:
+        sizes = [next(pf)[0].shape[0] for _ in range(5)]
+    assert sizes == [8, 8, 8, 8, 8]  # the 6-row tail never surfaces
+
+
+def test_prefetch_all_partial_dataset_terminates():
+    """A dataset with NO full batch must end in StopIteration, not spin
+    the loop=True worker forever."""
+    from gan_deeplearning4j_tpu.data.prefetch import PrefetchIterator
+
+    table = np.zeros((5, 3), dtype=np.float32)
+    it = RecordReaderDataSetIterator(
+        table, batch_size=8, label_index=2, num_classes=1)
+    with PrefetchIterator(it, sharding=None, loop=True, min_rows=8) as pf:
+        with pytest.raises(StopIteration):
+            next(pf)

@@ -55,6 +55,77 @@ def test_fused_bn_act_gradients():
                                    rtol=1e-4, atol=1e-5)
 
 
+def test_fused_bn_act_spmd_matches_global():
+    """SPMD path (moments kernel -> pmean -> apply kernel) == the
+    single-device global computation: sync-BN exactness over the mesh."""
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    from gan_deeplearning4j_tpu.parallel import data_mesh
+
+    rng = np.random.RandomState(2)
+    B, F = 32, 192
+    x = jnp.asarray(rng.randn(B, F).astype(np.float32) * 1.5 - 0.5)
+    gamma = jnp.asarray(rng.rand(F).astype(np.float32) + 0.5)
+    beta = jnp.asarray(rng.randn(F).astype(np.float32))
+
+    mesh = data_mesh(8)
+
+    def sharded(xb, g, b):
+        y, mean, var = fused_bn_act_train(xb, g, b, 1e-5, "tanh", True,
+                                          "data")
+        return y, mean, var
+
+    y, mean, var = shard_map(
+        sharded, mesh=mesh, in_specs=(P("data"), P(), P()),
+        out_specs=(P("data"), P(), P()), check_vma=False,
+    )(x, gamma, beta)
+    y_ref, mean_ref, var_ref = _reference(x, gamma, beta, 1e-5, "tanh")
+    np.testing.assert_allclose(np.asarray(mean), np.asarray(mean_ref),
+                               rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(var), np.asarray(var_ref),
+                               rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(y), np.asarray(y_ref),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_fused_bn_act_spmd_gradients():
+    """Backward through the SPMD custom-vjp (pmean in the reference
+    recomputation) == grads of the global single-device reference."""
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    from gan_deeplearning4j_tpu.parallel import data_mesh
+
+    rng = np.random.RandomState(3)
+    B, F = 16, 64
+    x = jnp.asarray(rng.randn(B, F).astype(np.float32))
+    gamma = jnp.asarray(rng.rand(F).astype(np.float32) + 0.5)
+    beta = jnp.asarray(rng.randn(F).astype(np.float32))
+    mesh = data_mesh(8)
+
+    def loss_spmd(x, g, b):
+        def shard(xb, g, b):
+            y, _, _ = fused_bn_act_train(xb, g, b, 1e-5, "tanh", True,
+                                         "data")
+            # global sum-of-squares: psum the local contribution
+            return jax.lax.psum(jnp.sum(y ** 2), "data")
+
+        return shard_map(
+            shard, mesh=mesh, in_specs=(P("data"), P(), P()),
+            out_specs=P(), check_vma=False)(x, g, b)
+
+    def loss_ref(x, g, b):
+        y, _, _ = _reference(x, g, b, 1e-5, "tanh")
+        return jnp.sum(y ** 2)
+
+    gf = jax.grad(loss_spmd, argnums=(0, 1, 2))(x, gamma, beta)
+    gr = jax.grad(loss_ref, argnums=(0, 1, 2))(x, gamma, beta)
+    for a, b_ in zip(gf, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
+                                   rtol=1e-4, atol=1e-5)
+
+
 def test_pallas_gate_off_by_default():
     from gan_deeplearning4j_tpu.ops import pallas as pallas_lib
 
