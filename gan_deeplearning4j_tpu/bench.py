@@ -204,6 +204,9 @@ def main(argv=None) -> None:
     p.add_argument("--profile", default=None, metavar="DIR",
                    help="capture a jax.profiler trace of the timed steps")
     p.add_argument("--skip-e2e", action="store_true")
+    from gan_deeplearning4j_tpu.runtime import backend
+
+    backend.add_bf16_flag(p)
     args = p.parse_args(argv)
 
     import jax
@@ -234,6 +237,12 @@ def main(argv=None) -> None:
                         "200 (stand-in for the reference's nd4j-native CPU run)",
             }, f, indent=1)
 
+    # bf16 applies to the DEVICE measurement only — the cached CPU
+    # baseline (measured above when absent) is always reference-f32
+    measured_bf16 = args.bf16 and default.platform != "cpu"
+    if measured_bf16:
+        backend.configure(matmul_bf16=True)
+
     with maybe_trace(args.profile):
         if default.platform == "cpu":
             value, flops = baseline, None
@@ -248,6 +257,9 @@ def main(argv=None) -> None:
         "unit": "img/sec/chip",
         "vs_baseline": round(value / baseline, 3),
         "step_ms": round(step_s * 1e3, 3),
+        # keyed on what RAN, not on the flag: --bf16 on a CPU-only host
+        # still reports the f32 baseline
+        "dtype": "bf16" if measured_bf16 else "f32",
     }
     peak = _peak_flops(default)
     if flops:

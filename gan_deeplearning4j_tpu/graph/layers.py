@@ -55,6 +55,19 @@ def _flat_size(shape: Shape) -> int:
     return n
 
 
+def _mxu_bf16(layer_flag: Optional[bool]) -> bool:
+    """Resolve a layer's bf16-matmul setting: an explicit layer flag wins;
+    None follows the global runtime policy (backend.configure
+    (matmul_bf16=True) — the TPU fast path, opt-in because it deviates
+    from the reference's fixed float32).  Read at TRACE time: flip the
+    policy before the first fit/compile."""
+    if layer_flag is not None:
+        return layer_flag
+    from gan_deeplearning4j_tpu.runtime import backend
+
+    return backend.config().matmul_bf16
+
+
 def _as_ff(x: jax.Array) -> jax.Array:
     """Auto CnnToFeedForward: flatten trailing dims (DL4J inserts this
     preprocessor when a dense layer follows a conv stack)."""
@@ -102,7 +115,9 @@ class Dense(Layer):
 
     n_out: int = 0
     n_in: Optional[int] = None
-    bf16_matmul: bool = False
+    # None = follow the runtime policy (backend.configure(matmul_bf16=True));
+    # True/False pin this layer regardless of policy
+    bf16_matmul: Optional[bool] = None
 
     def out_shape(self, in_shape):
         return (self.n_out,)
@@ -118,7 +133,8 @@ class Dense(Layer):
 
     def apply(self, params, x, train, rng, axis_name=None):
         x = _as_ff(x)
-        return self._act(dense_op(x, params["W"], params["b"], bf16=self.bf16_matmul)), None
+        return self._act(dense_op(
+            x, params["W"], params["b"], bf16=_mxu_bf16(self.bf16_matmul))), None
 
 
 @dataclasses.dataclass
@@ -138,6 +154,7 @@ class Conv2D(Layer):
     padding: Sequence[int] = (0, 0)
     n_in: Optional[int] = None
     n_out: int = 0
+    bf16_matmul: Optional[bool] = None  # None = runtime policy
 
     def out_shape(self, in_shape):
         c, h, w = in_shape
@@ -159,7 +176,8 @@ class Conv2D(Layer):
         return {"W": w, "b": initializers.zeros((self.n_out,))}
 
     def apply(self, params, x, train, rng, axis_name=None):
-        y = conv2d(x, params["W"], params["b"], self.stride, self.padding)
+        y = conv2d(x, params["W"], params["b"], self.stride, self.padding,
+                   bf16=_mxu_bf16(self.bf16_matmul))
         return self._act(y), None
 
 
@@ -173,6 +191,7 @@ class ConvTranspose2D(Layer):
     padding: Sequence[int] = (1, 1)
     n_in: Optional[int] = None
     n_out: int = 0
+    bf16_matmul: Optional[bool] = None  # None = runtime policy
 
     def out_shape(self, in_shape):
         c, h, w = in_shape
@@ -194,7 +213,8 @@ class ConvTranspose2D(Layer):
         return {"W": w, "b": initializers.zeros((self.n_out,))}
 
     def apply(self, params, x, train, rng, axis_name=None):
-        y = conv_transpose2d(x, params["W"], params["b"], self.stride, self.padding)
+        y = conv_transpose2d(x, params["W"], params["b"], self.stride,
+                             self.padding, bf16=_mxu_bf16(self.bf16_matmul))
         return self._act(y), None
 
 

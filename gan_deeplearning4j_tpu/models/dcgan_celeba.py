@@ -9,13 +9,15 @@ run SPMD over the replica axis.
 
 Standard 64x64 DCGAN shapes (Radford et al. 2015): z(100) -> 4x4x(8f) ->
 four stride-2 transposed convs -> 64x64x3 tanh; mirror conv stack with
-LeakyReLU + BN for the discriminator.  ``bf16=True`` runs the dense
-matmuls in bfloat16 on the MXU (params stay float32).
+LeakyReLU + BN for the discriminator.  ``bf16``: None (default) follows
+the global runtime policy (``backend.configure(matmul_bf16=...)``);
+True/False pins every layer of this model regardless of policy.
 """
 
 from __future__ import annotations
 
 import dataclasses
+from typing import Optional
 
 from gan_deeplearning4j_tpu.graph import (
     BatchNorm,
@@ -41,7 +43,7 @@ class CelebAConfig:
     base_filters: int = 64
     learning_rate: float = 0.0002
     clip: float = 1.0
-    bf16: bool = False
+    bf16: Optional[bool] = None  # None = follow runtime policy
 
 
 def build_generator(cfg: CelebAConfig = CelebAConfig()):

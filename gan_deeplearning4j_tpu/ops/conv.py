@@ -40,8 +40,22 @@ def conv2d(
     padding: Sequence[int] = (0, 0),
     *,
     preferred_dtype=None,
+    bf16: bool = False,
 ) -> jax.Array:
-    """x: [B, C, H, W]; w: [O, I, kh, kw]; b: [O] or None."""
+    """x: [B, C, H, W]; w: [O, I, kh, kw]; b: [O] or None.
+
+    ``bf16``: feed the MXU bfloat16 operands — the TPU fast path (the
+    reference has no analogue; its dtype is fixed by
+    ``Nd4j.setDataType(FLOAT)``).  Opt-in because it deviates from
+    reference numerics; params/activations stay float32.  The conv runs
+    fully in bf16 and the result is cast back (a mixed
+    preferred_element_type would leave the transpose/VJP conv with one
+    bf16 and one f32 operand, which lax rejects); the MXU still
+    accumulates partial products in f32 internally."""
+    orig_dtype = x.dtype
+    if bf16:
+        x = x.astype(jnp.bfloat16)
+        w = w.astype(jnp.bfloat16)
     ph, pw = padding
     out = lax.conv_general_dilated(
         x,
@@ -51,6 +65,8 @@ def conv2d(
         dimension_numbers=DIMENSION_NUMBERS,
         preferred_element_type=preferred_dtype,
     )
+    if bf16:
+        out = out.astype(orig_dtype)
     if b is not None:
         out = out + b.reshape(1, -1, 1, 1)
     return out

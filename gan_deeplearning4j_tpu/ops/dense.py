@@ -2,8 +2,8 @@
 
 Replaces libnd4j's GEMM path (OpenBLAS/MKL on CPU, cuBLAS on GPU —
 dl4jGAN.iml:229,244) with ``jnp.dot`` lowered to XLA ``dot_general`` on the
-MXU.  Optionally accumulates in bfloat16 inputs / float32 accumulation for
-the MXU fast path.
+MXU.  Optional bf16 fast path: bfloat16 operands, result rounded through
+bf16 and cast back to the input dtype.
 """
 
 from __future__ import annotations
@@ -19,13 +19,16 @@ def dense(
     *,
     bf16: bool = False,
 ) -> jax.Array:
-    """x: [B, F_in]; w: [F_in, F_out] (DL4J "W" layout); b: [F_out]."""
+    """x: [B, F_in]; w: [F_in, F_out] (DL4J "W" layout); b: [F_out].
+
+    ``bf16``: bfloat16 operands into the MXU, result cast back (a mixed
+    preferred_element_type breaks the dot transpose/VJP dtype agreement
+    the same way it does for conv — see ops/conv.py)."""
     if bf16:
         out = jnp.dot(
             x.astype(jnp.bfloat16),
             w.astype(jnp.bfloat16),
-            preferred_element_type=jnp.float32,
-        )
+        ).astype(x.dtype)
     else:
         out = jnp.dot(x, w)
     if b is not None:

@@ -34,6 +34,8 @@ def conv_transpose2d(
     b: jax.Array | None = None,
     stride: Sequence[int] = (2, 2),
     padding: Sequence[int] = (0, 0),
+    *,
+    bf16: bool = False,
 ) -> jax.Array:
     """Real transposed conv (for roadmap DCGAN variants). w: [O, I, kh, kw]
     mapping I input channels to O output channels.
@@ -44,6 +46,11 @@ def conv_transpose2d(
     ``(in - 1)*stride - 2*pad + kernel`` (torch ConvTranspose2d arithmetic,
     matching layers.ConvTranspose2D.out_shape).
     """
+    orig_dtype = x.dtype
+    if bf16:
+        # bf16 MXU operands, result cast back (same rationale as conv2d)
+        x = x.astype(jnp.bfloat16)
+        w = w.astype(jnp.bfloat16)
     sh, sw = stride
     ph, pw = padding
     kh, kw = w.shape[2], w.shape[3]
@@ -55,6 +62,8 @@ def conv_transpose2d(
         lhs_dilation=(sh, sw),
         dimension_numbers=DIMENSION_NUMBERS,
     )
+    if bf16:
+        out = out.astype(orig_dtype)
     if b is not None:
         out = out + b.reshape(1, -1, 1, 1)
     return out

@@ -39,6 +39,19 @@ class RuntimeConfig:
 
 _config = RuntimeConfig()
 
+BF16_HELP = (
+    "bfloat16 operands into every MXU contraction (conv, transposed conv, "
+    "dense); params/activations stay float32, each op's result is rounded "
+    "through bf16 once (the MXU accumulates partial products in f32 "
+    "internally). Faster; deviates from the reference's fixed float32 "
+    "numerics — see RESULTS.md for the measured speed/quality trade."
+)
+
+
+def add_bf16_flag(parser) -> None:
+    """Register the shared --bf16 CLI flag (one help text, no drift)."""
+    parser.add_argument("--bf16", action="store_true", help=BF16_HELP)
+
 
 def configure(**kwargs) -> RuntimeConfig:
     """Set global runtime options (platform, dtype, seed)."""

@@ -87,7 +87,13 @@ def main(argv=None) -> Dict[str, float]:
     p.add_argument("--fid-samples", type=int, default=10000,
                    help="generator samples for the end-of-run FID "
                         "(0 disables)")
+    from gan_deeplearning4j_tpu.runtime import backend
+
+    backend.add_bf16_flag(p)
     args = p.parse_args(argv)
+
+    if args.bf16:
+        backend.configure(matmul_bf16=True)
 
     config = default_config(
         num_iterations=args.iterations,

@@ -88,7 +88,13 @@ def main(argv=None) -> Dict[str, float]:
     p.add_argument("--resume", action="store_true")
     p.add_argument("--profile", default=None, metavar="DIR",
                    help="capture a jax.profiler trace of the run into DIR")
+    from gan_deeplearning4j_tpu.runtime import backend
+
+    backend.add_bf16_flag(p)
     args = p.parse_args(argv)
+
+    if args.bf16:
+        backend.configure(matmul_bf16=True)
 
     config = default_config(
         num_iterations=args.iterations,

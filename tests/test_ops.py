@@ -154,3 +154,47 @@ class TestActivations:
         f = activations.get(name)
         x = jnp.asarray([[0.5, -0.5]])
         assert f(x).shape == x.shape
+
+
+def test_bf16_policy_conv_dense_close_to_f32():
+    """The opt-in bf16 MXU policy (backend.configure(matmul_bf16=True))
+    must track the f32 path within bf16 tolerance on conv and dense."""
+    import jax.numpy as jnp
+
+    from gan_deeplearning4j_tpu.ops.conv import conv2d
+    from gan_deeplearning4j_tpu.ops.dense import dense
+
+    rng = np.random.RandomState(0)
+    x4 = jnp.asarray(rng.randn(4, 3, 12, 12).astype(np.float32))
+    w4 = jnp.asarray(rng.randn(8, 3, 5, 5).astype(np.float32) * 0.1)
+    b4 = jnp.asarray(rng.randn(8).astype(np.float32) * 0.1)
+    y_f32 = conv2d(x4, w4, b4, (2, 2), (0, 0))
+    y_bf16 = conv2d(x4, w4, b4, (2, 2), (0, 0), bf16=True)
+    assert y_bf16.dtype == jnp.float32  # f32 accumulation/output
+    np.testing.assert_allclose(np.asarray(y_bf16), np.asarray(y_f32),
+                               rtol=2e-2, atol=2e-2)
+
+    x2 = jnp.asarray(rng.randn(16, 64).astype(np.float32))
+    w2 = jnp.asarray(rng.randn(64, 32).astype(np.float32) * 0.1)
+    b2 = jnp.asarray(rng.randn(32).astype(np.float32) * 0.1)
+    z_f32 = dense(x2, w2, b2)
+    z_bf16 = dense(x2, w2, b2, bf16=True)
+    assert z_bf16.dtype == jnp.float32
+    np.testing.assert_allclose(np.asarray(z_bf16), np.asarray(z_f32),
+                               rtol=2e-2, atol=2e-2)
+
+
+def test_bf16_runtime_policy_reaches_layers():
+    """Dense/Conv2D layers with bf16_matmul=None follow the global
+    runtime policy at trace time."""
+    from gan_deeplearning4j_tpu.graph.layers import _mxu_bf16
+    from gan_deeplearning4j_tpu.runtime import backend
+
+    assert _mxu_bf16(None) is False      # default policy: reference f32
+    assert _mxu_bf16(True) is True       # explicit layer flag wins
+    backend.configure(matmul_bf16=True)
+    try:
+        assert _mxu_bf16(None) is True
+        assert _mxu_bf16(False) is False
+    finally:
+        backend.configure(matmul_bf16=False)
