@@ -64,7 +64,7 @@ def build_generator(cfg: CelebAConfig = CelebAConfig()):
         b.add_layer(name,
                     ConvTranspose2D(kernel=(4, 4), stride=(2, 2), padding=(1, 1),
                                     n_in=chans[i], n_out=chans[i + 1],
-                                    updater=lr),
+                                    updater=lr, bf16_matmul=cfg.bf16),
                     prev)
         if i == 0:
             b.input_preprocessor(name, FeedForwardToCnn(4, 4, 8 * f))
@@ -74,7 +74,7 @@ def build_generator(cfg: CelebAConfig = CelebAConfig()):
     b.add_layer("gen_deconv4",
                 ConvTranspose2D(kernel=(4, 4), stride=(2, 2), padding=(1, 1),
                                 n_in=f, n_out=cfg.channels, activation="tanh",
-                                updater=lr),
+                                updater=lr, bf16_matmul=cfg.bf16),
                 prev)
     b.set_outputs("gen_deconv4")
     return b.build().init()
@@ -94,7 +94,8 @@ def build_discriminator(cfg: CelebAConfig = CelebAConfig()):
         name = f"dis_conv{i + 1}"
         b.add_layer(name,
                     Conv2D(kernel=(4, 4), stride=(2, 2), padding=(1, 1),
-                           n_in=chans[i], n_out=chans[i + 1], updater=lr),
+                           n_in=chans[i], n_out=chans[i + 1], updater=lr,
+                           bf16_matmul=cfg.bf16),
                     prev)
         prev = name
         if i > 0:

@@ -3,8 +3,10 @@
 
 One ``jax.sharding.Mesh`` + XLA collectives over ICI replace the Spark
 cluster runtime, Kryo serialization, parameter-averaging TrainingMaster,
-and the Aeron parameter server.  Long-context sequence parallelism (ring
-attention) lives here too — first-class, per the framework's scope.
+and the Aeron parameter server.  Long-context sequence parallelism lives
+here too — first-class, per the framework's scope — in both idioms: ring
+attention (ppermute KV rotation) and Ulysses all-to-all head/sequence
+re-sharding.
 """
 
 from gan_deeplearning4j_tpu.parallel.mesh import (

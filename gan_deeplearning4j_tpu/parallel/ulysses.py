@@ -1,0 +1,70 @@
+"""Ulysses-style all-to-all sequence parallelism — the second SP idiom.
+
+Complements ring attention (ring_attention.py): where the ring keeps the
+sequence sharded and rotates KV blocks R hops around the ICI torus,
+Ulysses (DeepSpeed-Ulysses, Jacobs et al. 2023) re-shards ONCE — an
+``all_to_all`` swaps the sharded axis from sequence to heads, every
+device computes FULL-sequence attention for its H/R head group, and a
+second ``all_to_all`` swaps back:
+
+  [B, H, T/R, D]  --a2a(head<-seq)-->  [B, H/R, T, D]
+      full-sequence attention per local head group (any kernel)
+  [B, H/R, T, D]  --a2a(seq<-head)-->  [B, H, T/R, D]
+
+Trade-offs vs the ring (why the framework carries both):
+  - Ulysses: 2 collectives total, attention itself is a stock local op
+    (composes with any attention kernel, flash or vanilla); but the head
+    count must be divisible by the SP degree, capping scale at H.
+  - Ring: scales to any degree that divides T and never materializes the
+    full sequence per device — O(T/R * T/R) score blocks; but the
+    attention inner loop itself must be ring-aware.
+
+Causal masking needs no position bookkeeping here: each device sees the
+full sequence for its heads.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+from jax import lax, shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+from gan_deeplearning4j_tpu.parallel.ring_attention import attention
+
+
+def ulysses_attention_sharded(q, k, v, axis_name: str,
+                              causal: bool = False) -> jax.Array:
+    """shard_map body: q/k/v are local sequence shards [B, H, T/R, D];
+    returns the local output shard [B, H, T/R, D]."""
+    # seq-sharded -> head-sharded: split heads (axis 1) across the mesh
+    # axis, gather the sequence (axis 2)
+    qh, kh, vh = (
+        lax.all_to_all(a, axis_name, split_axis=1, concat_axis=2, tiled=True)
+        for a in (q, k, v))
+    o = attention(qh, kh, vh, causal=causal)   # [B, H/R, T, D], full seq
+    # head-sharded -> seq-sharded
+    return lax.all_to_all(o, axis_name, split_axis=2, concat_axis=1,
+                          tiled=True)
+
+
+def ulysses_attention(q, k, v, mesh: Mesh, axis: str = "seq",
+                      causal: bool = False) -> jax.Array:
+    """Host-level entry: shards [B, H, T, D] over ``axis`` (sequence) and
+    runs all-to-all SP.  H and T must both be divisible by the SP degree
+    (H for the head swap, T for the input sharding)."""
+    R = mesh.shape[axis]
+    if q.shape[1] % R != 0:
+        raise ValueError(f"head count {q.shape[1]} not divisible by SP {R}")
+    if q.shape[2] % R != 0:
+        raise ValueError(f"sequence length {q.shape[2]} not divisible by SP {R}")
+    spec = P(None, None, axis, None)
+    f = shard_map(
+        partial(ulysses_attention_sharded, axis_name=axis, causal=causal),
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+        check_vma=False,
+    )
+    return f(q, k, v)

@@ -29,6 +29,12 @@ from jax.sharding import Mesh, PartitionSpec as P
 from gan_deeplearning4j_tpu.runtime import prng
 
 
+# Cap on lax.scan steps per dispatch (trainer auto mode and the
+# benchmark's multistep measurement both use it, so the published number
+# describes the program the trainer actually runs).
+MAX_STEPS_PER_CALL = 25
+
+
 class ProtocolState(NamedTuple):
     """All four graphs' learnable state, one donated pytree.
 
@@ -68,10 +74,18 @@ def make_protocol_step(
     axis: str = "data",
     donate: bool = True,
     data_on_device: bool = False,
+    steps_per_call: int = 1,
 ):
     """Build the fused step:
     (state, real, labels, z_key, rng_key, y_real, y_fake, ones) ->
     (state', (d_loss, g_loss, clf_loss)).
+
+    ``steps_per_call`` > 1 wraps the iteration in ``lax.scan`` so ONE
+    dispatch advances K steps and returns K-stacked losses — on a
+    high-latency (tunneled) link the per-step dispatch cost otherwise
+    bounds throughput at ~1/dispatch-latency regardless of device speed.
+    Requires ``data_on_device`` (each inner step must slice its own batch;
+    a streamed batch argument would be reused K times).
 
     The per-iteration host work is ONE dispatch: the step index lives in
     ``state.it`` (device scalar, incremented by the program itself), and
@@ -168,6 +182,27 @@ def make_protocol_step(
             dis_params, dis_opt, gan_params, gan_opt,
             clf_params, clf_opt, gen_params, step_idx + 1)
         return new_state, (d_loss, g_loss, c_loss)
+
+    if steps_per_call > 1:
+        if not data_on_device:
+            raise ValueError(
+                "steps_per_call > 1 requires data_on_device=True (inner "
+                "steps slice their own batches from the resident dataset)")
+        # donation + scan trips an INVALID_ARGUMENT runtime error in the
+        # axon TPU backend (single-step donated programs are fine); the
+        # cost of not donating is one extra copy of the ~MB-scale state
+        donate = False
+        inner = step
+
+        def step(state, real, labels, z_key, rng_key, y_real, y_fake, ones):
+            def body(s, _):
+                s, losses = inner(s, real, labels, z_key, rng_key,
+                                  y_real, y_fake, ones)
+                return s, losses
+
+            state, losses = lax.scan(
+                body, state, None, length=steps_per_call)
+            return state, losses  # each loss stacked [steps_per_call]
 
     if mesh is None:
         return jax.jit(step, donate_argnums=(0,) if donate else ())

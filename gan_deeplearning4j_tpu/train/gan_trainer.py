@@ -79,6 +79,13 @@ class GANTrainerConfig:
     # traffic.  None = auto: on when fused and the table fits comfortably.
     data_on_device: Optional[bool] = None
     data_on_device_max_bytes: int = 2 << 30
+    # Steps per XLA dispatch on the resident path (lax.scan inside the
+    # fused program).  Per-step dispatch latency otherwise bounds
+    # throughput — on a tunneled PJRT link at ~1/2ms regardless of how
+    # fast the chip is.  None = auto (largest divisor <= 25 of the
+    # artifact cadences, so chunks never cross a dump/checkpoint
+    # boundary); 1 = one dispatch per step.
+    steps_per_call: Optional[int] = None
     # -- new capabilities over the reference --
     checkpoint_every: int = 0         # 0 = end-of-run models only
     checkpoint_keep: int = 3
@@ -230,6 +237,8 @@ class GANTrainer:
 
         self.batch_counter = 0
         self._test_batches = None
+        self._steps_per_call = 1
+        self._fused_multi = None
 
     # -- artifact dumps ------------------------------------------------------
 
@@ -329,13 +338,21 @@ class GANTrainer:
         resident = self._fused_enabled and self._resident_data_ok(iter_train)
         if self._fused_enabled:
             if self._fused_step is None:
-                self._fused_step = self._fused_lib.make_protocol_step(
-                    self.dis, self.gen, self.gan, self.classifier,
-                    self.w.dis_to_gan, self.w.gan_to_gen,
-                    self.w.dis_to_classifier,
+                kw = dict(
                     z_size=c.z_size, num_features=c.num_features,
                     mesh=self._mesh, data_on_device=resident,
                 )
+                graphs = (self.dis, self.gen, self.gan, self.classifier)
+                maps = (self.w.dis_to_gan, self.w.gan_to_gen,
+                        self.w.dis_to_classifier)
+                self._fused_step = self._fused_lib.make_protocol_step(
+                    *graphs, *maps, **kw)
+                self._steps_per_call = (
+                    self._resolve_steps_per_call() if resident else 1)
+                if self._steps_per_call > 1:
+                    self._fused_multi = self._fused_lib.make_protocol_step(
+                        *graphs, *maps,
+                        steps_per_call=self._steps_per_call, **kw)
             # loop-invariant step arguments, device-resident once
             self._fused_invariants = (
                 self._z_base, self._fused_rng,
@@ -435,6 +452,38 @@ class GANTrainer:
         return jax.random.uniform(
             key, (self.c.batch_size, self.c.z_size), minval=-1.0, maxval=1.0)
 
+    def _resolve_steps_per_call(self) -> int:
+        """Steps-per-dispatch: the largest K <= cap dividing every
+        artifact cadence AND the iteration count, so chunks never cross a
+        dump/checkpoint boundary and the run length is an exact number of
+        chunks — the resident loop then needs ONLY the multi-step program
+        (a remainder would force a second XLA compile mid-run, which would
+        land inside the steady-throughput window).  An explicit config
+        value acts as the cap and is reduced (with a warning) if it does
+        not divide the cadences — a non-dividing K would silently send
+        every partial chunk down the latency-bound single-step path."""
+        import math
+
+        from gan_deeplearning4j_tpu.train.fused_step import MAX_STEPS_PER_CALL
+
+        c = self.c
+        cap = (MAX_STEPS_PER_CALL if c.steps_per_call is None
+               else max(1, c.steps_per_call))
+        g = c.num_iterations
+        for cad in (c.print_every, c.save_every, c.checkpoint_every):
+            if cad:
+                g = math.gcd(g, cad)
+        if g <= 0:
+            return 1
+        k = max(d for d in range(1, min(cap, g) + 1) if g % d == 0)
+        if c.steps_per_call is not None and k != c.steps_per_call:
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "steps_per_call=%d does not divide the artifact cadences; "
+                "using %d so chunks stay aligned", c.steps_per_call, k)
+        return k
+
     def _resident_data_ok(self, iter_train) -> bool:
         """Decide the device-resident data path (config override, else
         auto: the table must hold at least one full batch and fit the
@@ -447,19 +496,51 @@ class GANTrainer:
         size = iter_train.features.nbytes + iter_train.labels.nbytes
         return size <= c.data_on_device_max_bytes
 
+    def _next_chunk(self) -> int:
+        """Steps until the next artifact/checkpoint boundary or the end of
+        the run, capped at steps_per_call."""
+        c = self.c
+        run = min(self._steps_per_call,
+                  c.num_iterations - self.batch_counter)
+        for cad in (c.print_every, c.save_every, c.checkpoint_every):
+            if cad:
+                run = min(run, cad - self.batch_counter % cad)
+        return run
+
     def _resident_loop(self, features, labels, iter_test, fused_state,
                        log) -> None:
-        """Hot loop of the device-resident data path: nothing per step but
-        the fused-step dispatch — batch slicing, latent draws and the step
-        counter all live on device."""
+        """Hot loop of the device-resident data path: batch slicing,
+        latent draws and the step counter all live on device, and (when
+        steps_per_call > 1) ONE dispatch advances a whole chunk of steps
+        — per-step dispatch latency is the throughput bound this removes."""
         self._final_state, self._final_losses = fused_state, None
+        K = self._steps_per_call
         while self.batch_counter < self.c.num_iterations:
-            fused_state, (d_loss, g_loss, c_loss) = self._fused_step(
-                fused_state, features, labels, *self._fused_invariants)
+            run = self._next_chunk()
+            if K > 1 and run == K:
+                fused_state, (d, g, cl) = self._fused_multi(
+                    fused_state, features, labels, *self._fused_invariants)
+                per_step = [(d[k], g[k], cl[k]) for k in range(K)]
+            else:
+                per_step = []
+                for _ in range(run):
+                    fused_state, losses = self._fused_step(
+                        fused_state, features, labels,
+                        *self._fused_invariants)
+                    per_step.append(losses)
             self._final_state = fused_state
-            self._final_losses = (d_loss, g_loss, c_loss)
-            self._mark_steady(d_loss)
-            self._step_bookkeeping(iter_test, d_loss, g_loss, c_loss, log)
+            if self._steady_t0 is None:
+                # steady clock starts after the FIRST chunk completes (it
+                # pays the compile); the whole chunk is excluded — fencing
+                # mid-chunk would credit already-finished steps to the
+                # steady window and overstate throughput
+                device_fence(per_step[-1])
+                self._steady_t0 = time.perf_counter()
+                self._steady_start_step = self.batch_counter + len(per_step)
+            for d_loss, g_loss, c_loss in per_step:
+                self._final_losses = (d_loss, g_loss, c_loss)
+                self._step_bookkeeping(iter_test, d_loss, g_loss, c_loss,
+                                       log)
 
     def _mark_steady(self, loss) -> None:
         """After the FIRST step of a run (the one that pays the XLA

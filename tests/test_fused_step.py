@@ -81,3 +81,54 @@ def test_fused_matches_unfused_trainer(tmp_path):
             np.testing.assert_allclose(
                 np.asarray(v), np.asarray(t_u.dis.params[layer][name]),
                 rtol=1e-4, atol=1e-6, err_msg=f"dis/{layer}/{name}")
+
+
+def test_multistep_matches_sequential_singles(cpu_devices):
+    """K steps in ONE scanned program == K sequential single-step
+    dispatches, bitwise on the resulting state (the counter-based PRNG
+    and on-device batch slicing make the inner steps identical)."""
+    K = 4
+    dis, gen, gan, clf = _build()
+    B = 20
+    n_rows = 3 * B  # resident table, slicing wraps
+    ones = jnp.ones((B, 1), dtype=jnp.float32)
+    key = jax.random.key(3)
+    kw = dict(z_size=2, num_features=12, data_on_device=True, donate=False)
+    single = fused.make_protocol_step(
+        dis, gen, gan, clf, M.DIS_TO_GAN, M.GAN_TO_GEN, M.DIS_TO_CLASSIFIER,
+        **kw)
+    multi = fused.make_protocol_step(
+        dis, gen, gan, clf, M.DIS_TO_GAN, M.GAN_TO_GEN, M.DIS_TO_CLASSIFIER,
+        steps_per_call=K, **kw)
+    rng_np = np.random.RandomState(1)
+    table = jnp.asarray(rng_np.rand(n_rows, 12).astype(np.float32))
+    labels = jnp.asarray((rng_np.rand(n_rows, 1) > 0.5).astype(np.float32))
+    inv = (key, jax.random.fold_in(key, 9), ones + 0.02, ones * 0.0 - 0.01,
+           ones)
+
+    s_seq = fused.state_from_graphs(dis, gen, gan, clf)
+    seq_losses = []
+    for _ in range(K):
+        s_seq, losses = single(s_seq, table, labels, *inv)
+        seq_losses.append([float(x) for x in losses])
+
+    s_multi = fused.state_from_graphs(dis, gen, gan, clf)
+    s_multi, (d, g, c) = multi(s_multi, table, labels, *inv)
+    assert d.shape == (K,)
+    for k in range(K):
+        np.testing.assert_allclose(
+            [float(d[k]), float(g[k]), float(c[k])], seq_losses[k],
+            rtol=1e-6)
+    for a, b in zip(jax.tree.leaves(s_seq), jax.tree.leaves(s_multi)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_multistep_requires_resident_data():
+    import pytest
+
+    dis, gen, gan, clf = _build()
+    with pytest.raises(ValueError, match="data_on_device"):
+        fused.make_protocol_step(
+            dis, gen, gan, clf,
+            M.DIS_TO_GAN, M.GAN_TO_GEN, M.DIS_TO_CLASSIFIER,
+            z_size=2, num_features=12, steps_per_call=4)

@@ -70,3 +70,50 @@ def test_tp_dense_pair_matches_unsharded(cpu_devices):
                                rtol=2e-5, atol=2e-5)
     with pytest.raises(ValueError):
         tp_dense_pair(x, w1[:, :30], b1[:30], w2[:30], b2, mesh)
+
+
+class TestUlysses:
+    """All-to-all (Ulysses) SP == vanilla attention, causal and not."""
+
+    @pytest.mark.parametrize("causal", [False, True])
+    @pytest.mark.parametrize("degree", [2, 4, 8])
+    def test_matches_vanilla(self, cpu_devices, causal, degree):
+        from gan_deeplearning4j_tpu.parallel.mesh import make_mesh
+        from gan_deeplearning4j_tpu.parallel.ulysses import ulysses_attention
+
+        rng = np.random.RandomState(7)
+        B, H, T, D = 2, 8, 32, 16
+        q, k, v = (jnp.asarray(rng.randn(B, H, T, D).astype(np.float32))
+                   for _ in range(3))
+        mesh = make_mesh({"seq": degree})
+        out = ulysses_attention(q, k, v, mesh, causal=causal)
+        ref = attention(q, k, v, causal=causal)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=2e-4, atol=2e-5)
+
+    def test_rejects_indivisible_heads(self, cpu_devices):
+        from gan_deeplearning4j_tpu.parallel.mesh import make_mesh
+        from gan_deeplearning4j_tpu.parallel.ulysses import ulysses_attention
+
+        q = jnp.zeros((1, 3, 8, 4))
+        mesh = make_mesh({"seq": 2})
+        with pytest.raises(ValueError, match="head count"):
+            ulysses_attention(q, q, q, mesh)
+
+    def test_matches_ring(self, cpu_devices):
+        """The two SP idioms agree with each other, not just with the
+        reference — ring and all-to-all are interchangeable backends."""
+        from gan_deeplearning4j_tpu.parallel.mesh import make_mesh
+        from gan_deeplearning4j_tpu.parallel.ring_attention import (
+            ring_attention,
+        )
+        from gan_deeplearning4j_tpu.parallel.ulysses import ulysses_attention
+
+        rng = np.random.RandomState(8)
+        q, k, v = (jnp.asarray(rng.randn(2, 4, 32, 8).astype(np.float32))
+                   for _ in range(3))
+        mesh = make_mesh({"seq": 4})
+        np.testing.assert_allclose(
+            np.asarray(ulysses_attention(q, k, v, mesh, causal=True)),
+            np.asarray(ring_attention(q, k, v, mesh, causal=True)),
+            rtol=2e-4, atol=2e-5)
