@@ -248,3 +248,80 @@ def load_split(path: str, label_index: int) -> Tuple[np.ndarray, np.ndarray]:
     feats = np.delete(table, label_index, axis=1)
     labels = table[:, label_index]
     return feats, labels
+
+
+# ---------------------------------------------------------------------------
+# Roadmap synthetic datasets (BASELINE.json configs 3-5; no network egress,
+# so CIFAR-10 / CelebA get deterministic surrogates with class/appearance
+# structure, like the MNIST surrogate above)
+# ---------------------------------------------------------------------------
+
+
+def synthetic_cifar10(
+    n: int, seed: int = SEED, size: int = 32
+) -> Tuple[np.ndarray, np.ndarray]:
+    """CIFAR-10 surrogate: 10 classes = glyph shape in a class hue over a
+    random background tint, random affine pose.  Returns
+    (features[n, 3*size*size] float32 in [-1, 1] NCHW-flattened,
+    labels[n] int64) — tanh-range, matching the cGAN generator head.
+    """
+    rng = np.random.RandomState(seed)
+    gray, labels = synthetic_mnist(n, seed=seed + 1, noise=0.04)
+    gray = gray.reshape(n, 28, 28)
+    # class hues spread around the wheel; shape colored, background tinted
+    hues = np.linspace(0.0, 1.0, 10, endpoint=False)
+    out = np.empty((n, 3, size, size), dtype=np.float32)
+    pad = (size - 28) // 2
+    for lo in range(0, n, 4096):
+        hi = min(lo + 4096, n)
+        m = hi - lo
+        g = np.zeros((m, size, size), dtype=np.float32)
+        g[:, pad:pad + 28, pad:pad + 28] = gray[lo:hi]
+        h = hues[labels[lo:hi]] + rng.uniform(-0.03, 0.03, m)
+        # cheap hue -> rgb (cosine color wheel)
+        phase = h[:, None, None]
+        rgb = np.stack([
+            0.5 + 0.5 * np.cos(2 * np.pi * (phase + off))
+            for off in (0.0, 1 / 3, 2 / 3)], axis=1).astype(np.float32)
+        bg = rng.uniform(-0.25, 0.25, (m, 3, 1, 1)).astype(np.float32)
+        img = bg + g[:, None] * (2.0 * rgb - 1.0 - bg)
+        out[lo:hi] = np.clip(img, -1.0, 1.0)
+    return out.reshape(n, -1), labels
+
+
+def synthetic_celeba(n: int, seed: int = SEED, size: int = 64) -> np.ndarray:
+    """CelebA surrogate: procedural 64x64 'faces' — skin-toned ellipse,
+    two eyes, mouth, hair band, varying pose/colors/background.  Returns
+    [n, 3*size*size] float32 in [-1, 1], NCHW-flattened (no labels —
+    CelebA DCGAN is unconditional)."""
+    rng = np.random.RandomState(seed)
+    yy, xx = np.meshgrid(np.linspace(-1, 1, size), np.linspace(-1, 1, size),
+                         indexing="ij")
+    out = np.empty((n, 3, size, size), dtype=np.float32)
+    for i in range(n):
+        cx, cy = rng.uniform(-0.15, 0.15, 2)
+        rx = rng.uniform(0.45, 0.6)
+        ry = rng.uniform(0.55, 0.75)
+        face = (((xx - cx) / rx) ** 2 + ((yy - cy) / ry) ** 2) < 1.0
+        skin = np.array([0.9, 0.65, 0.5]) * rng.uniform(0.7, 1.1)
+        bg = rng.uniform(-1.0, 1.0, 3)
+        img = np.empty((3, size, size), dtype=np.float32)
+        for c in range(3):
+            img[c] = np.where(face, 2 * skin[c] - 1, bg[c])
+        # hair: top band of the face ellipse
+        hair_color = rng.uniform(-1.0, 0.0, 3)
+        hair = face & (yy < cy - 0.25 * ry)
+        for c in range(3):
+            img[c] = np.where(hair, hair_color[c], img[c])
+        # eyes and mouth
+        for ex in (-0.22, 0.22):
+            eye = (((xx - cx - ex) / 0.07) ** 2
+                   + ((yy - cy + 0.12) / 0.05) ** 2) < 1.0
+            img[:, eye] = -0.9
+        mouth = (((xx - cx) / rng.uniform(0.12, 0.25)) ** 2
+                 + ((yy - cy - 0.35) / 0.05) ** 2) < 1.0
+        img[0, mouth] = 0.6
+        img[1:, mouth] = -0.6
+        img += rng.randn(3, size, size).astype(np.float32) * 0.04
+        out[i] = np.clip(img, -1.0, 1.0)
+    return out.reshape(n, -1)

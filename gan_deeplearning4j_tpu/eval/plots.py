@@ -28,6 +28,31 @@ def tile_grid(samples: np.ndarray, rows: int, cols: int,
     return out
 
 
+def _render_mosaic_png(path: str, arr: np.ndarray,
+                       grid_edge: Optional[int], w: int, h: int) -> str:
+    """Shared renderer: ``arr`` is [n, C, H, W] in [0, 1]; tiles each
+    channel and writes the PNG (grayscale when C == 1)."""
+    import matplotlib
+
+    matplotlib.use("Agg")
+    import matplotlib.pyplot as plt
+
+    n, c = arr.shape[0], arr.shape[1]
+    edge = grid_edge or int(round(np.sqrt(n)))
+    mosaic = np.stack(
+        [tile_grid(arr[:, ch], edge, edge) for ch in range(c)], axis=-1)
+    if c == 1:
+        mosaic = mosaic[..., 0]
+    plt.figure(figsize=(max(4, edge * w / 28), max(4, edge * h / 28)))
+    plt.imshow(mosaic, interpolation="nearest",
+               **({"cmap": "gray"} if c == 1 else {}))
+    plt.axis("off")
+    plt.tight_layout(pad=0)
+    plt.savefig(path, dpi=150, bbox_inches="tight")
+    plt.close()
+    return path
+
+
 def save_grid_png(path: str, grid_csv_or_array, sample_shape,
                   grid_edge: Optional[int] = None) -> str:
     """Render a trainer grid dump (``{name}_out_{k}.csv``) to a PNG mosaic.
@@ -36,24 +61,25 @@ def save_grid_png(path: str, grid_csv_or_array, sample_shape,
     insurance lattices).  ``grid_edge``: mosaic edge length (defaults to
     sqrt of the sample count — the trainers dump n^2 rows).
     """
-    import matplotlib
-
-    matplotlib.use("Agg")
-    import matplotlib.pyplot as plt
-
     from gan_deeplearning4j_tpu.data import read_csv_matrix
 
     arr = (read_csv_matrix(grid_csv_or_array)
            if isinstance(grid_csv_or_array, str)
            else np.asarray(grid_csv_or_array))
     h, w = sample_shape
-    n = arr.shape[0]
-    edge = grid_edge or int(round(np.sqrt(n)))
-    mosaic = tile_grid(arr.reshape(n, h, w), edge, edge)
-    plt.figure(figsize=(max(4, edge * w / 28), max(4, edge * h / 28)))
-    plt.imshow(mosaic, cmap="gray", interpolation="nearest")
-    plt.axis("off")
-    plt.tight_layout(pad=0)
-    plt.savefig(path, dpi=150, bbox_inches="tight")
-    plt.close()
-    return path
+    return _render_mosaic_png(
+        path, arr.reshape(arr.shape[0], 1, h, w), grid_edge, w, h)
+
+
+def save_rgb_grid_png(path: str, samples: np.ndarray, sample_shape,
+                      grid_edge: Optional[int] = None,
+                      value_range=(-1.0, 1.0)) -> str:
+    """RGB mosaic for the roadmap model families: ``samples`` is
+    [n, C*H*W] NCHW-flattened (the generators' flat output layout),
+    ``sample_shape`` = (C, H, W), values in ``value_range`` (tanh heads
+    emit [-1, 1])."""
+    c, h, w = sample_shape
+    arr = np.asarray(samples, dtype=np.float32).reshape(-1, c, h, w)
+    lo, hi = value_range
+    arr = np.clip((arr - lo) / (hi - lo), 0.0, 1.0)
+    return _render_mosaic_png(path, arr, grid_edge, w, h)

@@ -24,15 +24,22 @@ import numpy as np
 from gan_deeplearning4j_tpu.graph.graph import ComputationGraph, GraphBuilder, InputSpec
 from gan_deeplearning4j_tpu.graph.layers import LAYER_TYPES
 from gan_deeplearning4j_tpu.graph.preprocessors import PREPROCESSOR_TYPES
+from gan_deeplearning4j_tpu.optim.adam import Adam
 from gan_deeplearning4j_tpu.optim.rmsprop import RmsProp
 
 FORMAT_VERSION = 1
+
+# updater kinds by type-tag; legacy configs without a tag are RmsProp
+_UPDATER_TYPES = {"RmsProp": RmsProp, "Adam": Adam}
 
 
 def _layer_to_dict(layer) -> dict:
     d = dataclasses.asdict(layer)
     if d.get("updater") is not None:
-        d["updater"] = dataclasses.asdict(layer.updater)
+        d["updater"] = {
+            **dataclasses.asdict(layer.updater),
+            "__type__": type(layer.updater).__name__,
+        }
     d["__type__"] = type(layer).__name__
     return d
 
@@ -41,7 +48,9 @@ def _layer_from_dict(d: dict):
     d = dict(d)
     cls = LAYER_TYPES[d.pop("__type__")]
     if d.get("updater") is not None:
-        d["updater"] = RmsProp(**d["updater"])
+        up = dict(d["updater"])
+        up_cls = _UPDATER_TYPES[up.pop("__type__", "RmsProp")]
+        d["updater"] = up_cls(**up)
     return cls(**d)
 
 
@@ -113,19 +122,28 @@ def graph_from_config_dict(cfg: dict) -> ComputationGraph:
     return graph
 
 
-def _flatten(tree: Dict[str, Dict[str, jnp.ndarray]]) -> Dict[str, np.ndarray]:
-    return {
-        f"{layer}/{name}": np.asarray(v)
-        for layer, lp in tree.items()
-        for name, v in lp.items()
-    }
+def _flatten(tree: Dict, prefix: str = "") -> Dict[str, np.ndarray]:
+    """Nested dicts -> '/'-joined flat keys, any depth (params are
+    {layer: {param: array}}; Adam updater state adds a third level,
+    {layer: {param: {m, v, t}}})."""
+    out: Dict[str, np.ndarray] = {}
+    for k, v in tree.items():
+        key = f"{prefix}{k}"
+        if isinstance(v, dict):
+            out.update(_flatten(v, key + "/"))
+        else:
+            out[key] = np.asarray(v)
+    return out
 
 
-def _unflatten(flat) -> Dict[str, Dict[str, jnp.ndarray]]:
-    tree: Dict[str, Dict[str, jnp.ndarray]] = {}
+def _unflatten(flat) -> Dict:
+    tree: Dict = {}
     for key in flat.files:
-        layer, name = key.rsplit("/", 1)
-        tree.setdefault(layer, {})[name] = jnp.asarray(flat[key])
+        parts = key.split("/")
+        d = tree
+        for p in parts[:-1]:
+            d = d.setdefault(p, {})
+        d[parts[-1]] = jnp.asarray(flat[key])
     return tree
 
 

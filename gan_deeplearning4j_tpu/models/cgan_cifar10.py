@@ -28,7 +28,7 @@ from gan_deeplearning4j_tpu.graph import (
     Merge,
     Output,
 )
-from gan_deeplearning4j_tpu.optim.rmsprop import RmsProp
+from gan_deeplearning4j_tpu.optim.adam import Adam
 from gan_deeplearning4j_tpu.runtime import prng
 
 
@@ -42,12 +42,18 @@ class CGANConfig:
     z_size: int = 64
     base_filters: int = 64
     learning_rate: float = 0.0002
+    # TTUR: the discriminator trains slower than the generator (inverse
+    # two-timescale) — with the easy synthetic surrogate D otherwise wins
+    # outright and the generator gradient starves
+    d_learning_rate: float = 0.0001
+    # one-sided label smoothing on the real label (Salimans et al. 2016)
+    real_label: float = 0.9
     l2: float = 0.0
     clip: float = 1.0
 
 
 def build_generator(cfg: CGANConfig = CGANConfig()):
-    lr = RmsProp(cfg.learning_rate, 1e-8, 1e-8)
+    lr = Adam(cfg.learning_rate, 0.5, 0.999)
     f = cfg.base_filters
     b = GraphBuilder(seed=cfg.seed, l2=cfg.l2, activation="relu",
                      weight_init="xavier", clip_threshold=cfg.clip)
@@ -80,7 +86,7 @@ def build_generator(cfg: CGANConfig = CGANConfig()):
 
 
 def build_discriminator(cfg: CGANConfig = CGANConfig()):
-    lr = RmsProp(cfg.learning_rate, 1e-8, 1e-8)
+    lr = Adam(cfg.d_learning_rate, 0.5, 0.999)
     f = cfg.base_filters
     b = GraphBuilder(seed=cfg.seed, l2=cfg.l2, activation="leakyrelu",
                      weight_init="xavier", clip_threshold=cfg.clip)

@@ -29,7 +29,7 @@ from gan_deeplearning4j_tpu.graph import (
     InputSpec,
     Output,
 )
-from gan_deeplearning4j_tpu.optim.rmsprop import RmsProp
+from gan_deeplearning4j_tpu.optim.adam import Adam
 from gan_deeplearning4j_tpu.runtime import prng
 
 
@@ -47,7 +47,7 @@ class CelebAConfig:
 
 
 def build_generator(cfg: CelebAConfig = CelebAConfig()):
-    lr = RmsProp(cfg.learning_rate, 1e-8, 1e-8)
+    lr = Adam(cfg.learning_rate, 0.5, 0.999)
     f = cfg.base_filters
     b = GraphBuilder(seed=cfg.seed, activation="relu", weight_init="xavier",
                      clip_threshold=cfg.clip)
@@ -81,7 +81,7 @@ def build_generator(cfg: CelebAConfig = CelebAConfig()):
 
 
 def build_discriminator(cfg: CelebAConfig = CelebAConfig()):
-    lr = RmsProp(cfg.learning_rate, 1e-8, 1e-8)
+    lr = Adam(cfg.learning_rate, 0.5, 0.999)
     f = cfg.base_filters
     b = GraphBuilder(seed=cfg.seed, activation="leakyrelu",
                      weight_init="xavier", clip_threshold=cfg.clip)

@@ -29,7 +29,7 @@ from gan_deeplearning4j_tpu.graph import (
     InputSpec,
     Output,
 )
-from gan_deeplearning4j_tpu.optim.rmsprop import RmsProp
+from gan_deeplearning4j_tpu.optim.adam import Adam
 from gan_deeplearning4j_tpu.runtime import prng
 
 
@@ -49,7 +49,7 @@ class WGANGPConfig:
 
 def build_critic(cfg: WGANGPConfig = WGANGPConfig()):
     """Conv critic, NO BatchNorm, linear head, Wasserstein loss."""
-    lr = RmsProp(cfg.learning_rate, 1e-8, 1e-8)
+    lr = Adam(cfg.learning_rate, 0.5, 0.9)
     f = cfg.base_filters
     b = GraphBuilder(seed=cfg.seed, activation="leakyrelu",
                      weight_init="xavier",
@@ -74,7 +74,7 @@ def build_critic(cfg: WGANGPConfig = WGANGPConfig()):
 
 def build_generator(cfg: WGANGPConfig = WGANGPConfig()):
     """DCGAN-style generator: z -> dense 7*7*4f -> BN -> deconv x2 -> 28x28."""
-    lr = RmsProp(cfg.learning_rate, 1e-8, 1e-8)
+    lr = Adam(cfg.learning_rate, 0.5, 0.9)
     f = cfg.base_filters
     b = GraphBuilder(seed=cfg.seed, activation="relu", weight_init="xavier",
                      clip_threshold=cfg.clip or None)

@@ -1,3 +1,4 @@
+from gan_deeplearning4j_tpu.optim.adam import Adam  # noqa: F401
 from gan_deeplearning4j_tpu.optim.rmsprop import (  # noqa: F401
     RmsProp,
     rmsprop_init,

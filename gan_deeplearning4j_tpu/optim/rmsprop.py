@@ -27,11 +27,22 @@ import jax.numpy as jnp
 
 @dataclasses.dataclass(frozen=True)
 class RmsProp:
-    """Per-layer updater config (DL4J constructor argument order)."""
+    """Per-layer updater config (DL4J constructor argument order).
+
+    Implements the per-leaf updater protocol (``init_leaf`` /
+    ``update_leaf``) shared with optim.adam.Adam so GraphUpdater can mix
+    updater kinds across layers."""
 
     learning_rate: float = 0.001
     rms_decay: float = 1e-8
     epsilon: float = 1e-8
+
+    def init_leaf(self, p):
+        return jnp.zeros_like(p)
+
+    def update_leaf(self, g, state):
+        return rmsprop_update_leaf(
+            g, state, self.learning_rate, self.rms_decay, self.epsilon)
 
 
 def rmsprop_init(params):

@@ -16,20 +16,29 @@ from typing import Dict
 import jax
 import jax.numpy as jnp
 
-from gan_deeplearning4j_tpu.ops.clipping import clip_elementwise
-from gan_deeplearning4j_tpu.optim.rmsprop import rmsprop_init, rmsprop_update_leaf
+from gan_deeplearning4j_tpu.optim.rmsprop import RmsProp
 
 # DL4J regularizes "weight" params (W, gamma is excluded in DL4J: BN gamma/beta
 # have no L2 by default; biases excluded by default l2Bias=0).
 _L2_PARAM_NAMES = frozenset({"W"})
 
+# layers without an explicit updater are frozen (the reference's
+# freezing-by-zero-lr mechanism); the default rms_decay/epsilon values
+# don't matter at lr 0 but keep DL4J's
+_FROZEN = RmsProp(0.0, 1e-8, 1e-8)
+
 
 class GraphUpdater:
-    """Per-layer-lr updater over a {layer: {param: array}} tree."""
+    """Per-layer updater over a {layer: {param: array}} tree.
+
+    Each layer's updater is any object with the per-leaf protocol
+    (``init_leaf(p)`` / ``update_leaf(g, state) -> (update, new_state)``)
+    — RmsProp (the reference's pinned choice) and Adam (roadmap families)
+    both implement it, and kinds can mix across layers of one graph."""
 
     def __init__(
         self,
-        layer_updaters: Dict[str, "RmsProp"],
+        layer_updaters: Dict[str, object],
         l2: float = 0.0,
         clip_threshold: float | None = 1.0,
         rms_decay: float = 1e-8,
@@ -38,25 +47,32 @@ class GraphUpdater:
         self.layer_updaters = dict(layer_updaters)
         self.l2 = float(l2)
         self.clip_threshold = clip_threshold
+        # kept for backward compatibility of the constructor signature;
+        # per-layer updaters carry their own hyperparameters
         self.rms_decay = float(rms_decay)
         self.epsilon = float(epsilon)
 
+    def _updater_for(self, layer: str):
+        return self.layer_updaters.get(layer) or _FROZEN
+
     def init(self, params):
-        return rmsprop_init(params)
+        return {
+            layer: {
+                pname: self._updater_for(layer).init_leaf(p)
+                for pname, p in layer_params.items()
+            }
+            for layer, layer_params in params.items()
+        }
 
     def lr_for(self, layer: str) -> float:
-        up = self.layer_updaters.get(layer)
-        return 0.0 if up is None else float(up.learning_rate)
+        return float(self._updater_for(layer).learning_rate)
 
     def apply(self, params, grads, cache):
         """Returns (new_params, new_cache). Pure; call inside jit."""
         new_params = {}
         new_cache = {}
         for layer, layer_grads in grads.items():
-            up = self.layer_updaters.get(layer)
-            lr = 0.0 if up is None else up.learning_rate
-            decay = self.rms_decay if up is None else up.rms_decay
-            eps = self.epsilon if up is None else up.epsilon
+            up = self._updater_for(layer)
             new_params[layer] = dict(params[layer])
             new_cache[layer] = dict(cache.get(layer, {}))
             for pname, g in layer_grads.items():
@@ -65,8 +81,7 @@ class GraphUpdater:
                     g = g + self.l2 * p
                 if self.clip_threshold is not None:
                     g = jnp.clip(g, -self.clip_threshold, self.clip_threshold)
-                c = cache[layer][pname]
-                update, c2 = rmsprop_update_leaf(g, c, lr, decay, eps)
+                update, c2 = up.update_leaf(g, cache[layer][pname])
                 new_params[layer][pname] = p - update
                 new_cache[layer][pname] = c2
             # params without grads (e.g. BN running mean/var) pass through via

@@ -198,3 +198,30 @@ def test_bf16_runtime_policy_reaches_layers():
         assert _mxu_bf16(False) is False
     finally:
         backend.configure(matmul_bf16=False)
+
+
+def test_adam_updater_protocol():
+    """Adam per-leaf rule matches a hand computation (bias-corrected),
+    and GraphUpdater can mix Adam and RmsProp layers in one graph."""
+    import jax.numpy as jnp
+
+    from gan_deeplearning4j_tpu.optim import Adam, GraphUpdater, RmsProp
+
+    adam = Adam(0.1, 0.9, 0.999, 1e-8)
+    p = jnp.asarray([1.0, -2.0])
+    g = jnp.asarray([0.5, -0.25])
+    state = adam.init_leaf(p)
+    update, state = adam.update_leaf(g, state)
+    # step 1: mhat == g, vhat == g^2 -> update ~= lr * sign(g)
+    np.testing.assert_allclose(np.asarray(update),
+                               0.1 * np.sign([0.5, -0.25]), rtol=1e-4)
+    assert float(state["t"]) == 1.0
+
+    up = GraphUpdater({"a": Adam(0.1), "b": RmsProp(0.2, 1e-8, 1e-8)})
+    params = {"a": {"W": p}, "b": {"W": p}}
+    grads = {"a": {"W": g}, "b": {"W": g}}
+    cache = up.init(params)
+    assert "m" in cache["a"]["W"] and cache["b"]["W"].shape == p.shape
+    new_params, new_cache = up.apply(params, grads, cache)
+    assert np.all(np.asarray(new_params["a"]["W"]) != np.asarray(p))
+    assert float(new_cache["a"]["W"]["t"]) == 1.0

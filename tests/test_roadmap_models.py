@@ -122,3 +122,21 @@ def test_gan_pair_dp_matches_single_device(cpu_devices):
             np.testing.assert_allclose(
                 np.asarray(v), np.asarray(d2.params[layer][name]),
                 rtol=1e-4, atol=1e-5, err_msg=f"{layer}/{name}")
+
+
+def test_roadmap_main_end_to_end(tmp_path):
+    """The roadmap CLI trains each family for a few iterations and dumps
+    the sample grid + model zips (reference artifact style)."""
+    import os
+
+    from gan_deeplearning4j_tpu.train.roadmap_main import main
+
+    d = str(tmp_path / "cgan")
+    res = main(["--family", "cgan-cifar10", "--iterations", "2",
+                "--batch-size", "8", "--n-train", "32",
+                "--print-every", "2", "--res-path", d])
+    assert res["steps"] == 2
+    assert np.isfinite(res["d_loss"]) and np.isfinite(res["g_loss"])
+    for f in ("cgan-cifar10_samples_2.png", "cgan-cifar10_gen_model.zip",
+              "cgan-cifar10_dis_model.zip", "cgan-cifar10_metrics.jsonl"):
+        assert os.path.exists(os.path.join(d, f)), f
