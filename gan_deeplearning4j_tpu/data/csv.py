@@ -165,10 +165,23 @@ class RecordReaderDataSetIterator:
 def write_csv_matrix(path: str, matrix, delimiter: str = ",", fmt: str = "%.8g") -> None:
     """Dump a 2-D array as CSV in the reference's artifact format (comma
     delimiter, no trailing newline — dl4jGANComputerVision.java:482-495),
-    but vectorized instead of per-scalar ``getDouble`` writes."""
+    but vectorized instead of per-scalar ``getDouble`` writes.  Uses the
+    threaded C++ formatter (data/native.py) when built; numpy otherwise."""
+    import re
+
     m = np.asarray(matrix)
     if m.ndim == 1:
         m = m.reshape(1, -1)
+    spec = re.fullmatch(r"%\.(\d+)([fg])", fmt)
+    if spec and m.dtype.kind == "f":
+        from gan_deeplearning4j_tpu.data import native
+
+        raw = native.format_csv(m, delimiter, spec.group(2),
+                                int(spec.group(1)))
+        if raw is not None:
+            with open(path, "wb") as f:
+                f.write(raw)
+            return
     buf = io.StringIO()
     np.savetxt(buf, m, delimiter=delimiter, fmt=fmt)
     text = buf.getvalue().rstrip("\n")

@@ -135,8 +135,16 @@ def export_mnist_csv(
         path = os.path.join(out_dir, f"mnist_{split}.csv")
         feats, labels = synthetic_mnist(n, seed=s)
         table = np.concatenate([feats, labels.reshape(-1, 1).astype(np.float32)], axis=1)
-        fmt = ["%.2f"] * 784 + ["%d"]
-        np.savetxt(path, table, delimiter=",", fmt=fmt)
+        from gan_deeplearning4j_tpu.data import native
+
+        raw = native.format_csv(table, ",", "f", 2, int_last=True)
+        if raw is not None:  # threaded C++ formatter (scales with cores;
+            # parity with np.savetxt on a single-core host)
+            with open(path, "wb") as f:
+                f.write(raw + b"\n")
+        else:
+            fmt = ["%.2f"] * 784 + ["%d"]
+            np.savetxt(path, table, delimiter=",", fmt=fmt)
         paths.append(path)
     return tuple(paths)
 

@@ -45,6 +45,13 @@ def _load() -> Optional[ctypes.CDLL]:
             ctypes.c_char_p, ctypes.c_long, ctypes.c_char,
             ctypes.POINTER(ctypes.c_float), ctypes.c_long,
         ]
+        if hasattr(lib, "fastcsv_format"):  # older .so without the writer
+            lib.fastcsv_format.restype = ctypes.c_long
+            lib.fastcsv_format.argtypes = [
+                ctypes.POINTER(ctypes.c_float), ctypes.c_long, ctypes.c_long,
+                ctypes.c_char, ctypes.c_char, ctypes.c_int, ctypes.c_int,
+                ctypes.c_char_p, ctypes.c_long,
+            ]
         _LIB = lib
     except OSError:
         _LIB = None
@@ -85,3 +92,37 @@ def read_csv(path: str, skip_lines: int, delimiter: str, dtype) -> Optional[np.n
     if n != out.size:
         return None
     return out
+
+
+def format_csv(matrix: np.ndarray, delimiter: str = ",", fmt: str = "g",
+               precision: int = 8, int_last: bool = False) -> Optional[bytes]:
+    """Format a float32 matrix as CSV bytes via the threaded C++ writer
+    (the decoder's write-side twin); None if unavailable — caller falls
+    back to numpy.  ``fmt``: 'f' (fixed ``precision`` decimals) or 'g'
+    (``precision`` significant digits); ``int_last`` prints the final
+    column as an integer (the dataset contract's label column)."""
+    lib = _load()
+    if lib is None or not hasattr(lib, "fastcsv_format"):
+        return None
+    if len(delimiter) != 1 or fmt not in ("f", "g") or precision > 32:
+        return None
+    m = np.asarray(matrix)
+    if m.dtype != np.float32:
+        # a float64 table would silently lose digits through the f32
+        # formatter — let the caller's numpy fallback keep full precision
+        return None
+    m = np.ascontiguousarray(m)
+    if m.ndim != 2 or m.size == 0:
+        return None
+    capacity = m.size * (precision + 16)
+    buf = ctypes.create_string_buffer(capacity)
+    n = lib.fastcsv_format(
+        m.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        m.shape[0], m.shape[1], delimiter.encode()[0], fmt.encode()[0],
+        precision, int(int_last), buf, capacity,
+    )
+    if n < 0:
+        return None
+    # string_at copies exactly n bytes (buf.raw would materialize the whole
+    # over-allocated capacity first)
+    return ctypes.string_at(buf, n)

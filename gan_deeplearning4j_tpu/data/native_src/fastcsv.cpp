@@ -13,8 +13,10 @@
 //
 // Build: python -m gan_deeplearning4j_tpu.data.build_native
 
+#include <cstdio>
 #include <cstdlib>
 #include <cstring>
+#include <string>
 #include <thread>
 #include <vector>
 
@@ -158,6 +160,88 @@ long fastcsv_parse(const char* data, long len, char delim, float* out, long capa
         total += r;
     }
     return total;
+}
+
+}  // extern "C"
+
+// ---------------------------------------------------------------------------
+// Writer: format a row-major float32 matrix as CSV text (the reverse of
+// fastcsv_parse; completes the native data layer's read+write pair).
+// ---------------------------------------------------------------------------
+
+namespace {
+
+// Format rows [row0, row1) into a string. fmt: 'f' (fixed, %.*f) or 'g'
+// (significant digits, %.*g). int_last: last column printed as %ld
+// (the dataset contract's integer label column).
+std::string format_rows(const float* data, long row0, long row1, long cols,
+                        char delim, char fmt, int precision, int int_last) {
+    std::string out;
+    out.reserve((size_t)(row1 - row0) * cols * (precision + 8));
+    char buf[64];
+    const char f_or_g[2][5] = {"%.*f", "%.*g"};
+    const char* spec = (fmt == 'f') ? f_or_g[0] : f_or_g[1];
+    for (long r = row0; r < row1; r++) {
+        const float* row = data + r * cols;
+        for (long c = 0; c < cols; c++) {
+            int n;
+            if (int_last && c == cols - 1) {
+                n = snprintf(buf, sizeof buf, "%ld", (long)(row[c] < 0
+                             ? row[c] - 0.5f : row[c] + 0.5f));
+            } else {
+                n = snprintf(buf, sizeof buf, spec, precision,
+                             (double)row[c]);
+            }
+            // snprintf returns the WOULD-BE length; clamp to what was
+            // actually written when the value overflows buf
+            if (n > (int)sizeof buf - 1) n = (int)sizeof buf - 1;
+            out.append(buf, (size_t)n);
+            if (c + 1 < cols) out.push_back(delim);
+        }
+        out.push_back('\n');
+    }
+    return out;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Format the matrix into out[capacity]. Returns bytes written (WITHOUT a
+// trailing newline, matching the artifact contract), or -1 if the buffer
+// is too small. Threaded across row chunks.
+long fastcsv_format(const float* data, long rows, long cols, char delim,
+                    char fmt, int precision, int int_last,
+                    char* out, long capacity) {
+    if (rows <= 0 || cols <= 0) return 0;
+    unsigned hw = std::thread::hardware_concurrency();
+    long nthreads = hw ? (long)hw : 1;
+    if (nthreads > rows) nthreads = rows;
+    if (rows * cols < 1 << 15) nthreads = 1;
+
+    std::vector<std::string> parts((size_t)nthreads);
+    std::vector<std::thread> threads;
+    for (long t = 0; t < nthreads; t++) {
+        long r0 = rows * t / nthreads;
+        long r1 = rows * (t + 1) / nthreads;
+        threads.emplace_back([&, t, r0, r1]() {
+            parts[(size_t)t] = format_rows(data, r0, r1, cols, delim, fmt,
+                                           precision, int_last);
+        });
+    }
+    for (auto& th : threads) th.join();
+
+    long total = 0;
+    for (const auto& s : parts) total += (long)s.size();
+    // the memcpy loop writes ALL total bytes (incl. the final newline the
+    // returned count excludes) — capacity must cover every written byte
+    if (total > capacity) return -1;
+    char* p = out;
+    for (const auto& s : parts) {
+        memcpy(p, s.data(), s.size());
+        p += s.size();
+    }
+    return total - 1;  // exclude the final trailing newline from the count
 }
 
 }  // extern "C"

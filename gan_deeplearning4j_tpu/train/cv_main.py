@@ -12,6 +12,7 @@ Run: ``python -m gan_deeplearning4j_tpu.train.cv_main --iterations 10000``
 from __future__ import annotations
 
 import argparse
+import dataclasses
 from typing import Dict
 
 from gan_deeplearning4j_tpu.data import ensure_mnist_csv
@@ -20,6 +21,7 @@ from gan_deeplearning4j_tpu.train.gan_trainer import (
     GANTrainer,
     GANTrainerConfig,
     Workload,
+    train_with_recovery,
 )
 
 
@@ -80,6 +82,9 @@ def main(argv=None) -> Dict[str, float]:
     p.add_argument("--averaging-frequency", type=int, default=10)
     p.add_argument("--checkpoint-every", type=int, default=0)
     p.add_argument("--resume", action="store_true")
+    p.add_argument("--max-restarts", type=int, default=0,
+                   help="auto-resume from the latest checkpoint on failure, "
+                        "up to N times (needs --checkpoint-every)")
     p.add_argument("--n-train", type=int, default=60000)
     p.add_argument("--n-test", type=int, default=10000)
     p.add_argument("--profile", default=None, metavar="DIR",
@@ -107,12 +112,25 @@ def main(argv=None) -> Dict[str, float]:
         checkpoint_every=args.checkpoint_every,
         resume=args.resume,
     )
-    trainer = GANTrainer(CVWorkload(n_train=args.n_train, n_test=args.n_test),
-                         config)
     from gan_deeplearning4j_tpu.utils import maybe_trace
 
+    trainer = None
+
+    def make_trainer(resume: bool) -> GANTrainer:
+        nonlocal trainer
+        cfg = (dataclasses.replace(config, resume=True) if resume
+               else config)
+        trainer = GANTrainer(
+            CVWorkload(n_train=args.n_train, n_test=args.n_test), cfg)
+        return trainer
+
     with maybe_trace(args.profile):
-        result = trainer.train()
+        if args.max_restarts > 0:
+            result = train_with_recovery(make_trainer,
+                                         max_restarts=args.max_restarts)
+        else:
+            # config already carries resume=args.resume
+            result = make_trainer(False).train()
     result.update(evaluate(trainer, fid_samples=args.fid_samples))
     print(result)
     return result

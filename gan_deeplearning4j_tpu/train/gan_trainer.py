@@ -119,6 +119,33 @@ class Workload:
         dl4jGANInsurance.java:422-437)."""
 
 
+def train_with_recovery(make_trainer: Callable[[bool], "GANTrainer"],
+                        max_restarts: int = 2,
+                        log: Callable[[str], None] = print) -> Dict[str, float]:
+    """Failure detection / recovery (SURVEY.md §5): run the trainer; on an
+    exception, rebuild it and resume from the latest checkpoint, up to
+    ``max_restarts`` times.  ``make_trainer(resume)`` constructs a fresh
+    trainer (its config must set ``checkpoint_every`` — without
+    checkpoints a restart replays from step 0, which the deterministic
+    data/PRNG order makes correct but wasteful).  The reference has no
+    recovery story beyond Spark task retries (SURVEY §5); deterministic
+    resume (proven in tests/test_train.py) makes restart-equals-never-
+    failed exact here."""
+    attempt = 0
+    while True:
+        trainer = make_trainer(attempt > 0)
+        try:
+            return trainer.train(log=log)
+        except KeyboardInterrupt:
+            raise
+        except Exception as e:  # noqa: BLE001 — any failure is retryable
+            attempt += 1
+            if attempt > max_restarts:
+                raise
+            log(f"training failed ({e!r}); restart {attempt}/{max_restarts} "
+                "from the latest checkpoint")
+
+
 def sync_params(dst, src, mapping) -> None:
     for dst_layer, src_layer, names in mapping:
         dst.set_layer_params(

@@ -191,3 +191,33 @@ def test_prefetch_all_partial_dataset_terminates():
     with PrefetchIterator(it, sharding=None, loop=True, min_rows=8) as pf:
         with pytest.raises(StopIteration):
             next(pf)
+
+
+def test_native_csv_writer_matches_numpy(tmp_path):
+    """The C++ formatter's output parses back to the same values numpy
+    writes, for both %g artifacts and the %.2f+int dataset contract."""
+    from gan_deeplearning4j_tpu.data import native, write_csv_matrix
+
+    if not native.available():
+        pytest.skip("native library not built")
+    rng = np.random.RandomState(0)
+    m = rng.randn(37, 11).astype(np.float32) * np.logspace(
+        -3, 3, 11, dtype=np.float32)
+    # %.8g artifact path (write_csv_matrix prefers the native writer)
+    p = tmp_path / "a.csv"
+    write_csv_matrix(str(p), m)
+    back = np.loadtxt(p, delimiter=",", ndmin=2)
+    np.testing.assert_allclose(back, m, rtol=1e-6)
+    # fixed-decimals + integer label column (dataset contract)
+    table = np.concatenate(
+        [rng.rand(23, 5).astype(np.float32),
+         rng.randint(0, 10, (23, 1)).astype(np.float32)], axis=1)
+    raw = native.format_csv(table, ",", "f", 2, int_last=True)
+    assert raw is not None
+    got = np.loadtxt([ln for ln in raw.decode().splitlines()],
+                     delimiter=",", ndmin=2)
+    np.testing.assert_allclose(got[:, :5], np.round(table[:, :5], 2),
+                               atol=5e-3)
+    np.testing.assert_array_equal(got[:, 5], table[:, 5])
+    # last line carries no trailing newline (reference artifact format)
+    assert not raw.endswith(b"\n")

@@ -149,3 +149,56 @@ def test_eval_metric_units():
     scores = np.array([0.9, 0.8, 0.1, 0.3])
     y = np.array([1, 1, 0, 0])
     assert auroc_from_predictions(scores, y) == pytest.approx(1.0)
+
+
+def test_train_with_recovery_resumes_after_failure(tmp_path):
+    """Failure recovery (SURVEY §5): a crash mid-run restarts from the
+    latest checkpoint and finishes with the same final state a
+    never-failed run produces (deterministic resume)."""
+    from gan_deeplearning4j_tpu.train import insurance_main
+    from gan_deeplearning4j_tpu.train.gan_trainer import (
+        GANTrainer,
+        train_with_recovery,
+    )
+
+    def config(res):
+        return insurance_main.default_config(
+            num_iterations=8, batch_size=20, res_path=res,
+            print_every=10 ** 9, save_every=8, metrics=False, n_devices=1,
+            checkpoint_every=2)
+
+    # reference run, no failure
+    ref_dir = str(tmp_path / "ref")
+    ref = GANTrainer(insurance_main.InsuranceWorkload(), config(ref_dir))
+    ref.train(log=lambda s: None)
+
+    # flaky run: raises once at step 5 (after the step-4 checkpoint)
+    flaky_dir = str(tmp_path / "flaky")
+    state = {"fails_left": 1}
+
+    def make_trainer(resume):
+        cfg = config(flaky_dir)
+        if resume:
+            import dataclasses as dc
+
+            cfg = dc.replace(cfg, resume=True)
+        t = GANTrainer(insurance_main.InsuranceWorkload(), cfg)
+        orig = t._step_bookkeeping
+
+        def flaky_bookkeeping(*a, **kw):
+            if t.batch_counter == 4 and state["fails_left"] > 0:
+                state["fails_left"] -= 1
+                raise RuntimeError("injected failure at step 5")
+            return orig(*a, **kw)
+
+        t._step_bookkeeping = flaky_bookkeeping
+        return t
+
+    res = train_with_recovery(make_trainer, max_restarts=1,
+                              log=lambda s: None)
+    assert res["steps"] == 8
+    assert state["fails_left"] == 0  # the failure actually fired
+    # recovered run's predictions match the never-failed run's exactly
+    a = read_csv_matrix(os.path.join(ref_dir, "insurance_test_predictions_8.csv"))
+    b = read_csv_matrix(os.path.join(flaky_dir, "insurance_test_predictions_8.csv"))
+    np.testing.assert_array_equal(a, b)
