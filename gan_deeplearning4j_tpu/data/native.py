@@ -95,12 +95,19 @@ def read_csv(path: str, skip_lines: int, delimiter: str, dtype) -> Optional[np.n
 
 
 def format_csv(matrix: np.ndarray, delimiter: str = ",", fmt: str = "g",
-               precision: int = 8, int_last: bool = False) -> Optional[bytes]:
+               precision: int = 8, int_last: bool = False,
+               chunk_rows: int = 8192) -> Optional[bytes]:
     """Format a float32 matrix as CSV bytes via the threaded C++ writer
     (the decoder's write-side twin); None if unavailable — caller falls
     back to numpy.  ``fmt``: 'f' (fixed ``precision`` decimals) or 'g'
     (``precision`` significant digits); ``int_last`` prints the final
-    column as an integer (the dataset contract's label column)."""
+    column as an integer (truncated toward zero like numpy's "%d";
+    non-finite labels write 0 where numpy would raise).
+
+    Formats in row chunks so peak memory is bounded by the chunk, not the
+    table (a 60000x785 export would otherwise allocate ~GB transiently);
+    if a chunk's tight capacity estimate is exceeded it retries once with
+    the worst-case bound (63 bytes/value, the C side's snprintf clamp)."""
     lib = _load()
     if lib is None or not hasattr(lib, "fastcsv_format"):
         return None
@@ -114,15 +121,26 @@ def format_csv(matrix: np.ndarray, delimiter: str = ",", fmt: str = "g",
     m = np.ascontiguousarray(m)
     if m.ndim != 2 or m.size == 0:
         return None
-    capacity = m.size * (precision + 16)
-    buf = ctypes.create_string_buffer(capacity)
-    n = lib.fastcsv_format(
-        m.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
-        m.shape[0], m.shape[1], delimiter.encode()[0], fmt.encode()[0],
-        precision, int(int_last), buf, capacity,
-    )
-    if n < 0:
+
+    def fmt_chunk(chunk: np.ndarray) -> Optional[bytes]:
+        for per_value in (precision + 10, 64):
+            capacity = chunk.size * per_value
+            buf = ctypes.create_string_buffer(capacity)
+            n = lib.fastcsv_format(
+                chunk.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+                chunk.shape[0], chunk.shape[1], delimiter.encode()[0],
+                fmt.encode()[0], precision, int(int_last), buf, capacity,
+            )
+            if n >= 0:
+                # copies exactly n bytes (buf.raw would materialize the
+                # whole over-allocated capacity first)
+                return ctypes.string_at(buf, n)
         return None
-    # string_at copies exactly n bytes (buf.raw would materialize the whole
-    # over-allocated capacity first)
-    return ctypes.string_at(buf, n)
+
+    parts = []
+    for lo in range(0, m.shape[0], chunk_rows):
+        part = fmt_chunk(np.ascontiguousarray(m[lo:lo + chunk_rows]))
+        if part is None:
+            return None
+        parts.append(part)
+    return b"\n".join(parts)

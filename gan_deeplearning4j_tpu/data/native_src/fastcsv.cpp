@@ -186,8 +186,11 @@ std::string format_rows(const float* data, long row0, long row1, long cols,
         for (long c = 0; c < cols; c++) {
             int n;
             if (int_last && c == cols - 1) {
-                n = snprintf(buf, sizeof buf, "%ld", (long)(row[c] < 0
-                             ? row[c] - 0.5f : row[c] + 0.5f));
+                // truncate toward zero like numpy's "%d"; guard the cast
+                // (out-of-range/NaN float->long is UB) by writing 0
+                double dv = (double)row[c];
+                if (!(dv > -9.2e18 && dv < 9.2e18)) dv = 0.0;
+                n = snprintf(buf, sizeof buf, "%lld", (long long)dv);
             } else {
                 n = snprintf(buf, sizeof buf, spec, precision,
                              (double)row[c]);

@@ -3,10 +3,10 @@
 
 One ``jax.sharding.Mesh`` + XLA collectives over ICI replace the Spark
 cluster runtime, Kryo serialization, parameter-averaging TrainingMaster,
-and the Aeron parameter server.  Long-context sequence parallelism lives
-here too — first-class, per the framework's scope — in both idioms: ring
-attention (ppermute KV rotation) and Ulysses all-to-all head/sequence
-re-sharding.
+and the Aeron parameter server.  All five sharding axes are carried with
+exactness tests: data (pmean grad sync / param averaging), tensor
+(Megatron column/row), sequence (ring attention AND Ulysses all-to-all),
+pipeline (GPipe microbatch staircase), and expert (all_to_all top-1 MoE).
 """
 
 from gan_deeplearning4j_tpu.parallel.mesh import (

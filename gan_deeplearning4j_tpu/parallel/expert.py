@@ -1,0 +1,129 @@
+"""Expert parallelism — top-1 MoE routing over a mesh axis via all_to_all.
+
+The reference has no mixture-of-experts (SURVEY.md §2c marks EP absent /
+not required), but EP completes the framework's distributed axis set
+(dp/tp/pp/sp/ep).  The ICI idiom, built from XLA collectives:
+
+  - expert ``e`` of ``E`` lives on device ``e`` of the ``expert`` mesh
+    axis; tokens are sharded over the same axis (N/E per device)
+  - a linear router scores each local token; top-1 expert assignment
+  - each device scatters its tokens into an [E, C, F] dispatch buffer
+    (C = per-(src,dst) capacity); ONE ``lax.all_to_all`` turns the
+    expert axis into the source axis — device ``e`` now holds every
+    token routed to expert ``e``
+  - the local expert MLP runs on its [E*C, F] buffer; a second
+    ``all_to_all`` returns outputs to the token owners, which combine
+    them scaled by the router gate
+
+Capacity semantics (standard MoE): a source device can send at most C
+tokens to one expert; overflow tokens are DROPPED (output zero for that
+token — the gate-weighted combine makes the layer a no-op for them).
+Exactness: with C >= the true per-pair demand there are no drops and the
+sharded layer equals the dense single-device computation (tested).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Dict
+
+import jax
+import jax.numpy as jnp
+from jax import lax, shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+
+def expert_mlp(params: Dict, x: jax.Array) -> jax.Array:
+    """The per-expert FFN: dense -> tanh -> dense (params leaves carry a
+    leading expert axis OUTSIDE shard_map; inside, it is stripped)."""
+    h = jnp.tanh(x @ params["W1"] + params["b1"])
+    return h @ params["W2"] + params["b2"]
+
+
+def _moe_body(router_w, expert_params, x, axis_name: str, n_experts: int,
+              capacity: int):
+    expert_params = jax.tree.map(lambda a: a[0], expert_params)
+    n_local = x.shape[0]
+    F = x.shape[1]
+
+    # --- route: top-1 expert + gate per local token --------------------
+    logits = x @ router_w                       # [n_local, E]
+    gates = jax.nn.softmax(logits, axis=-1)
+    expert_idx = jnp.argmax(gates, axis=-1)     # [n_local]
+    gate = jnp.take_along_axis(gates, expert_idx[:, None], axis=1)[:, 0]
+
+    # --- dispatch: position of each token within its expert's quota ----
+    one_hot = jax.nn.one_hot(expert_idx, n_experts, dtype=jnp.int32)
+    pos_in_expert = (jnp.cumsum(one_hot, axis=0) - 1) * one_hot
+    pos = jnp.sum(pos_in_expert, axis=-1)       # [n_local]
+    keep = pos < capacity                       # overflow tokens drop
+    dispatch = jnp.zeros((n_experts, capacity, F), x.dtype)
+    dispatch = dispatch.at[
+        jnp.where(keep, expert_idx, 0),
+        jnp.where(keep, pos, 0)].add(
+        jnp.where(keep[:, None], x, 0.0))
+
+    # --- exchange: expert axis <-> source axis -------------------------
+    # after all_to_all, slot [src, c] on device e holds source src's
+    # c-th token for expert e
+    received = lax.all_to_all(
+        dispatch, axis_name, split_axis=0, concat_axis=0, tiled=True)
+
+    # --- local expert computation --------------------------------------
+    out = expert_mlp(expert_params, received.reshape(-1, F))
+    out = out.reshape(n_experts, capacity, F)
+
+    # --- return to the token owners ------------------------------------
+    returned = lax.all_to_all(
+        out, axis_name, split_axis=0, concat_axis=0, tiled=True)
+    # gather each kept token's output back out of its dispatch slot
+    token_out = returned[
+        jnp.where(keep, expert_idx, 0), jnp.where(keep, pos, 0)]
+    token_out = jnp.where(keep[:, None], token_out, 0.0)
+    return token_out * gate[:, None]
+
+
+def moe_apply(router_w, expert_params, x, mesh: Mesh,
+              axis: str = "expert", capacity: int | None = None) -> jax.Array:
+    """Top-1 MoE layer, tokens and experts sharded over ``axis``.
+
+    ``router_w``: [F, E].  ``expert_params``: pytree with a leading
+    expert axis of size E = mesh.shape[axis].  ``x``: [N, F], N divisible
+    by E.  ``capacity``: per-(source-device, expert) token quota; the
+    default N/E equals each device's WHOLE token count, so no token can
+    ever drop (worst-case-skew safe) at the cost of E-times-balanced
+    all_to_all volume — production configs pass a tighter capacity
+    (e.g. ceil(N/E^2) * slack) and accept dropped-token semantics.
+    """
+    E = mesh.shape[axis]
+    N = x.shape[0]
+    if N % E != 0:
+        raise ValueError(f"token count {N} not divisible by EP degree {E}")
+    if capacity is None:
+        capacity = N // E
+
+    return shard_map(
+        partial(_moe_body, axis_name=axis, n_experts=E, capacity=capacity),
+        mesh=mesh,
+        in_specs=(P(), P(axis), P(axis)),
+        out_specs=P(axis),
+        check_vma=False,
+    )(router_w, expert_params, x)
+
+
+def moe_dense_reference(router_w, expert_params, x) -> jax.Array:
+    """Single-device reference: every token through its top-1 expert
+    (no capacity, no sharding) — what moe_apply must equal when no
+    tokens are dropped."""
+    logits = x @ router_w
+    gates = jax.nn.softmax(logits, axis=-1)
+    expert_idx = jnp.argmax(gates, axis=-1)
+    gate = jnp.take_along_axis(gates, expert_idx[:, None], axis=1)[:, 0]
+    outs = []
+    n_experts = router_w.shape[1]
+    for e in range(n_experts):
+        p = jax.tree.map(lambda a, e=e: a[e], expert_params)
+        outs.append(expert_mlp(p, x))
+    stacked = jnp.stack(outs)                   # [E, N, F]
+    picked = stacked[expert_idx, jnp.arange(x.shape[0])]
+    return picked * gate[:, None]

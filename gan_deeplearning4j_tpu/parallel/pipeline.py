@@ -1,0 +1,107 @@
+"""Pipeline parallelism — GPipe-style microbatched stages over a mesh axis.
+
+The reference has nothing layer-sharded (SURVEY.md §2c marks PP absent /
+not required), but distributed coverage is first-class in this
+framework's scope: when a model outgrows one chip's HBM the remaining
+axis after data/tensor/sequence sharding is DEPTH.  This is the ICI
+idiom for it, built from XLA collectives (no torch/NCCL translation):
+
+  - stage ``s`` of ``S`` lives on device ``s`` of the ``pipe`` mesh axis
+    (stage params are stacked on a leading axis and sharded over it)
+  - the batch splits into ``M`` microbatches; at schedule tick ``t``
+    (T = M + S - 1 ticks total) device ``s`` processes microbatch
+    ``t - s`` when ``0 <= t - s < M`` — the classic GPipe staircase
+  - activations flow stage-to-stage with ONE ``lax.ppermute`` hop per
+    tick (neighbour traffic on the ICI torus); the last stage accumulates
+    its outputs and a final ``psum`` broadcasts them
+  - bubble fraction is (S-1)/T — amortized away by more microbatches
+
+Stages must map activations of one fixed shape to the same shape (the
+rotating buffer is shape-static under jit); heterogeneous-width models
+pad to the pipeline width.  Forward-only here: it is the building block
+the GANPair/fused engines would call per sub-network, and the exactness
+contract (pipeline == sequential composition, tests) is the hard part.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax, shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+
+def _pipe_body(stage_params, micro, fn: Callable, axis_name: str,
+               n_stages: int, n_micro: int):
+    """shard_map body.  stage_params: this device's stage leaves (leading
+    stage axis stripped by sharding).  micro: [M, B, F] microbatches
+    (replicated).  Returns [M, B, F] outputs (replicated via psum)."""
+    s = lax.axis_index(axis_name)
+    # shard_map keeps the sharded stage axis as size 1 — strip it so the
+    # body sees ONE stage's params
+    stage_params = jax.tree.map(lambda a: a[0], stage_params)
+    B, F = micro.shape[1], micro.shape[2]
+    T = n_micro + n_stages - 1
+    perm = [(i, i + 1) for i in range(n_stages - 1)]
+
+    def tick(t, carry):
+        buf, outs = carry
+        # what this device works on at tick t: microbatch m = t - s
+        m = t - s
+        feeding = jnp.logical_and(m >= 0, m < n_micro)
+        # stage 0 reads the microbatch; later stages read the rotated buffer
+        my_in = jnp.where(
+            s == 0,
+            lax.dynamic_index_in_dim(
+                micro, jnp.clip(m, 0, n_micro - 1), keepdims=False),
+            buf)
+        out = fn(stage_params, my_in)
+        out = jnp.where(feeding, out, jnp.zeros_like(out))
+        # last stage: bank the finished microbatch
+        is_last = s == n_stages - 1
+        outs = lax.dynamic_update_index_in_dim(
+            outs,
+            jnp.where(jnp.logical_and(feeding, is_last), out,
+                      lax.dynamic_index_in_dim(
+                          outs, jnp.clip(m, 0, n_micro - 1),
+                          keepdims=False)),
+            jnp.clip(m, 0, n_micro - 1), axis=0)
+        # rotate activations one hop down the pipe for the next tick
+        buf = lax.ppermute(out, axis_name, perm)
+        return buf, outs
+
+    buf = jnp.zeros((B, F), micro.dtype)
+    outs = jnp.zeros((n_micro, B, F), micro.dtype)
+    _, outs = lax.fori_loop(0, T, tick, (buf, outs))
+    # only the last stage holds real outputs; broadcast to every device
+    return lax.psum(outs, axis_name)
+
+
+def pipeline_apply(fn: Callable, stacked_params, x, mesh: Mesh,
+                   axis: str = "pipe", n_micro: int = 4) -> jax.Array:
+    """Run ``x`` through ``S`` pipelined stages.
+
+    ``fn(stage_params, x) -> y`` applies ONE stage (same shape in/out).
+    ``stacked_params``: pytree whose leaves have a leading stage axis of
+    size S = mesh.shape[axis] (stage s's slice lives on pipe device s).
+    ``x``: [N, F] with N divisible by ``n_micro``.
+    Returns [N, F], equal to applying the S stages sequentially.
+    """
+    S = mesh.shape[axis]
+    N = x.shape[0]
+    if N % n_micro != 0:
+        raise ValueError(f"batch {N} not divisible by n_micro {n_micro}")
+    micro = x.reshape(n_micro, N // n_micro, *x.shape[1:])
+
+    out = shard_map(
+        partial(_pipe_body, fn=fn, axis_name=axis, n_stages=S,
+                n_micro=n_micro),
+        mesh=mesh,
+        in_specs=(P(axis), P()),
+        out_specs=P(),
+        check_vma=False,
+    )(stacked_params, micro)
+    return out.reshape(N, *x.shape[1:])

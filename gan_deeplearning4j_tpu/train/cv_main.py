@@ -99,6 +99,9 @@ def main(argv=None) -> Dict[str, float]:
 
     if args.bf16:
         backend.configure(matmul_bf16=True)
+    if args.max_restarts > 0 and args.checkpoint_every <= 0:
+        p.error("--max-restarts needs --checkpoint-every (without "
+                "checkpoints every restart replays from step 0)")
 
     config = default_config(
         num_iterations=args.iterations,
