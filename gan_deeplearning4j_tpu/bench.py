@@ -271,10 +271,17 @@ def main(argv=None) -> None:
     p.add_argument("--profile", default=None, metavar="DIR",
                    help="capture a jax.profiler trace of the timed steps")
     p.add_argument("--skip-e2e", action="store_true")
+    p.add_argument("--batch", type=int, default=200,
+                   help="global batch (default: the reference's 200; the "
+                        "CPU-baseline ratio is only reported at 200, "
+                        "apples to apples)")
     from gan_deeplearning4j_tpu.runtime import backend
 
     backend.add_bf16_flag(p)
     args = p.parse_args(argv)
+
+    global BATCH
+    BATCH = args.batch
 
     import jax
 
@@ -284,13 +291,14 @@ def main(argv=None) -> None:
     cpu = jax.devices("cpu")[0]
 
     # baseline: CPU protocol throughput, measured once and cached
+    # (defined at the reference's batch 200 — no baseline row otherwise)
     baseline = None
-    if os.path.exists(BASELINE_PATH):
+    if BATCH == 200 and os.path.exists(BASELINE_PATH):
         with open(BASELINE_PATH) as f:
             cached = json.load(f)
         if cached.get("version") == METHODOLOGY_VERSION:
             baseline = cached.get("cpu_img_per_sec")
-    if not baseline:
+    if not baseline and BATCH == 200:
         # a CPU step is seconds long — a short schedule is precise enough
         # for a denominator three orders of magnitude below the TPU number
         cpu_step, _ = protocol_step_time(
@@ -312,6 +320,10 @@ def main(argv=None) -> None:
 
     with maybe_trace(args.profile):
         if default.platform == "cpu":
+            if not baseline:
+                raise SystemExit(
+                    "CPU-only host with --batch != 200: no baseline to "
+                    "report (the cached baseline is batch-200 only)")
             value, flops = baseline, None
             step_s = BATCH / baseline
             multi_s = None
@@ -324,12 +336,14 @@ def main(argv=None) -> None:
         "metric": "dcgan_mnist_img_per_sec",
         "value": round(value, 2),
         "unit": "img/sec/chip",
-        "vs_baseline": round(value / baseline, 3),
+        "batch": BATCH,
         "step_ms": round(step_s * 1e3, 3),
         # keyed on what RAN, not on the flag: --bf16 on a CPU-only host
         # still reports the f32 baseline
         "dtype": "bf16" if measured_bf16 else "f32",
     }
+    if baseline:
+        out["vs_baseline"] = round(value / baseline, 3)
     if multi_s:
         # steps_per_call=25 fast path: one dispatch per 25 steps — the
         # gap vs step_ms is pure dispatch latency (large on a tunnel)

@@ -43,7 +43,6 @@ def _pipe_body(stage_params, micro, fn: Callable, axis_name: str,
     # shard_map keeps the sharded stage axis as size 1 — strip it so the
     # body sees ONE stage's params
     stage_params = jax.tree.map(lambda a: a[0], stage_params)
-    B, F = micro.shape[1], micro.shape[2]
     T = n_micro + n_stages - 1
     perm = [(i, i + 1) for i in range(n_stages - 1)]
 
@@ -73,8 +72,9 @@ def _pipe_body(stage_params, micro, fn: Callable, axis_name: str,
         buf = lax.ppermute(out, axis_name, perm)
         return buf, outs
 
-    buf = jnp.zeros((B, F), micro.dtype)
-    outs = jnp.zeros((n_micro, B, F), micro.dtype)
+    # one-microbatch activation buffer / banked outputs, any rank
+    buf = jnp.zeros(micro.shape[1:], micro.dtype)
+    outs = jnp.zeros(micro.shape, micro.dtype)
     _, outs = lax.fori_loop(0, T, tick, (buf, outs))
     # only the last stage holds real outputs; broadcast to every device
     return lax.psum(outs, axis_name)

@@ -70,3 +70,23 @@ def test_pipeline_differentiable(cpu_devices):
     for a, b in zip(jax.tree.leaves(gp), jax.tree.leaves(gs)):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                    rtol=1e-4, atol=1e-5)
+
+
+def test_pipeline_nd_activations(cpu_devices):
+    """Activations of any rank flow through the staircase (conv-style
+    [B, C, H] stages, not just [B, F])."""
+    rng = np.random.RandomState(3)
+    stages = 4
+    scales = jnp.asarray(rng.rand(stages, 1, 1, 1).astype(np.float32) + 0.5)
+
+    def stage(p, x):
+        return jnp.tanh(x * p)
+
+    x = jnp.asarray(rng.randn(8, 3, 5).astype(np.float32))
+    mesh = make_mesh({"pipe": stages})
+    out = pipeline_apply(stage, scales, x, mesh, n_micro=2)
+    ref = x
+    for s in range(stages):
+        ref = jnp.tanh(ref * scales[s])
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-5, atol=1e-6)
