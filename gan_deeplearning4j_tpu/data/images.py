@@ -1,0 +1,106 @@
+"""Image record reader — DataVec's image pipeline, TPU-native.
+
+The reference's classpath carries DataVec's image readers
+(``datavec-data-image`` + OpenCV/leptonica, ``dl4jGAN.iml`` — SURVEY.md
+§2b: unused by the mains, whose data arrives as CSV, and slated for
+"PIL/numpy loaders" in the rebuild).  This is that loader: a directory
+of images becomes an NCHW float32 table, with DataVec's
+``ParentPathLabelGenerator`` convention (label = parent directory name)
+when subdirectories are present.
+
+No OpenCV: PIL decodes/resizes (already in the environment via
+matplotlib), numpy lays out [N, C, H, W] scaled to [0, 1] — matching the
+notebook's /255 convention (gan.ipynb cell 2) — with an optional
+[-1, 1] tanh range for the roadmap GAN families.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+_EXTENSIONS = (".png", ".jpg", ".jpeg", ".bmp", ".gif")
+
+
+@dataclasses.dataclass(frozen=True)
+class ImageRecordReader:
+    """Decode images to [C, height, width] float32.
+
+    ``channels``: 1 (grayscale) or 3 (RGB).  ``tanh_range``: scale to
+    [-1, 1] instead of [0, 1] (the roadmap generators' output range).
+    """
+
+    height: int
+    width: int
+    channels: int = 3
+    tanh_range: bool = False
+
+    def read_image(self, path: str) -> np.ndarray:
+        from PIL import Image
+
+        with Image.open(path) as im:
+            im = im.convert("L" if self.channels == 1 else "RGB")
+            im = im.resize((self.width, self.height), Image.BILINEAR)
+            arr = np.asarray(im, dtype=np.float32) / 255.0
+        if self.channels == 1:
+            arr = arr[None]                       # [1, H, W]
+        else:
+            arr = np.transpose(arr, (2, 0, 1))    # HWC -> CHW
+        if self.tanh_range:
+            arr = arr * 2.0 - 1.0
+        return arr
+
+    def read_folder(
+        self, root: str, flatten: bool = True,
+        limit: Optional[int] = None,
+    ) -> Tuple[np.ndarray, Optional[np.ndarray], List[str]]:
+        """Read a directory tree of images.
+
+        Layout A (labelled, DataVec ParentPathLabelGenerator):
+        ``root/<class_name>/img.png`` — returns (features, labels,
+        class_names) with labels indexing the sorted class names.
+        Layout B (unlabelled): images directly under ``root`` — returns
+        (features, None, []).
+
+        ``flatten``: [N, C*H*W] (the graph APIs' cnn_flat input layout)
+        instead of [N, C, H, W].
+        """
+        def images_in(d: str) -> List[str]:
+            return sorted(f for f in os.listdir(d)
+                          if f.lower().endswith(_EXTENSIONS))
+
+        # a directory is a class dir only if it actually holds images —
+        # a stray .thumbnails/ must not flip a flat folder into
+        # labelled mode
+        classes = sorted(
+            d for d in os.listdir(root)
+            if os.path.isdir(os.path.join(root, d))
+            and images_in(os.path.join(root, d)))
+        files: List[Tuple[str, int]] = []
+        if classes:
+            # interleave classes so a ``limit`` keeps class balance
+            # (a class-sorted list would drop later classes entirely)
+            per_class = [
+                [(os.path.join(root, cls, f), idx)
+                 for f in images_in(os.path.join(root, cls))]
+                for idx, cls in enumerate(classes)]
+            longest = max(len(lst) for lst in per_class)
+            for i in range(longest):
+                for lst in per_class:
+                    if i < len(lst):
+                        files.append(lst[i])
+        else:
+            files = [(os.path.join(root, f), -1) for f in images_in(root)]
+        if limit is not None:
+            files = files[:limit]
+        if not files:
+            raise FileNotFoundError(f"no images under {root}")
+        feats = np.stack([self.read_image(p) for p, _ in files])
+        labels = (np.asarray([lab for _, lab in files], dtype=np.int64)
+                  if classes else None)
+        if flatten:
+            feats = feats.reshape(feats.shape[0], -1)
+        return feats, labels, classes

@@ -31,11 +31,24 @@ from gan_deeplearning4j_tpu.utils import MetricsLogger, device_fence
 FAMILIES = ("cgan-cifar10", "wgan-gp", "celeba")
 
 
-def _build(family: str, mesh):
+SAMPLE_SHAPES = {
+    "cgan-cifar10": (3, 32, 32),
+    "wgan-gp": (1, 28, 28),
+    "celeba": (3, 64, 64),
+}
+
+
+def _build(family: str, mesh, num_classes: int = None):
     if family == "cgan-cifar10":
+        import dataclasses
+
         from gan_deeplearning4j_tpu.models import cgan_cifar10 as M
 
         cfg = M.CGANConfig()
+        if num_classes is not None and num_classes != cfg.num_classes:
+            # the label input's width must match the dataset's class count
+            # (a real --data-dir tree can have any number of class dirs)
+            cfg = dataclasses.replace(cfg, num_classes=num_classes)
         pair = GANPair(M.build_generator(cfg), M.build_discriminator(cfg),
                        mesh=mesh)
         return pair, cfg, (cfg.channels, cfg.height, cfg.width)
@@ -56,11 +69,29 @@ def _build(family: str, mesh):
     raise ValueError(f"unknown family {family!r}; choose from {FAMILIES}")
 
 
-def _data(family: str, n: int, seed: int):
+def _data(family: str, n: int, seed: int, sample_shape=None,
+          data_dir: str = None):
     """(features[n, C*H*W], onehot_labels[n, 10] or None), tanh range
-    except wgan-gp (sigmoid generator head -> [0, 1] data)."""
+    except wgan-gp (sigmoid generator head -> [0, 1] data).
+
+    ``data_dir``: directory of real images (DataVec-style
+    ``dir/<class>/img.png`` for the conditional family, flat images
+    otherwise) read via data/images.py; default = the synthetic
+    surrogates (no network egress in this environment)."""
     from gan_deeplearning4j_tpu.data import datasets
 
+    if data_dir:
+        from gan_deeplearning4j_tpu.data.images import ImageRecordReader
+
+        c, h, w = sample_shape
+        reader = ImageRecordReader(h, w, c, tanh_range=(family != "wgan-gp"))
+        x, labels, classes = reader.read_folder(data_dir, limit=n)
+        if family == "cgan-cifar10":
+            if labels is None:
+                raise ValueError(
+                    "cgan-cifar10 needs class subdirectories in --data-dir")
+            return x, np.eye(len(classes), dtype=np.float32)[labels]
+        return x, None
     if family == "cgan-cifar10":
         x, y = datasets.synthetic_cifar10(n, seed=seed)
         return x, np.eye(10, dtype=np.float32)[y]
@@ -72,15 +103,20 @@ def _data(family: str, n: int, seed: int):
 
 def train(family: str, iterations: int, batch_size: int, res_path: str,
           n_train: int, print_every: int, n_devices=None,
-          log=print) -> Dict[str, float]:
+          data_dir: str = None, log=print) -> Dict[str, float]:
     os.makedirs(res_path, exist_ok=True)
     mesh = None
     if n_devices and n_devices > 1:
         from gan_deeplearning4j_tpu.parallel import data_mesh
 
         mesh = data_mesh(n_devices)
-    pair, cfg, sample_shape = _build(family, mesh)
-    x, y = _data(family, n_train, cfg.seed)
+    # data first: a real --data-dir can dictate the class count the
+    # conditional model's label input must match
+    x, y = _data(family, n_train, prng.NUMBER_OF_THE_BEAST,
+                 SAMPLE_SHAPES[family], data_dir)
+    n_train = x.shape[0]
+    pair, cfg, sample_shape = _build(
+        family, mesh, num_classes=None if y is None else y.shape[1])
     n_critic = getattr(cfg, "n_critic", 1)
 
     root = prng.root_key(cfg.seed)
@@ -96,8 +132,9 @@ def train(family: str, iterations: int, batch_size: int, res_path: str,
                                 minval=-1.0, maxval=1.0)
     eval_cond = None
     if y is not None:
+        k = y.shape[1]
         eval_cond = jnp.asarray(
-            np.eye(10, dtype=np.float32)[np.arange(64) % 10])
+            np.eye(k, dtype=np.float32)[np.arange(64) % k])
 
     steady_t0 = None
     d_loss = g_loss = jnp.zeros(())
@@ -186,6 +223,10 @@ def main(argv=None) -> Dict[str, float]:
     p.add_argument("--n-train", type=int, default=10000)
     p.add_argument("--print-every", type=int, default=500)
     p.add_argument("--n-devices", type=int, default=None)
+    p.add_argument("--data-dir", default=None,
+                   help="directory of real images (class subdirs for the "
+                        "conditional family) instead of the synthetic "
+                        "surrogate")
     from gan_deeplearning4j_tpu.runtime import backend
 
     backend.add_bf16_flag(p)
@@ -194,7 +235,8 @@ def main(argv=None) -> Dict[str, float]:
         backend.configure(matmul_bf16=True)
     res = args.res_path or os.path.join("outputs", args.family)
     result = train(args.family, args.iterations, args.batch_size, res,
-                   args.n_train, args.print_every, args.n_devices)
+                   args.n_train, args.print_every, args.n_devices,
+                   data_dir=args.data_dir)
     print(result)
     return result
 
