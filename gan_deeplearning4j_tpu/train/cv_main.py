@@ -12,7 +12,6 @@ Run: ``python -m gan_deeplearning4j_tpu.train.cv_main --iterations 10000``
 from __future__ import annotations
 
 import argparse
-import dataclasses
 from typing import Dict
 
 from gan_deeplearning4j_tpu.data import ensure_mnist_csv
@@ -21,7 +20,8 @@ from gan_deeplearning4j_tpu.train.gan_trainer import (
     GANTrainer,
     GANTrainerConfig,
     Workload,
-    train_with_recovery,
+    check_recovery_args,
+    run_with_recovery,
 )
 
 
@@ -99,9 +99,7 @@ def main(argv=None) -> Dict[str, float]:
 
     if args.bf16:
         backend.configure(matmul_bf16=True)
-    if args.max_restarts > 0 and args.checkpoint_every <= 0:
-        p.error("--max-restarts needs --checkpoint-every (without "
-                "checkpoints every restart replays from step 0)")
+    check_recovery_args(p, args)
 
     config = default_config(
         num_iterations=args.iterations,
@@ -117,23 +115,11 @@ def main(argv=None) -> Dict[str, float]:
     )
     from gan_deeplearning4j_tpu.utils import maybe_trace
 
-    trainer = None
-
-    def make_trainer(resume: bool) -> GANTrainer:
-        nonlocal trainer
-        cfg = (dataclasses.replace(config, resume=True) if resume
-               else config)
-        trainer = GANTrainer(
-            CVWorkload(n_train=args.n_train, n_test=args.n_test), cfg)
-        return trainer
-
     with maybe_trace(args.profile):
-        if args.max_restarts > 0:
-            result = train_with_recovery(make_trainer,
-                                         max_restarts=args.max_restarts)
-        else:
-            # config already carries resume=args.resume
-            result = make_trainer(False).train()
+        trainer, result = run_with_recovery(
+            config,
+            lambda: CVWorkload(n_train=args.n_train, n_test=args.n_test),
+            max_restarts=args.max_restarts)
     result.update(evaluate(trainer, fid_samples=args.fid_samples))
     print(result)
     return result

@@ -146,6 +146,33 @@ def train_with_recovery(make_trainer: Callable[[bool], "GANTrainer"],
                 "from the latest checkpoint")
 
 
+def check_recovery_args(parser, args) -> None:
+    """Shared CLI validation for the mains' --max-restarts flag."""
+    if args.max_restarts > 0 and args.checkpoint_every <= 0:
+        parser.error("--max-restarts needs --checkpoint-every (without "
+                     "checkpoints every restart replays from step 0)")
+
+
+def run_with_recovery(config: "GANTrainerConfig", make_workload,
+                      max_restarts: int = 0):
+    """Shared main wiring: construct the trainer (fresh workload each
+    attempt, resume=True on retries) and train, optionally under
+    train_with_recovery.  Returns (trainer, result) — the trainer is the
+    last (successful) one, for post-run evaluation."""
+    holder = {}
+
+    def make_trainer(resume: bool) -> "GANTrainer":
+        cfg = dataclasses.replace(config, resume=True) if resume else config
+        holder["trainer"] = GANTrainer(make_workload(), cfg)
+        return holder["trainer"]
+
+    if max_restarts > 0:
+        result = train_with_recovery(make_trainer, max_restarts=max_restarts)
+    else:
+        result = make_trainer(False).train()
+    return holder["trainer"], result
+
+
 def sync_params(dst, src, mapping) -> None:
     for dst_layer, src_layer, names in mapping:
         dst.set_layer_params(

@@ -24,6 +24,8 @@ from gan_deeplearning4j_tpu.train.gan_trainer import (
     GANTrainer,
     GANTrainerConfig,
     Workload,
+    check_recovery_args,
+    run_with_recovery,
 )
 
 
@@ -86,6 +88,9 @@ def main(argv=None) -> Dict[str, float]:
     p.add_argument("--averaging-frequency", type=int, default=5)
     p.add_argument("--checkpoint-every", type=int, default=0)
     p.add_argument("--resume", action="store_true")
+    p.add_argument("--max-restarts", type=int, default=0,
+                   help="auto-resume from the latest checkpoint on failure, "
+                        "up to N times (needs --checkpoint-every)")
     p.add_argument("--profile", default=None, metavar="DIR",
                    help="capture a jax.profiler trace of the run into DIR")
     from gan_deeplearning4j_tpu.runtime import backend
@@ -95,6 +100,7 @@ def main(argv=None) -> Dict[str, float]:
 
     if args.bf16:
         backend.configure(matmul_bf16=True)
+    check_recovery_args(p, args)
 
     config = default_config(
         num_iterations=args.iterations,
@@ -108,11 +114,11 @@ def main(argv=None) -> Dict[str, float]:
         checkpoint_every=args.checkpoint_every,
         resume=args.resume,
     )
-    trainer = GANTrainer(InsuranceWorkload(), config)
     from gan_deeplearning4j_tpu.utils import maybe_trace
 
     with maybe_trace(args.profile):
-        result = trainer.train()
+        trainer, result = run_with_recovery(
+            config, InsuranceWorkload, max_restarts=args.max_restarts)
     result.update(evaluate(trainer))
     print(result)
     return result
