@@ -171,6 +171,98 @@ class GANPair:
         sh = mesh_lib.batch_sharding(self.mesh, self.axis)
         return jax.tree.map(lambda x: jax.device_put(jnp.asarray(x), sh), tree)
 
+    def make_multistep(self, table_x, table_cond=None, *,
+                       batch_size: int, steps_per_call: int,
+                       n_critic: int = 1, real_label: float = 1.0,
+                       z_size: int, seed_key=None):
+        """Fused multi-iteration training: ONE jitted program advances
+        ``steps_per_call`` full (n_critic D-steps + 1 G-step) iterations
+        via ``lax.scan``, with the dataset device-resident and batches
+        sampled on-device (uniform with replacement, counter-based keys)
+        — the same dispatch-amortization as the protocol trainer's
+        steps_per_call (train/fused_step.py), for the roadmap engine.
+
+        Single-device only (the mesh path keeps per-step dispatch);
+        donation is off (donation + scan crashes the axon TPU runtime).
+        Returns (step_fn, state0):
+          step_fn(state) -> (state', (d_losses[K], g_losses[K]))
+          state = (params_g, opt_g, params_d, opt_d, it)
+        """
+        if self.mesh is not None:
+            raise ValueError("multistep is single-device; mesh users keep "
+                             "the per-step path")
+        n_rows = table_x.shape[0]
+        key0 = (seed_key if seed_key is not None
+                else prng.stream(prng.root_key(self.gen.seed), "pair-multi"))
+        y_real_v = jnp.full((batch_size, 1), real_label, jnp.float32)
+        y_fake_v = (-jnp.ones((batch_size, 1), jnp.float32)
+                    if self.mode == "wgan-gp"
+                    else jnp.zeros((batch_size, 1), jnp.float32))
+        y_gen_v = jnp.ones((batch_size, 1), jnp.float32)
+        label_name = self.gen.input_names[1] if len(
+            self.gen.input_names) > 1 else None
+
+        def _multi(state, table_x, table_cond, y_real_v, y_fake_v, y_gen_v,
+                   key0):
+            # the dataset/label vectors/keys arrive as ARGUMENTS, not
+            # closed-over constants — the fused_step.py rule: on a
+            # tunneled PJRT backend closure-captured device constants
+            # cost per-execution overhead and bloat the program
+            def draw(key, which):
+                k = jax.random.fold_in(key, which)
+                idx = jax.random.randint(
+                    jax.random.fold_in(k, 0), (batch_size,), 0, n_rows)
+                z = jax.random.uniform(
+                    jax.random.fold_in(k, 1), (batch_size, z_size),
+                    minval=-1.0, maxval=1.0)
+                return idx, z
+
+            def cond_of(idx):
+                if table_cond is None:
+                    return {}
+                return {label_name: table_cond[idx]}
+
+            def one_iteration(carry, _):
+                pg, og, pd, od, it = carry
+                key = jax.random.fold_in(key0, it)
+                d_loss = jnp.zeros(())
+                for j in range(n_critic):
+                    idx, z = draw(key, j)
+                    z_in = {self.gen.input_names[0]: z}
+                    c = cond_of(idx)
+                    z_in.update(c)
+                    pd, od, d_loss = self._d_step(
+                        pd, od, pg, prng.stream(key, f"d{j}"),
+                        table_x[idx], z_in, c, c, y_real_v, y_fake_v)
+                idx, z = draw(key, n_critic)
+                z_in = {self.gen.input_names[0]: z}
+                c = cond_of(idx)
+                z_in.update(c)
+                pg, og, g_loss = self._g_step(
+                    pg, og, pd, prng.stream(key, "g"), z_in, c, y_gen_v)
+                return (pg, og, pd, od, it + 1), (d_loss, g_loss)
+
+            return lax.scan(one_iteration, state, None,
+                            length=steps_per_call)
+
+        jit_multi = jax.jit(_multi)
+        invariants = (table_x, table_cond, y_real_v, y_fake_v, y_gen_v,
+                      key0)
+
+        def step_fn(state):
+            return jit_multi(state, *invariants)
+
+        state0 = (self.gen.params, self.gen.opt_state,
+                  self.dis.params, self.dis.opt_state,
+                  jnp.asarray(0, jnp.int32))
+        return step_fn, state0
+
+    def adopt_state(self, state) -> None:
+        """Write a multistep scan state back into the graph objects (for
+        artifact dumps / serialization)."""
+        (self.gen.params, self.gen.opt_state,
+         self.dis.params, self.dis.opt_state, _) = state
+
     def d_step(self, real, z_inputs: Dict, cond_real: Optional[Dict] = None,
                cond_fake: Optional[Dict] = None,
                y_real=None, y_fake=None) -> jax.Array:

@@ -92,13 +92,20 @@ def test_two_process_gradient_sync_matches_single_host(tmp_path):
         for pid in range(2)
     ]
     results = {}
-    for p in procs:
-        out, err = p.communicate(timeout=220)
-        assert p.returncode == 0, err[-2000:]
-        for line in out.splitlines():
-            if line.startswith("RESULT"):
-                rec = json.loads(line[len("RESULT"):])
-                results[rec["pid"]] = rec
+    try:
+        for p in procs:
+            out, err = p.communicate(timeout=220)
+            assert p.returncode == 0, err[-2000:]
+            for line in out.splitlines():
+                if line.startswith("RESULT"):
+                    rec = json.loads(line[len("RESULT"):])
+                    results[rec["pid"]] = rec
+    finally:
+        # a failing/timed-out worker must not orphan its peer blocked in
+        # the distributed rendezvous
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
     assert set(results) == {0, 1}
     # 2 processes x 2 virtual devices each = a 4-device global mesh
     assert results[0]["devices"] == 4
