@@ -42,6 +42,10 @@ class CelebAConfig:
     z_size: int = 100
     base_filters: int = 64
     learning_rate: float = 0.0002
+    # TTUR + one-sided label smoothing (same rationale as cgan_cifar10:
+    # without them D wins outright on the easy synthetic surrogate)
+    d_learning_rate: float = 0.0001
+    real_label: float = 0.9
     clip: float = 1.0
     bf16: Optional[bool] = None  # None = follow runtime policy
 
@@ -81,7 +85,7 @@ def build_generator(cfg: CelebAConfig = CelebAConfig()):
 
 
 def build_discriminator(cfg: CelebAConfig = CelebAConfig()):
-    lr = Adam(cfg.learning_rate, 0.5, 0.999)
+    lr = Adam(cfg.d_learning_rate, 0.5, 0.999)
     f = cfg.base_filters
     b = GraphBuilder(seed=cfg.seed, activation="leakyrelu",
                      weight_init="xavier", clip_threshold=cfg.clip)
