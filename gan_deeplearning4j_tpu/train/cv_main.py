@@ -50,8 +50,8 @@ class CVWorkload(Workload):
     def ensure_data(self, res_path: str):
         return ensure_mnist_csv(res_path, self.n_train, self.n_test)
 
-    def grid_extra_dump(self, trainer, grid_out, step):
-        pass  # the CV main dumps only the grid itself
+    def grid_extra_arrays(self, trainer, grid_out, step):
+        return []  # the CV main dumps only the grid itself
 
 
 def default_config(**overrides) -> GANTrainerConfig:
@@ -82,6 +82,10 @@ def main(argv=None) -> Dict[str, float]:
     p.add_argument("--averaging-frequency", type=int, default=10)
     p.add_argument("--checkpoint-every", type=int, default=0)
     p.add_argument("--resume", action="store_true")
+    p.add_argument("--sync-dumps", action="store_true",
+                   help="write artifacts synchronously on the training "
+                        "thread (the reference's behavior) instead of the "
+                        "background artifact writer")
     p.add_argument("--max-restarts", type=int, default=0,
                    help="auto-resume from the latest checkpoint on failure, "
                         "up to N times (needs --checkpoint-every)")
@@ -112,6 +116,7 @@ def main(argv=None) -> Dict[str, float]:
         averaging_frequency=args.averaging_frequency,
         checkpoint_every=args.checkpoint_every,
         resume=args.resume,
+        async_dumps=not args.sync_dumps,
     )
     from gan_deeplearning4j_tpu.utils import maybe_trace
 

@@ -49,6 +49,7 @@ from gan_deeplearning4j_tpu.parallel import DataParallelGraph, data_mesh
 from gan_deeplearning4j_tpu.parallel import mesh as mesh_lib
 from gan_deeplearning4j_tpu.runtime import prng
 from gan_deeplearning4j_tpu.utils import MetricsLogger, device_fence
+from gan_deeplearning4j_tpu.utils.async_dump import AsyncArtifactWriter
 
 
 @dataclasses.dataclass
@@ -91,6 +92,11 @@ class GANTrainerConfig:
     checkpoint_keep: int = 3
     resume: bool = False
     metrics: bool = True
+    # Artifact dumps: device compute is dispatched on the training thread
+    # (exact step-k snapshot), readback + CSV write run on a background
+    # worker so the device never idles on the ~70ms tunnel round trip.
+    # False = the reference's synchronous behavior.
+    async_dumps: bool = True
 
 
 class Workload:
@@ -112,11 +118,15 @@ class Workload:
         """Return (train_csv, test_csv)."""
         raise NotImplementedError
 
-    def grid_extra_dump(self, trainer: "GANTrainer", grid_out: np.ndarray,
-                        step: int) -> None:
-        """Workload-specific extra artifact at print_every (the insurance
-        main dumps classifier predictions over the generated grid,
-        dl4jGANInsurance.java:422-437)."""
+    def grid_extra_arrays(self, trainer: "GANTrainer", grid_out,
+                          step: int) -> list:
+        """Workload-specific extra artifacts at print_every, as
+        ``[(path, array)]`` pairs (the insurance main dumps classifier
+        predictions over the generated grid, dl4jGANInsurance.java:422-437).
+        Dispatch any device compute here, on the training thread — the
+        returned arrays are materialized and written by the async artifact
+        writer."""
+        return []
 
 
 def train_with_recovery(make_trainer: Callable[[bool], "GANTrainer"],
@@ -293,18 +303,27 @@ class GANTrainer:
         self._test_batches = None
         self._steps_per_call = 1
         self._fused_multi = None
+        # inline writer until train() swaps in the background one, so the
+        # dump methods also work when called directly (tests, notebooks)
+        self._dumper = AsyncArtifactWriter(synchronous=True)
 
     # -- artifact dumps ------------------------------------------------------
 
     def _dump_grid(self) -> None:
+        # dispatch on this thread (step-k param snapshot), write on the worker
         out = self.gen.output(self.z_grid)[0]
-        out = np.asarray(out).reshape(self.z_grid.shape[0], self.c.num_features)
-        write_csv_matrix(
-            os.path.join(self.c.res_path,
-                         f"{self.c.dataset_name}_out_{self.batch_counter}.csv"),
-            out,
-        )
-        self.w.grid_extra_dump(self, out, self.batch_counter)
+        out = out.reshape(self.z_grid.shape[0], self.c.num_features)
+        path = os.path.join(
+            self.c.res_path,
+            f"{self.c.dataset_name}_out_{self.batch_counter}.csv")
+        extras = self.w.grid_extra_arrays(self, out, self.batch_counter)
+
+        def write(out=out, path=path, extras=extras):
+            write_csv_matrix(path, np.asarray(out))
+            for p, arr in extras:
+                write_csv_matrix(p, np.asarray(arr))
+
+        self._dumper.submit(write)
 
     def _dump_predictions(self, iter_test: RecordReaderDataSetIterator) -> None:
         # the test set is loop-invariant: transfer it once and reuse the
@@ -316,18 +335,20 @@ class GANTrainer:
             while iter_test.has_next():
                 batches.append(jnp.asarray(iter_test.next().features))
             self._test_batches = batches
-        # dispatch every batch, then one overlapped readback — per-batch
-        # round trips would serialize on a tunneled link
+        # dispatch every batch on this thread, then hand the overlapped
+        # readback (per-batch round trips would serialize on a tunneled
+        # link) and the CSV write to the worker
         from gan_deeplearning4j_tpu.utils import overlap_device_get
 
-        preds = overlap_device_get(
-            [self.classifier.output(xb)[0] for xb in self._test_batches])
-        write_csv_matrix(
-            os.path.join(
-                self.c.res_path,
-                f"{self.c.dataset_name}_test_predictions_{self.batch_counter}.csv"),
-            np.vstack(preds),
-        )
+        outs = [self.classifier.output(xb)[0] for xb in self._test_batches]
+        path = os.path.join(
+            self.c.res_path,
+            f"{self.c.dataset_name}_test_predictions_{self.batch_counter}.csv")
+
+        def write(outs=outs, path=path):
+            write_csv_matrix(path, np.vstack(overlap_device_get(outs)))
+
+        self._dumper.submit(write)
 
     # -- checkpointing -------------------------------------------------------
 
@@ -337,6 +358,10 @@ class GANTrainer:
 
     def _maybe_checkpoint(self) -> None:
         if self.checkpointer and self.batch_counter % self.c.checkpoint_every == 0:
+            # drain queued artifact writes first: once this checkpoint
+            # exists, a crash-resume continues past this step and would
+            # never re-create artifacts that were still in the queue
+            self._dumper.flush()
             # no RNG state needed: the z-stream is counter-based, derived
             # from batch_counter (the checkpoint step) alone
             self.checkpointer.save(
@@ -415,46 +440,54 @@ class GANTrainer:
                 self.dis, self.gen, self.gan, self.classifier,
                 start_step=self.batch_counter)
 
-        if resident:
-            # the whole training table lives in HBM; the fused step slices
-            # its own batches from the device counter — no per-step
-            # host->device traffic and no host data loop at all.  Under a
-            # mesh, place it replicated ONCE (an uncommitted single-device
-            # array would be re-broadcast by jit every step).
-            if self._mesh is not None:
-                rep = jax.sharding.NamedSharding(
-                    self._mesh, jax.sharding.PartitionSpec())
-                dev_features = jax.device_put(iter_train.features, rep)
-                dev_labels = jax.device_put(iter_train.labels, rep)
+        # artifact materialization runs on a background worker for the
+        # whole loop; the with-block guarantees every dump is on disk (or
+        # its error raised) before the end-of-run models/metrics below
+        self._dumper = AsyncArtifactWriter(synchronous=not c.async_dumps)
+        with self._dumper:
+            if resident:
+                # the whole training table lives in HBM; the fused step
+                # slices its own batches from the device counter — no
+                # per-step host->device traffic and no host data loop at
+                # all.  Under a mesh, place it replicated ONCE (an
+                # uncommitted single-device array would be re-broadcast by
+                # jit every step).
+                if self._mesh is not None:
+                    rep = jax.sharding.NamedSharding(
+                        self._mesh, jax.sharding.PartitionSpec())
+                    dev_features = jax.device_put(iter_train.features, rep)
+                    dev_labels = jax.device_put(iter_train.labels, rep)
+                else:
+                    dev_features = jnp.asarray(iter_train.features)
+                    dev_labels = jnp.asarray(iter_train.labels)
+                self._resident_loop(dev_features, dev_labels, iter_test,
+                                    fused_state, log)
             else:
-                dev_features = jnp.asarray(iter_train.features)
-                dev_labels = jnp.asarray(iter_train.labels)
-            self._resident_loop(dev_features, dev_labels, iter_test,
-                                fused_state, log)
-        else:
-            # Background prefetch (SURVEY.md §3.2 hot-loop note: the
-            # reference decodes CSV on the training thread every iteration
-            # — here a worker thread decodes AND starts the host->device
-            # transfer for batch k+depth while the device computes batch
-            # k).  The fused path transfers straight to its batch
-            # sharding; other paths keep host arrays (DataParallelGraph
-            # owns their placement).
-            from gan_deeplearning4j_tpu.data.prefetch import PrefetchIterator
+                # Background prefetch (SURVEY.md §3.2 hot-loop note: the
+                # reference decodes CSV on the training thread every
+                # iteration — here a worker thread decodes AND starts the
+                # host->device transfer for batch k+depth while the device
+                # computes batch k).  The fused path transfers straight to
+                # its batch sharding; other paths keep host arrays
+                # (DataParallelGraph owns their placement).
+                from gan_deeplearning4j_tpu.data.prefetch import (
+                    PrefetchIterator,
+                )
 
-            sharding = None
-            if self._fused_step is not None:
-                sharding = self._batch_sharding
-                if sharding is None:
-                    sharding = jax.sharding.SingleDeviceSharding(
-                        jax.devices()[0])
-            prefetch = PrefetchIterator(
-                iter_train, prefetch_depth=2, sharding=sharding, loop=True,
-                min_rows=c.batch_size)
-            try:
-                self._train_loop(prefetch, iter_test, fused_state, ones,
-                                 y_dis, log)
-            finally:
-                prefetch.close()
+                sharding = None
+                if self._fused_step is not None:
+                    sharding = self._batch_sharding
+                    if sharding is None:
+                        sharding = jax.sharding.SingleDeviceSharding(
+                            jax.devices()[0])
+                prefetch = PrefetchIterator(
+                    iter_train, prefetch_depth=2, sharding=sharding,
+                    loop=True, min_rows=c.batch_size)
+                try:
+                    self._train_loop(prefetch, iter_test, fused_state, ones,
+                                     y_dis, log)
+                finally:
+                    prefetch.close()
 
         if self._fused_step is not None and self._final_state is not None:
             self._fused_lib.state_to_graphs(

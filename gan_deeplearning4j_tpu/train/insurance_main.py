@@ -16,9 +16,8 @@ import os
 from typing import Dict
 
 import jax.numpy as jnp
-import numpy as np
 
-from gan_deeplearning4j_tpu.data import ensure_insurance_csv, write_csv_matrix
+from gan_deeplearning4j_tpu.data import ensure_insurance_csv
 from gan_deeplearning4j_tpu.models import mlpgan_insurance as M
 from gan_deeplearning4j_tpu.train.gan_trainer import (
     GANTrainer,
@@ -51,12 +50,14 @@ class InsuranceWorkload(Workload):
     def ensure_data(self, res_path: str):
         return ensure_insurance_csv(res_path)
 
-    def grid_extra_dump(self, trainer, grid_out: np.ndarray, step: int):
+    def grid_extra_arrays(self, trainer, grid_out, step: int):
+        # classifier predictions over the generated lattice grid
+        # (dl4jGANInsurance.java:422-437); dispatched here on the training
+        # thread, written by the async artifact writer
         preds = trainer.classifier.output(jnp.asarray(grid_out))[0]
-        write_csv_matrix(
-            os.path.join(trainer.c.res_path, f"insurance_out_pred_{step}.csv"),
-            np.asarray(preds),
-        )
+        path = os.path.join(trainer.c.res_path,
+                            f"insurance_out_pred_{step}.csv")
+        return [(path, preds)]
 
 
 def default_config(**overrides) -> GANTrainerConfig:
@@ -88,6 +89,10 @@ def main(argv=None) -> Dict[str, float]:
     p.add_argument("--averaging-frequency", type=int, default=5)
     p.add_argument("--checkpoint-every", type=int, default=0)
     p.add_argument("--resume", action="store_true")
+    p.add_argument("--sync-dumps", action="store_true",
+                   help="write artifacts synchronously on the training "
+                        "thread (the reference's behavior) instead of the "
+                        "background artifact writer")
     p.add_argument("--max-restarts", type=int, default=0,
                    help="auto-resume from the latest checkpoint on failure, "
                         "up to N times (needs --checkpoint-every)")
@@ -113,6 +118,7 @@ def main(argv=None) -> Dict[str, float]:
         averaging_frequency=args.averaging_frequency,
         checkpoint_every=args.checkpoint_every,
         resume=args.resume,
+        async_dumps=not args.sync_dumps,
     )
     from gan_deeplearning4j_tpu.utils import maybe_trace
 

@@ -1,0 +1,115 @@
+"""Asynchronous artifact writer — overlap readback/CSV IO with training.
+
+The reference writes its periodic artifacts synchronously on the training
+thread, element by element (dl4jGANComputerVision.java:479-522 — the §3.3
+hot-loop inefficiency SURVEY.md flags).  Here the trainer dispatches the
+device computation for an artifact on the main thread (so the values are an
+exact snapshot of the params at that step) and hands the *materialization* —
+device→host readback plus CSV formatting/writing — to a single background
+worker.  On a tunneled PJRT link a readback is a ~70ms round trip; at the
+reference's save cadence (every 100 of 10,000 iterations, two artifacts
+each) that is seconds of wall clock the device spends idle, which this
+thread reclaims.
+
+Snapshot correctness: jax dispatch is async — the arrays enqueued here are
+futures tied to the exact program the main thread dispatched before its
+next training step, so a late readback still yields step-k values.  The
+queue is bounded: each pending job pins its device buffers live, so
+backpressure (a blocking ``submit``) caps HBM retention at
+``max_pending`` artifacts rather than letting a slow disk grow it.
+
+Failure semantics: a worker exception is captured and re-raised on the
+training thread at the next ``submit``/``flush``/``close`` — artifact
+failures are not silent (the recovery wrapper in train.gan_trainer then
+sees them like any other training fault).
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Callable, Optional
+
+
+class AsyncArtifactWriter:
+    """Run zero-arg write jobs on a background thread, in submit order.
+
+    ``synchronous=True`` degrades to running each job inline at ``submit``
+    (the reference's behavior, and the fallback for debugging or
+    single-threaded environments); the API is identical either way.
+    """
+
+    def __init__(self, max_pending: int = 4, synchronous: bool = False):
+        self._synchronous = synchronous
+        self._error: Optional[BaseException] = None
+        if synchronous:
+            return
+        self._closed = False
+        self._q: "queue.Queue[Optional[Callable[[], None]]]" = queue.Queue(
+            maxsize=max_pending)
+        self._thread = threading.Thread(
+            target=self._worker, name="gan4j-artifact-writer", daemon=True)
+        self._thread.start()
+
+    # -- worker --------------------------------------------------------------
+
+    def _worker(self) -> None:
+        while True:
+            job = self._q.get()
+            try:
+                if job is None:
+                    return
+                if self._error is None:  # fail fast: skip jobs after error
+                    job()
+            except BaseException as e:  # noqa: BLE001 — reraised on main thread
+                if self._error is None:
+                    self._error = e
+            finally:
+                self._q.task_done()
+
+    def _reraise(self) -> None:
+        if self._error is not None:
+            err, self._error = self._error, None
+            raise err
+
+    # -- API -----------------------------------------------------------------
+
+    def submit(self, job: Callable[[], None]) -> None:
+        """Enqueue a write job (blocking when ``max_pending`` jobs wait)."""
+        self._reraise()
+        if self._synchronous or self._closed:
+            # after close() the worker is gone — run inline rather than
+            # letting the job vanish into a dead queue
+            job()
+            return
+        self._q.put(job)
+
+    def flush(self) -> None:
+        """Block until every submitted job has run; surface worker errors."""
+        if not self._synchronous:
+            self._q.join()
+        self._reraise()
+
+    def close(self) -> None:
+        """Flush, stop the worker, and surface any pending error."""
+        if self._synchronous:
+            self._reraise()
+            return
+        if not self._closed:
+            self._closed = True
+            self._q.join()
+            self._q.put(None)
+            self._thread.join(timeout=10)
+        self._reraise()
+
+    def __enter__(self) -> "AsyncArtifactWriter":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        # on an exception in the with-body, still drain (artifacts already
+        # snapshotted are valid) but let the body's exception win
+        try:
+            self.close()
+        except BaseException:
+            if exc == (None, None, None):
+                raise

@@ -1,0 +1,80 @@
+"""AsyncArtifactWriter: ordering, flush, error surfacing, sync fallback."""
+
+import threading
+import time
+
+import pytest
+
+from gan_deeplearning4j_tpu.utils.async_dump import AsyncArtifactWriter
+
+
+def test_jobs_run_in_submit_order_and_flush_waits():
+    done = []
+    w = AsyncArtifactWriter(max_pending=2)
+    for i in range(8):
+        w.submit(lambda i=i: (time.sleep(0.01), done.append(i)))
+    w.flush()
+    assert done == list(range(8))
+    w.close()
+
+
+def test_worker_error_surfaces_on_main_thread():
+    w = AsyncArtifactWriter()
+
+    def boom():
+        raise RuntimeError("disk full")
+
+    w.submit(boom)
+    with pytest.raises(RuntimeError, match="disk full"):
+        w.flush()
+    # after surfacing, the writer is usable again
+    ran = []
+    w.submit(lambda: ran.append(1))
+    w.close()
+    assert ran == [1]
+
+
+def test_jobs_after_error_are_skipped_until_reraise():
+    w = AsyncArtifactWriter()
+    ran = []
+    w.submit(lambda: (_ for _ in ()).throw(ValueError("first")))
+    w.submit(lambda: ran.append("skipped"))
+    with pytest.raises(ValueError, match="first"):
+        w.flush()
+    assert ran == []  # the job submitted after the failure did not run
+    w.close()
+
+
+def test_synchronous_mode_runs_inline():
+    w = AsyncArtifactWriter(synchronous=True)
+    tid = []
+    w.submit(lambda: tid.append(threading.get_ident()))
+    assert tid == [threading.get_ident()]
+    w.flush()
+    w.close()
+
+
+def test_backpressure_bounds_pending_jobs():
+    gate = threading.Event()
+    w = AsyncArtifactWriter(max_pending=1)
+    w.submit(gate.wait)          # occupies the worker
+    w.submit(lambda: None)       # fills the queue slot
+    t0 = time.perf_counter()
+    blocked = threading.Thread(target=lambda: w.submit(lambda: None))
+    blocked.start()
+    blocked.join(timeout=0.05)
+    assert blocked.is_alive()    # third submit is blocked on the full queue
+    gate.set()
+    blocked.join(timeout=5)
+    assert not blocked.is_alive()
+    w.close()
+    assert time.perf_counter() - t0 < 5
+
+
+def test_submit_after_close_runs_inline():
+    w = AsyncArtifactWriter()
+    w.close()
+    ran = []
+    w.submit(lambda: ran.append(1))
+    assert ran == [1]
+    w.close()

@@ -202,3 +202,24 @@ def test_train_with_recovery_resumes_after_failure(tmp_path):
     a = read_csv_matrix(os.path.join(ref_dir, "insurance_test_predictions_8.csv"))
     b = read_csv_matrix(os.path.join(flaky_dir, "insurance_test_predictions_8.csv"))
     np.testing.assert_array_equal(a, b)
+
+
+def test_async_dumps_match_sync_dumps(tmp_path):
+    """Artifacts produced by the background artifact writer are bitwise
+    the files the synchronous (reference-style) path writes: device
+    compute is dispatched at the step boundary either way, only the
+    readback/CSV IO moves off the training thread."""
+    from gan_deeplearning4j_tpu.train.insurance_main import main
+
+    d_async = str(tmp_path / "async")
+    d_sync = str(tmp_path / "sync")
+    common = ["--iterations", "4", "--print-every", "2", "--save-every", "4"]
+    main(common + ["--res-path", d_async])
+    main(common + ["--res-path", d_sync, "--sync-dumps"])
+    files = ["insurance_out_2.csv", "insurance_out_4.csv",
+             "insurance_out_pred_2.csv", "insurance_out_pred_4.csv",
+             "insurance_test_predictions_4.csv"]
+    for f in files:
+        a = open(os.path.join(d_async, f), "rb").read()
+        s = open(os.path.join(d_sync, f), "rb").read()
+        assert a == s, f
