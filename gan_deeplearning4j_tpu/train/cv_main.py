@@ -82,6 +82,9 @@ def main(argv=None) -> Dict[str, float]:
     p.add_argument("--averaging-frequency", type=int, default=10)
     p.add_argument("--checkpoint-every", type=int, default=0)
     p.add_argument("--resume", action="store_true")
+    p.add_argument("--steps-per-call", type=int, default=None,
+                   help="cap on lax.scan protocol steps per XLA dispatch "
+                        "on the device-resident path (None = auto)")
     p.add_argument("--sync-dumps", action="store_true",
                    help="write artifacts synchronously on the training "
                         "thread (the reference's behavior) instead of the "
@@ -116,6 +119,7 @@ def main(argv=None) -> Dict[str, float]:
         averaging_frequency=args.averaging_frequency,
         checkpoint_every=args.checkpoint_every,
         resume=args.resume,
+        steps_per_call=args.steps_per_call,
         async_dumps=not args.sync_dumps,
     )
     from gan_deeplearning4j_tpu.utils import maybe_trace

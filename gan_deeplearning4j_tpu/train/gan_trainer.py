@@ -334,6 +334,13 @@ class GANTrainer:
             batches = []
             while iter_test.has_next():
                 batches.append(jnp.asarray(iter_test.next().features))
+            # fuse into ONE resident array when it fits: a single
+            # classifier dispatch per dump instead of one per test batch
+            # (batch_size_pred exists for host memory in the reference's
+            # loop, dl4jGANComputerVision.java:498-522 — inference over
+            # running-stats BN is batch-size independent)
+            if len(batches) > 1 and sum(b.nbytes for b in batches) <= 256 << 20:
+                batches = [jnp.concatenate(batches)]
             self._test_batches = batches
         # dispatch every batch on this thread, then hand the overlapped
         # readback (per-batch round trips would serialize on a tunneled
@@ -605,9 +612,17 @@ class GANTrainer:
         while self.batch_counter < self.c.num_iterations:
             run = self._next_chunk()
             if K > 1 and run == K:
+                # whole-chunk bookkeeping: the (K,) loss arrays stay
+                # stacked on device — per-step slicing would cost 3 tiny
+                # dispatches per step plus 3 scalar readbacks per step at
+                # metrics flush, host-side work that scales with steps and
+                # (on a tunneled link) dominates no matter how large K is
                 fused_state, (d, g, cl) = self._fused_multi(
                     fused_state, features, labels, *self._fused_invariants)
-                per_step = [(d[k], g[k], cl[k]) for k in range(K)]
+                self._final_state = fused_state
+                self._final_losses = (d[-1], g[-1], cl[-1])
+                self._mark_steady(self._final_losses, steps=run)
+                self._chunk_bookkeeping(iter_test, d, g, cl, run, log)
             else:
                 per_step = []
                 for _ in range(run):
@@ -615,29 +630,25 @@ class GANTrainer:
                         fused_state, features, labels,
                         *self._fused_invariants)
                     per_step.append(losses)
-            self._final_state = fused_state
-            if self._steady_t0 is None:
-                # steady clock starts after the FIRST chunk completes (it
-                # pays the compile); the whole chunk is excluded — fencing
-                # mid-chunk would credit already-finished steps to the
-                # steady window and overstate throughput
-                device_fence(per_step[-1])
-                self._steady_t0 = time.perf_counter()
-                self._steady_start_step = self.batch_counter + len(per_step)
-            for d_loss, g_loss, c_loss in per_step:
-                self._final_losses = (d_loss, g_loss, c_loss)
-                self._step_bookkeeping(iter_test, d_loss, g_loss, c_loss,
-                                       log)
+                self._final_state = fused_state
+                self._mark_steady(per_step[-1], steps=len(per_step))
+                for d_loss, g_loss, c_loss in per_step:
+                    self._final_losses = (d_loss, g_loss, c_loss)
+                    self._step_bookkeeping(iter_test, d_loss, g_loss,
+                                           c_loss, log)
 
-    def _mark_steady(self, loss) -> None:
-        """After the FIRST step of a run (the one that pays the XLA
+    def _mark_steady(self, loss, steps: int = 1) -> None:
+        """After the FIRST step/chunk of a run (the one that pays the XLA
         compile), fence once and start the steady-state wall clock —
         per-step host timestamps in an async-dispatch loop measure
-        dispatch, not device time."""
+        dispatch, not device time.  ``steps``: how many protocol steps the
+        fenced dispatch advanced (they are excluded from the steady
+        window — fencing mid-chunk would credit already-finished steps to
+        the window and overstate throughput)."""
         if self._steady_t0 is None:
             device_fence(loss)
             self._steady_t0 = time.perf_counter()
-            self._steady_start_step = self.batch_counter + 1
+            self._steady_start_step = self.batch_counter + steps
 
     def _train_loop(self, prefetch, iter_test, fused_state, ones, y_dis,
                     log) -> None:
@@ -687,6 +698,22 @@ class GANTrainer:
 
             self._step_bookkeeping(iter_test, d_loss, g_loss, c_loss, log)
 
+    def _chunk_bookkeeping(self, iter_test, d, g, cl, n, log) -> None:
+        """Bookkeeping for one multi-step dispatch: ONE chunk metrics
+        record holding the stacked (n,) loss arrays, then cadence
+        triggers — which by construction (_resolve_steps_per_call /
+        _next_chunk) can only fire at the chunk end."""
+        c = self.c
+        start = self.batch_counter
+        self.batch_counter += n
+        self.metrics.log_chunk(
+            start + 1, n, c.batch_size,
+            {"d_loss": d, "g_loss": g, "classifier_loss": cl})
+        for s in range(start - start % 100 + 100, self.batch_counter + 1,
+                       100):
+            log(f"Completed Batch {s}!")
+        self._boundary_bookkeeping(iter_test)
+
     def _step_bookkeeping(self, iter_test, d_loss, g_loss, c_loss, log) -> None:
         c = self.c
         self.batch_counter += 1
@@ -696,7 +723,12 @@ class GANTrainer:
         )
         if self.batch_counter % 100 == 0:
             log(f"Completed Batch {self.batch_counter}!")
+        self._boundary_bookkeeping(iter_test)
 
+    def _boundary_bookkeeping(self, iter_test) -> None:
+        """Artifact/checkpoint cadence triggers at the current counter
+        (shared by the per-step and chunk paths)."""
+        c = self.c
         if self._fused_step is not None and (
             self.batch_counter % c.print_every == 0
             or self.batch_counter % c.save_every == 0

@@ -28,6 +28,7 @@ from __future__ import annotations
 
 import queue
 import threading
+import time
 from typing import Callable, Optional
 
 
@@ -84,20 +85,35 @@ class AsyncArtifactWriter:
             return
         self._q.put(job)
 
-    def flush(self) -> None:
-        """Block until every submitted job has run; surface worker errors."""
+    def _drain(self, timeout: float) -> None:
+        """queue.join with a deadline: a hung write job (stalled disk,
+        wedged readback) surfaces as a RuntimeError on the training
+        thread instead of deadlocking the run."""
+        deadline = time.monotonic() + timeout
+        with self._q.all_tasks_done:
+            while self._q.unfinished_tasks:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    raise RuntimeError(
+                        f"artifact writer stalled: {self._q.unfinished_tasks}"
+                        f" job(s) still pending after {timeout:.0f}s")
+                self._q.all_tasks_done.wait(remaining)
+
+    def flush(self, timeout: float = 600.0) -> None:
+        """Block until every submitted job has run (raising if the worker
+        stalls past ``timeout``); surface worker errors."""
         if not self._synchronous:
-            self._q.join()
+            self._drain(timeout)
         self._reraise()
 
-    def close(self) -> None:
+    def close(self, timeout: float = 600.0) -> None:
         """Flush, stop the worker, and surface any pending error."""
         if self._synchronous:
             self._reraise()
             return
         if not self._closed:
             self._closed = True
-            self._q.join()
+            self._drain(timeout)
             self._q.put(None)
             self._thread.join(timeout=10)
         self._reraise()

@@ -52,6 +52,50 @@ class MetricsLogger:
         if len(self._pending) >= self.flush_every:
             self.flush()
 
+    def log_chunk(self, start_step: int, n: int, examples: int,
+                  metrics: Dict) -> None:
+        """Record ``n`` consecutive steps from one multi-step dispatch.
+
+        ``metrics`` values are length-``n`` jax.Arrays (one stacked array
+        per metric for the WHOLE chunk).  Per-step ``log_step`` would cost
+        3 sliced-scalar device dispatches per step plus 3 scalar readbacks
+        per step at flush — host-side work that scales with steps and, on
+        a tunneled PJRT link, dominates the run no matter how many steps
+        one XLA dispatch advances.  A chunk record keeps ONE device array
+        per metric; flush reads each back in one transfer and expands to
+        per-step records (wall time attributed uniformly across the
+        chunk's steps)."""
+        now = time.perf_counter()
+        self._pending.append({
+            "_chunk": (start_step, n, examples,
+                       self._last_step_t, now),
+            **metrics,
+        })
+        self._last_step_t = now
+        if sum(r["_chunk"][1] if "_chunk" in r else 1
+               for r in self._pending) >= self.flush_every:
+            self.flush()
+
+    def _expand(self, rec: Dict) -> List[Dict]:
+        """Materialized pending record -> per-step host records."""
+        if "_chunk" not in rec:
+            return [{k: (float(v) if hasattr(v, "dtype") else v)
+                     for k, v in rec.items()}]
+        start_step, n, examples, t0, t1 = rec["_chunk"]
+        step_s = (t1 - t0) / n
+        out = []
+        for k in range(n):
+            r = {"step": start_step + k,
+                 "wall_s": (t0 - self._t0) + (k + 1) * step_s,
+                 "step_s": step_s}
+            if examples:
+                r["examples_per_sec"] = examples / max(step_s, 1e-9)
+            for key, v in rec.items():
+                if key != "_chunk":
+                    r[key] = float(v[k]) if hasattr(v, "dtype") else v
+            out.append(r)
+        return out
+
     def flush(self) -> None:
         if not self._pending:
             return
@@ -63,9 +107,7 @@ class MetricsLogger:
         pending = overlap_device_get(self._pending)
         materialized = []
         for rec in pending:
-            materialized.append(
-                {k: (float(v) if hasattr(v, "dtype") else v) for k, v in rec.items()}
-            )
+            materialized.extend(self._expand(rec))
         if self.path:
             with open(self.path, "a") as f:
                 for rec in materialized:

@@ -78,3 +78,13 @@ def test_submit_after_close_runs_inline():
     w.submit(lambda: ran.append(1))
     assert ran == [1]
     w.close()
+
+
+def test_flush_timeout_raises_on_stalled_worker():
+    gate = threading.Event()
+    w = AsyncArtifactWriter()
+    w.submit(gate.wait)  # a hung write job
+    with pytest.raises(RuntimeError, match="stalled"):
+        w.flush(timeout=0.2)
+    gate.set()
+    w.close()

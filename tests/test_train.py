@@ -183,15 +183,24 @@ def test_train_with_recovery_resumes_after_failure(tmp_path):
 
             cfg = dc.replace(cfg, resume=True)
         t = GANTrainer(insurance_main.InsuranceWorkload(), cfg)
-        orig = t._step_bookkeeping
+        orig_step = t._step_bookkeeping
+        orig_chunk = t._chunk_bookkeeping
 
-        def flaky_bookkeeping(*a, **kw):
+        def fail_if_due():
             if t.batch_counter == 4 and state["fails_left"] > 0:
                 state["fails_left"] -= 1
                 raise RuntimeError("injected failure at step 5")
-            return orig(*a, **kw)
 
-        t._step_bookkeeping = flaky_bookkeeping
+        def flaky_step(*a, **kw):
+            fail_if_due()
+            return orig_step(*a, **kw)
+
+        def flaky_chunk(*a, **kw):
+            fail_if_due()  # fires at the start of the steps-5..6 chunk
+            return orig_chunk(*a, **kw)
+
+        t._step_bookkeeping = flaky_step
+        t._chunk_bookkeeping = flaky_chunk
         return t
 
     res = train_with_recovery(make_trainer, max_restarts=1,
@@ -223,3 +232,25 @@ def test_async_dumps_match_sync_dumps(tmp_path):
         a = open(os.path.join(d_async, f), "rb").read()
         s = open(os.path.join(d_sync, f), "rb").read()
         assert a == s, f
+
+
+def test_chunked_metrics_match_per_step(tmp_path):
+    """The multistep path's chunk metrics records (one stacked device
+    array per loss per dispatch, MetricsLogger.log_chunk) expand to the
+    same per-step JSONL the single-step path writes."""
+    import json
+
+    from gan_deeplearning4j_tpu.train.insurance_main import main
+
+    recs = {}
+    for k in ("2", "1"):
+        d = str(tmp_path / f"k{k}")
+        main(["--iterations", "4", "--res-path", d, "--print-every", "2",
+              "--save-every", "4", "--steps-per-call", k])
+        with open(os.path.join(d, "insurance_metrics.jsonl")) as f:
+            recs[k] = [json.loads(line) for line in f]
+    assert [r["step"] for r in recs["2"]] == [1, 2, 3, 4]
+    for a, b in zip(recs["2"], recs["1"]):
+        assert a["step"] == b["step"]
+        for key in ("d_loss", "g_loss", "classifier_loss"):
+            assert a[key] == b[key], (a["step"], key)
