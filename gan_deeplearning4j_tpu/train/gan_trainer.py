@@ -706,8 +706,12 @@ class GANTrainer:
         c = self.c
         start = self.batch_counter
         self.batch_counter += n
+        # examples=0: on the async resident path the host free-runs ahead
+        # of the device, so inter-chunk wall time measures dispatch, not
+        # compute — a per-step examples_per_sec from it would be fiction.
+        # The run-level number comes from the fenced steady window.
         self.metrics.log_chunk(
-            start + 1, n, c.batch_size,
+            start + 1, n, 0,
             {"d_loss": d, "g_loss": g, "classifier_loss": cl})
         for s in range(start - start % 100 + 100, self.batch_counter + 1,
                        100):

@@ -27,6 +27,7 @@ import numpy as np
 from gan_deeplearning4j_tpu.runtime import prng
 from gan_deeplearning4j_tpu.train.gan_pair import GANPair
 from gan_deeplearning4j_tpu.utils import MetricsLogger, device_fence
+from gan_deeplearning4j_tpu.utils.async_dump import AsyncArtifactWriter
 
 FAMILIES = ("cgan-cifar10", "wgan-gp", "celeba")
 
@@ -139,104 +140,114 @@ def train(family: str, iterations: int, batch_size: int, res_path: str,
     real_label = (getattr(cfg, "real_label", 1.0)
                   if pair.mode == "gan" else 1.0)
 
-    def dump_samples(it: int) -> None:
-        from gan_deeplearning4j_tpu.eval.plots import save_rgb_grid_png
+    # the with-block guarantees queued sample PNGs land on disk (or
+    # their error surfaces) even when training raises mid-run
+    with AsyncArtifactWriter() as dumper:
 
-        eval_in = {"z": z_eval}
-        if eval_cond is not None:
-            eval_in["label"] = eval_cond
-        samples = pair.gen.output(
-            *[eval_in[k] for k in pair.gen.input_names])[0]
-        samples = np.asarray(samples).reshape(64, -1)
-        vrange = (0.0, 1.0) if family == "wgan-gp" else (-1.0, 1.0)
-        save_rgb_grid_png(
-            os.path.join(res_path, f"{family}_samples_{it}.png"),
-            samples, sample_shape, value_range=vrange)
+        def dump_samples(it: int) -> None:
+            from gan_deeplearning4j_tpu.eval.plots import save_rgb_grid_png
 
-    steady_t0 = None
-    steady_start = 0
-    d_loss = g_loss = jnp.zeros(())
-    if mesh is None:
-        # fused multi-iteration fast path: ONE dispatch per K iterations
-        # (dispatch latency otherwise bounds the loop — same rationale as
-        # the protocol trainer's steps_per_call)
-        import math
+            eval_in = {"z": z_eval}
+            if eval_cond is not None:
+                eval_in["label"] = eval_cond
+            # dispatch on the training thread (step-it snapshot); readback +
+            # PNG encode run on the artifact-writer thread
+            samples = pair.gen.output(
+                *[eval_in[k] for k in pair.gen.input_names])[0]
+            vrange = (0.0, 1.0) if family == "wgan-gp" else (-1.0, 1.0)
+            path = os.path.join(res_path, f"{family}_samples_{it}.png")
 
-        g = math.gcd(math.gcd(iterations, print_every), 100)
-        K = max(d for d in range(1, min(25, g) + 1) if g % d == 0)
-        step_fn, state = pair.make_multistep(
-            jnp.asarray(x), None if y is None else jnp.asarray(y),
-            batch_size=batch_size, steps_per_call=K, n_critic=n_critic,
-            real_label=real_label, z_size=cfg.z_size,
-            seed_key=z_key)
-        it = 0
-        while it < iterations:
-            state, (dl, gl) = step_fn(state)
-            if steady_t0 is None:
-                device_fence((dl, gl))
-                steady_t0 = time.perf_counter()
-                steady_start = it + K
-            for k in range(K):
+            def write(samples=samples, path=path):
+                save_rgb_grid_png(path, np.asarray(samples).reshape(64, -1),
+                                  sample_shape, value_range=vrange)
+
+            dumper.submit(write)
+
+        steady_t0 = None
+        steady_start = 0
+        d_loss = g_loss = jnp.zeros(())
+        if mesh is None:
+            # fused multi-iteration fast path: ONE dispatch per K iterations
+            # (dispatch latency otherwise bounds the loop — same rationale as
+            # the protocol trainer's steps_per_call)
+            import math
+
+            g = math.gcd(math.gcd(iterations, print_every), 100)
+            K = max(d for d in range(1, min(25, g) + 1) if g % d == 0)
+            step_fn, state = pair.make_multistep(
+                jnp.asarray(x), None if y is None else jnp.asarray(y),
+                batch_size=batch_size, steps_per_call=K, n_critic=n_critic,
+                real_label=real_label, z_size=cfg.z_size,
+                seed_key=z_key)
+            it = 0
+            while it < iterations:
+                state, (dl, gl) = step_fn(state)
+                if steady_t0 is None:
+                    device_fence((dl, gl))
+                    steady_t0 = time.perf_counter()
+                    steady_start = it + K
                 # per-step LOSSES are real; per-step wall-clock is not (K
                 # steps land in one dispatch), so omit examples — the
-                # run-level examples_per_sec in the result is the
-                # throughput record
-                metrics.log_step(it + k + 1, d_loss=dl[k], g_loss=gl[k])
-            it += K
-            d_loss, g_loss = dl[-1], gl[-1]
-            if it % 100 == 0:
-                log(f"[{family}] iteration {it}: d={float(d_loss):.4f} "
-                    f"g={float(g_loss):.4f}")
-            if it % print_every == 0 or it >= iterations:
-                pair.adopt_state(state)
-                dump_samples(it)
-        pair.adopt_state(state)
-        iterations = it
-    else:
-        draw = 0
-        for it in range(1, iterations + 1):
-            for _ in range(n_critic):
-                idx = rng_np.randint(0, n_train, batch_size)
-                real = jnp.asarray(x[idx])
+                # run-level examples_per_sec in the result is the throughput
+                # record.  ONE chunk record keeps the (K,) loss arrays
+                # stacked on device (per-step slicing is host work that
+                # scales with steps — see MetricsLogger.log_chunk).
+                metrics.log_chunk(it + 1, K, 0, {"d_loss": dl, "g_loss": gl})
+                it += K
+                d_loss, g_loss = dl[-1], gl[-1]
+                if it % 100 == 0:
+                    log(f"[{family}] iteration {it}: d={float(d_loss):.4f} "
+                        f"g={float(g_loss):.4f}")
+                if it % print_every == 0 or it >= iterations:
+                    pair.adopt_state(state)
+                    dump_samples(it)
+            pair.adopt_state(state)
+            iterations = it
+        else:
+            draw = 0
+            for it in range(1, iterations + 1):
+                for _ in range(n_critic):
+                    idx = rng_np.randint(0, n_train, batch_size)
+                    real = jnp.asarray(x[idx])
+                    draw += 1
+                    z = jax.random.uniform(
+                        jax.random.fold_in(z_key, draw),
+                        (batch_size, cfg.z_size), minval=-1.0, maxval=1.0)
+                    z_in: Dict = {"z": z}
+                    cond_r = cond_f = None
+                    if y is not None:
+                        lab = jnp.asarray(y[idx])
+                        z_in["label"] = lab
+                        cond_r = cond_f = {"label": lab}
+                    y_real = y_fake = None
+                    if real_label != 1.0:
+                        y_real = jnp.full((batch_size, 1), real_label,
+                                          jnp.float32)
+                        y_fake = jnp.zeros((batch_size, 1), jnp.float32)
+                    d_loss = pair.d_step(real, z_in, cond_r, cond_f, y_real,
+                                         y_fake)
                 draw += 1
                 z = jax.random.uniform(
                     jax.random.fold_in(z_key, draw),
                     (batch_size, cfg.z_size), minval=-1.0, maxval=1.0)
-                z_in: Dict = {"z": z}
-                cond_r = cond_f = None
+                z_in = {"z": z}
+                cond_f = None
                 if y is not None:
-                    lab = jnp.asarray(y[idx])
+                    lab = jnp.asarray(y[rng_np.randint(0, n_train, batch_size)])
                     z_in["label"] = lab
-                    cond_r = cond_f = {"label": lab}
-                y_real = y_fake = None
-                if real_label != 1.0:
-                    y_real = jnp.full((batch_size, 1), real_label,
-                                      jnp.float32)
-                    y_fake = jnp.zeros((batch_size, 1), jnp.float32)
-                d_loss = pair.d_step(real, z_in, cond_r, cond_f, y_real,
-                                     y_fake)
-            draw += 1
-            z = jax.random.uniform(
-                jax.random.fold_in(z_key, draw),
-                (batch_size, cfg.z_size), minval=-1.0, maxval=1.0)
-            z_in = {"z": z}
-            cond_f = None
-            if y is not None:
-                lab = jnp.asarray(y[rng_np.randint(0, n_train, batch_size)])
-                z_in["label"] = lab
-                cond_f = {"label": lab}
-            g_loss = pair.g_step(z_in, cond_f)
-            if steady_t0 is None:
-                device_fence((d_loss, g_loss))
-                steady_t0 = time.perf_counter()
-                steady_start = it
-            metrics.log_step(it, examples=batch_size * (n_critic + 1),
-                             d_loss=d_loss, g_loss=g_loss)
-            if it % 100 == 0:
-                log(f"[{family}] iteration {it}: d={float(d_loss):.4f} "
-                    f"g={float(g_loss):.4f}")
-            if it % print_every == 0 or it == iterations:
-                dump_samples(it)
+                    cond_f = {"label": lab}
+                g_loss = pair.g_step(z_in, cond_f)
+                if steady_t0 is None:
+                    device_fence((d_loss, g_loss))
+                    steady_t0 = time.perf_counter()
+                    steady_start = it
+                metrics.log_step(it, examples=batch_size * (n_critic + 1),
+                                 d_loss=d_loss, g_loss=g_loss)
+                if it % 100 == 0:
+                    log(f"[{family}] iteration {it}: d={float(d_loss):.4f} "
+                        f"g={float(g_loss):.4f}")
+                if it % print_every == 0 or it == iterations:
+                    dump_samples(it)
 
     device_fence((d_loss, g_loss))
     steps_timed = iterations - steady_start if steady_t0 is not None else 0

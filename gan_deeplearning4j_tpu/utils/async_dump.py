@@ -112,8 +112,13 @@ class AsyncArtifactWriter:
             self._reraise()
             return
         if not self._closed:
-            self._closed = True
+            # drain BEFORE marking closed: a drain timeout leaves the
+            # writer open (the worker may still be wedged on a job), so a
+            # retry of close() drains again instead of silently
+            # succeeding while jobs are pending — and submit() keeps
+            # queueing rather than racing the stuck worker inline
             self._drain(timeout)
+            self._closed = True
             self._q.put(None)
             self._thread.join(timeout=10)
         self._reraise()
