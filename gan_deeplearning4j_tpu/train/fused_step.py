@@ -31,8 +31,11 @@ from gan_deeplearning4j_tpu.runtime import prng
 
 # Cap on lax.scan steps per dispatch (trainer auto mode and the
 # benchmark's multistep measurement both use it, so the published number
-# describes the program the trainer actually runs).
-MAX_STEPS_PER_CALL = 25
+# describes the program the trainer actually runs).  100 aligns with the
+# reference's printEvery/saveEvery cadence (dl4jGANComputerVision.java:69)
+# so the auto chunk IS the artifact interval; scan cost is
+# trip-count-independent and the carried state does not grow with K.
+MAX_STEPS_PER_CALL = 100
 
 
 class ProtocolState(NamedTuple):

@@ -48,7 +48,11 @@ from gan_deeplearning4j_tpu.graph import serialization
 from gan_deeplearning4j_tpu.parallel import DataParallelGraph, data_mesh
 from gan_deeplearning4j_tpu.parallel import mesh as mesh_lib
 from gan_deeplearning4j_tpu.runtime import prng
-from gan_deeplearning4j_tpu.utils import MetricsLogger, device_fence
+from gan_deeplearning4j_tpu.utils import (
+    MetricsLogger,
+    device_fence,
+    start_host_copy,
+)
 from gan_deeplearning4j_tpu.utils.async_dump import AsyncArtifactWriter
 
 
@@ -83,7 +87,7 @@ class GANTrainerConfig:
     # Steps per XLA dispatch on the resident path (lax.scan inside the
     # fused program).  Per-step dispatch latency otherwise bounds
     # throughput — on a tunneled PJRT link at ~1/2ms regardless of how
-    # fast the chip is.  None = auto (largest divisor <= 25 of the
+    # fast the chip is.  None = auto (largest divisor <= 100 of the
     # artifact cadences, so chunks never cross a dump/checkpoint
     # boundary); 1 = one dispatch per step.
     steps_per_call: Optional[int] = None
@@ -317,6 +321,7 @@ class GANTrainer:
             self.c.res_path,
             f"{self.c.dataset_name}_out_{self.batch_counter}.csv")
         extras = self.w.grid_extra_arrays(self, out, self.batch_counter)
+        start_host_copy((out, extras))
 
         def write(out=out, path=path, extras=extras):
             write_csv_matrix(path, np.asarray(out))
@@ -351,6 +356,8 @@ class GANTrainer:
         path = os.path.join(
             self.c.res_path,
             f"{self.c.dataset_name}_test_predictions_{self.batch_counter}.csv")
+
+        start_host_copy(outs)
 
         def write(outs=outs, path=path):
             write_csv_matrix(path, np.vstack(overlap_device_get(outs)))

@@ -26,7 +26,11 @@ import numpy as np
 
 from gan_deeplearning4j_tpu.runtime import prng
 from gan_deeplearning4j_tpu.train.gan_pair import GANPair
-from gan_deeplearning4j_tpu.utils import MetricsLogger, device_fence
+from gan_deeplearning4j_tpu.utils import (
+    MetricsLogger,
+    device_fence,
+    start_host_copy,
+)
 from gan_deeplearning4j_tpu.utils.async_dump import AsyncArtifactWriter
 
 FAMILIES = ("cgan-cifar10", "wgan-gp", "celeba")
@@ -156,6 +160,7 @@ def train(family: str, iterations: int, batch_size: int, res_path: str,
                 *[eval_in[k] for k in pair.gen.input_names])[0]
             vrange = (0.0, 1.0) if family == "wgan-gp" else (-1.0, 1.0)
             path = os.path.join(res_path, f"{family}_samples_{it}.png")
+            start_host_copy(samples)
 
             def write(samples=samples, path=path):
                 save_rgb_grid_png(path, np.asarray(samples).reshape(64, -1),
@@ -172,8 +177,13 @@ def train(family: str, iterations: int, batch_size: int, res_path: str,
             # the protocol trainer's steps_per_call)
             import math
 
+            from gan_deeplearning4j_tpu.train.fused_step import (
+                MAX_STEPS_PER_CALL,
+            )
+
             g = math.gcd(math.gcd(iterations, print_every), 100)
-            K = max(d for d in range(1, min(25, g) + 1) if g % d == 0)
+            K = max(d for d in range(1, min(MAX_STEPS_PER_CALL, g) + 1)
+                    if g % d == 0)
             step_fn, state = pair.make_multistep(
                 jnp.asarray(x), None if y is None else jnp.asarray(y),
                 batch_size=batch_size, steps_per_call=K, n_critic=n_critic,

@@ -1,8 +1,12 @@
 """Utilities: structured metrics/observability + tracing (SURVEY.md §5)."""
 
-from gan_deeplearning4j_tpu.utils.device import device_fence, overlap_device_get
+from gan_deeplearning4j_tpu.utils.device import (
+    device_fence,
+    overlap_device_get,
+    start_host_copy,
+)
 from gan_deeplearning4j_tpu.utils.metrics import MetricsLogger
 from gan_deeplearning4j_tpu.utils.profiling import maybe_trace, summarize_trace
 
 __all__ = ["MetricsLogger", "maybe_trace", "summarize_trace",
-           "device_fence", "overlap_device_get"]
+           "device_fence", "overlap_device_get", "start_host_copy"]

@@ -23,15 +23,25 @@ def overlap_device_get(tree: Any) -> Any:
     overlapped transfers: async-start ALL host copies, then read.
     Non-array leaves pass through unchanged."""
     leaves, treedef = jax.tree_util.tree_flatten(tree)
-    for a in leaves:
+    start_host_copy(leaves)
+    return jax.tree_util.tree_unflatten(
+        treedef,
+        [np.asarray(a) if hasattr(a, "dtype") else a for a in leaves])
+
+
+def start_host_copy(tree: Any) -> Any:
+    """Begin the device->host transfer of every array leaf WITHOUT
+    waiting (returns ``tree`` unchanged).  Call right after dispatching
+    an artifact's compute: the copy then overlaps subsequent device work
+    and a later ``np.asarray``/``overlap_device_get`` (e.g. on the async
+    artifact writer's thread) mostly finds the bytes already host-side."""
+    for a in jax.tree_util.tree_leaves(tree):
         if hasattr(a, "copy_to_host_async"):
             try:
                 a.copy_to_host_async()
             except Exception:
-                pass  # fall back to the synchronous read below
-    return jax.tree_util.tree_unflatten(
-        treedef,
-        [np.asarray(a) if hasattr(a, "dtype") else a for a in leaves])
+                pass  # the eventual synchronous read still works
+    return tree
 
 
 def device_fence(tree: Any) -> None:
