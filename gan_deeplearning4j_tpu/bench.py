@@ -345,8 +345,8 @@ def main(argv=None) -> None:
     if baseline:
         out["vs_baseline"] = round(value / baseline, 3)
     if multi_s:
-        # steps_per_call=25 fast path: one dispatch per 25 steps — the
-        # gap vs step_ms is pure dispatch latency (large on a tunnel)
+        # steps_per_call=MAX_STEPS_PER_CALL fast path: one dispatch per
+        # chunk — the gap vs step_ms is pure dispatch latency (large on a tunnel)
         out["multistep_img_per_sec"] = round(BATCH / multi_s, 2)
         out["multistep_step_ms"] = round(multi_s * 1e3, 3)
     peak = _peak_flops(default)

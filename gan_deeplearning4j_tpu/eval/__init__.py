@@ -6,6 +6,7 @@ lines 925-955) and cell 10 computes the insurance weighted AUROC plus the
 latent-grid lattice renderings (raw lines 1483-1516).
 """
 
+from gan_deeplearning4j_tpu.eval.evaluation import Evaluation
 from gan_deeplearning4j_tpu.eval.fid import (
     compute_fid,
     fid_from_features,
@@ -21,6 +22,7 @@ from gan_deeplearning4j_tpu.eval.metrics import (
 )
 
 __all__ = [
+    "Evaluation",
     "accuracy_from_predictions",
     "auroc_from_predictions",
     "compute_fid",

@@ -1,7 +1,9 @@
+from gan_deeplearning4j_tpu.optim.adagrad import AdaGrad  # noqa: F401
 from gan_deeplearning4j_tpu.optim.adam import Adam  # noqa: F401
 from gan_deeplearning4j_tpu.optim.rmsprop import (  # noqa: F401
     RmsProp,
     rmsprop_init,
     rmsprop_update,
 )
+from gan_deeplearning4j_tpu.optim.sgd import Nesterovs, Sgd  # noqa: F401
 from gan_deeplearning4j_tpu.optim.updater import GraphUpdater  # noqa: F401

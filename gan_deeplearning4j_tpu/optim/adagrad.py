@@ -1,0 +1,32 @@
+"""AdaGrad — DL4J's ``org.nd4j.linalg.learning.config.AdaGrad`` equivalent.
+
+DL4J's AdaGradUpdater accumulates the squared-gradient history and scales
+by the root of the (epsilon-shifted) history:
+
+    h' = h + g^2
+    update = lr * g / sqrt(h' + eps)
+
+Defaults are DL4J's (lr 1e-1, eps 1e-6).  Same per-leaf updater protocol
+as RmsProp/Adam/Sgd — see optim/updater.py.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass(frozen=True)
+class AdaGrad:
+    learning_rate: float = 0.1
+    epsilon: float = 1e-6
+
+    def init_leaf(self, p):
+        return jnp.zeros_like(p)
+
+    def update_leaf(self, g, h):
+        h_new = h + g * g
+        update = self.learning_rate * g * jax.lax.rsqrt(h_new + self.epsilon)
+        return update, h_new

@@ -1,0 +1,52 @@
+"""Sgd / Nesterovs — DL4J's plain and momentum updaters.
+
+The reference pins RmsProp on every layer, but the stack it exercises
+ships the full ``org.nd4j.linalg.learning.config`` updater set (pulled in
+via deeplearning4j-nn, Java/pom.xml:100-103) and a DL4J user switching to
+this framework expects the standard members.  Rules match DL4J's
+implementations:
+
+    Sgd:        update = lr * g
+    Nesterovs:  v' = mu * v - lr * g
+                update = mu * v - (1 + mu) * v'      (so that
+                param -= update  ==  the cs231n/DL4J form
+                param += -mu * v + (1 + mu) * v')
+
+Defaults are DL4J's (Sgd lr 1e-1 is DL4J's DEFAULT_SGD_LR; Nesterovs
+lr 0.1, momentum 0.9).  Both implement the per-leaf updater protocol
+(``init_leaf`` / ``update_leaf``) shared with RmsProp/Adam, so kinds can
+mix across the layers of one graph and the whole update stays one fused
+XLA program.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass(frozen=True)
+class Sgd:
+    learning_rate: float = 0.1
+
+    def init_leaf(self, p):
+        # stateless; a zero scalar keeps the state-tree shape uniform
+        return jnp.zeros((), dtype=jnp.float32)
+
+    def update_leaf(self, g, state):
+        return self.learning_rate * g, state
+
+
+@dataclasses.dataclass(frozen=True)
+class Nesterovs:
+    learning_rate: float = 0.1
+    momentum: float = 0.9
+
+    def init_leaf(self, p):
+        return jnp.zeros_like(p)
+
+    def update_leaf(self, g, v):
+        v_new = self.momentum * v - self.learning_rate * g
+        update = self.momentum * v - (1.0 + self.momentum) * v_new
+        return update, v_new

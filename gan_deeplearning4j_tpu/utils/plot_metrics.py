@@ -1,0 +1,96 @@
+"""Loss-curve rendering from metrics JSONL — the training-UI the stack implies.
+
+The DL4J stack ships a training UI and the reference leans on the Spark UI
+(SURVEY.md §5 metrics/observability row); this framework's structured
+per-step JSONL (utils/metrics.py) is the data feed, and this module is the
+viewer: one PNG of the loss curves per run, plus a CLI.
+
+Run: ``python -m gan_deeplearning4j_tpu.utils.plot_metrics
+outputs/computer_vision/mnist_metrics.jsonl``
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+from typing import Dict, List, Optional, Sequence
+
+# fixed categorical assignment (colorblind-validated order; identity never
+# depends on position in the file)
+_SERIES_COLORS = {
+    "d_loss": "#2a78d6",
+    "g_loss": "#eb6834",
+    "classifier_loss": "#1baf7a",
+}
+_FALLBACK_COLORS = ["#eda100", "#e87ba4", "#008300", "#4a3aa7", "#e34948"]
+
+
+def read_metrics(path: str) -> List[Dict]:
+    with open(path) as f:
+        return [json.loads(line) for line in f if line.strip()]
+
+
+def plot_losses(metrics_jsonl: str, out_png: Optional[str] = None,
+                keys: Optional[Sequence[str]] = None,
+                smooth: int = 1) -> str:
+    """Render the loss curves of one run to ``out_png`` (default: next to
+    the JSONL).  ``keys``: which scalar series to draw (default: every
+    ``*_loss`` key present); ``smooth``: centered moving-average window in
+    steps (1 = raw)."""
+    import matplotlib
+
+    matplotlib.use("Agg")
+    import matplotlib.pyplot as plt
+    import numpy as np
+
+    records = read_metrics(metrics_jsonl)
+    if not records:
+        raise ValueError(f"no records in {metrics_jsonl}")
+    if keys is None:
+        keys = [k for k in records[0] if k.endswith("_loss")]
+    steps = np.array([r["step"] for r in records])
+
+    import itertools
+
+    fig, ax = plt.subplots(figsize=(8, 4.5), dpi=120)
+    fallback = itertools.cycle(_FALLBACK_COLORS)
+    for key in keys:
+        vals = np.array([r.get(key, np.nan) for r in records], dtype=float)
+        if smooth > 1:
+            kernel = np.ones(smooth) / smooth
+            vals = np.convolve(vals, kernel, mode="same")
+        color = _SERIES_COLORS.get(key) or next(fallback)
+        ax.plot(steps, vals, color=color, linewidth=1.6, label=key)
+    ax.set_xlabel("step")
+    ax.set_ylabel("loss")
+    ax.set_title(os.path.basename(metrics_jsonl))
+    # recessive grid, no top/right spines; legend identifies the series
+    ax.grid(True, color="#dddddd", linewidth=0.6, alpha=0.6)
+    for side in ("top", "right"):
+        ax.spines[side].set_visible(False)
+    if len(keys) > 1:
+        ax.legend(frameon=False)
+    fig.tight_layout()
+    out_png = out_png or (os.path.splitext(metrics_jsonl)[0] + "_losses.png")
+    fig.savefig(out_png)
+    plt.close(fig)
+    return out_png
+
+
+def main(argv=None) -> str:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("metrics_jsonl")
+    p.add_argument("--out", default=None, help="output PNG path")
+    p.add_argument("--keys", nargs="*", default=None,
+                   help="series to draw (default: every *_loss)")
+    p.add_argument("--smooth", type=int, default=1,
+                   help="moving-average window in steps")
+    args = p.parse_args(argv)
+    out = plot_losses(args.metrics_jsonl, args.out, args.keys, args.smooth)
+    print(out)
+    return out
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,145 @@
+"""Evaluation / updater-set tests: DL4J-equivalent surfaces, numerics
+checked against sklearn (Evaluation) and hand-derived rules (updaters)."""
+
+import numpy as np
+import pytest
+
+from gan_deeplearning4j_tpu.eval import Evaluation
+
+
+def _filled_eval():
+    rng = np.random.RandomState(0)
+    y = rng.randint(0, 4, 500)
+    scores = rng.rand(500, 4)
+    scores[np.arange(500), y] += 0.5  # make it ~70% accurate
+    ev = Evaluation(4)
+    for i in range(0, 500, 64):  # batch accumulation
+        ev.eval(y[i:i + 64], scores[i:i + 64])
+    return ev, y, scores.argmax(axis=1)
+
+
+def test_evaluation_matches_sklearn():
+    pytest.importorskip("sklearn")
+    from sklearn.metrics import (
+        accuracy_score,
+        confusion_matrix,
+        f1_score,
+        precision_score,
+        recall_score,
+    )
+
+    ev, y, pred = _filled_eval()
+    assert ev.num_examples() == 500
+    np.testing.assert_array_equal(ev.confusion_matrix(),
+                                  confusion_matrix(y, pred))
+    assert ev.accuracy() == pytest.approx(accuracy_score(y, pred))
+    assert ev.precision() == pytest.approx(
+        precision_score(y, pred, average="macro"))
+    assert ev.recall() == pytest.approx(recall_score(y, pred, average="macro"))
+    for c in range(4):
+        assert ev.f1(c) == pytest.approx(
+            f1_score(y, pred, average=None)[c])
+
+
+def test_evaluation_onehot_labels_and_stats():
+    ev = Evaluation(3)
+    y = np.eye(3)[[0, 1, 2, 2]]
+    p = np.eye(3)[[0, 1, 2, 1]]
+    ev.eval(y, p)
+    assert ev.accuracy() == pytest.approx(0.75)
+    s = ev.stats()
+    assert "Accuracy:  0.7500" in s and "Confusion matrix" in s
+
+
+def test_evaluation_absent_class_excluded_from_macro():
+    ev = Evaluation(3)  # class 2 never appears
+    ev.eval(np.array([0, 0, 1, 1]), np.array([0, 1, 1, 1]))
+    sklearn = pytest.importorskip("sklearn")  # noqa: F841
+    from sklearn.metrics import precision_score
+
+    want = precision_score(np.array([0, 0, 1, 1]), np.array([0, 1, 1, 1]),
+                           labels=[0, 1], average="macro")
+    assert ev.precision() == pytest.approx(want)
+
+
+def test_sgd_nesterovs_adagrad_rules():
+    import jax.numpy as jnp
+
+    from gan_deeplearning4j_tpu.optim import AdaGrad, Nesterovs, Sgd
+
+    g = jnp.asarray([1.0, -2.0])
+
+    s = Sgd(learning_rate=0.5)
+    upd, _ = s.update_leaf(g, s.init_leaf(g))
+    np.testing.assert_allclose(upd, [0.5, -1.0])
+
+    n = Nesterovs(learning_rate=0.1, momentum=0.9)
+    v0 = n.init_leaf(g)
+    upd1, v1 = n.update_leaf(g, v0)
+    # v1 = -lr*g; param -= update == param += -mu*v0 + (1+mu)*v1
+    np.testing.assert_allclose(v1, -0.1 * np.asarray(g))
+    np.testing.assert_allclose(upd1, 0.9 * np.asarray(v0)
+                               - 1.9 * np.asarray(v1), rtol=1e-6)
+    upd2, v2 = n.update_leaf(g, v1)
+    np.testing.assert_allclose(v2, 0.9 * np.asarray(v1) - 0.1 * np.asarray(g),
+                               rtol=1e-6)
+    np.testing.assert_allclose(upd2, 0.9 * np.asarray(v1)
+                               - 1.9 * np.asarray(v2), rtol=1e-6)
+
+    a = AdaGrad(learning_rate=0.1, epsilon=1e-6)
+    h0 = a.init_leaf(g)
+    upd, h1 = a.update_leaf(g, h0)
+    np.testing.assert_allclose(h1, np.asarray(g) ** 2)
+    np.testing.assert_allclose(
+        upd, 0.1 * np.asarray(g) / np.sqrt(np.asarray(g) ** 2 + 1e-6),
+        rtol=1e-5)
+
+
+def test_new_updaters_in_graph_updater():
+    """The per-leaf protocol slots into GraphUpdater: a 2-layer tree with
+    mixed Sgd/Nesterovs updaters steps without error and moves params."""
+    import jax.numpy as jnp
+
+    from gan_deeplearning4j_tpu.optim import GraphUpdater, Nesterovs, Sgd
+
+    params = {"a": {"W": jnp.ones((3,)), "b": jnp.zeros((3,))},
+              "c": {"W": jnp.full((2,), 2.0)}}
+    gu = GraphUpdater({"a": Sgd(0.1), "c": Nesterovs(0.1, 0.9)}, l2=0.0)
+    cache = gu.init(params)
+    grads = {"a": {"W": jnp.ones((3,)), "b": jnp.ones((3,))},
+             "c": {"W": jnp.ones((2,))}}
+    new, cache = gu.apply(params, grads, cache)
+    np.testing.assert_allclose(new["a"]["W"], 0.9)
+    assert not np.allclose(new["c"]["W"], 2.0)
+    # second step exercises the momentum state round trip
+    new2, cache = gu.apply(new, grads, cache)
+    assert not np.allclose(new2["c"]["W"], new["c"]["W"])
+
+
+def test_plot_metrics_renders_png(tmp_path):
+    pytest.importorskip("matplotlib")
+    import json
+
+    from gan_deeplearning4j_tpu.utils.plot_metrics import main, read_metrics
+
+    path = str(tmp_path / "m.jsonl")
+    with open(path, "w") as f:
+        for s in range(1, 21):
+            f.write(json.dumps({"step": s, "d_loss": 1.0 / s,
+                                "g_loss": 0.5 + 0.01 * s,
+                                "classifier_loss": 2.0 / s}) + "\n")
+    out = main([path, "--smooth", "3"])
+    assert out.endswith("m_losses.png")
+    import os
+
+    assert os.path.getsize(out) > 1000
+    assert len(read_metrics(path)) == 20
+
+
+def test_evaluation_binary_sigmoid_column():
+    ev = Evaluation(2)
+    ev.eval(np.array([[0], [1], [1], [0]]),
+            np.array([[0.2], [0.8], [0.4], [0.1]]))
+    assert ev.accuracy() == pytest.approx(0.75)
+    with pytest.raises(ValueError, match="binary sigmoid"):
+        Evaluation(3).eval(np.array([0, 1]), np.array([[0.2], [0.8]]))
