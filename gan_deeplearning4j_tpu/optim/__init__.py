@@ -5,5 +5,12 @@ from gan_deeplearning4j_tpu.optim.rmsprop import (  # noqa: F401
     rmsprop_init,
     rmsprop_update,
 )
+from gan_deeplearning4j_tpu.optim.schedules import (  # noqa: F401
+    ExponentialSchedule,
+    PolySchedule,
+    Scheduled,
+    SigmoidSchedule,
+    StepSchedule,
+)
 from gan_deeplearning4j_tpu.optim.sgd import Nesterovs, Sgd  # noqa: F401
 from gan_deeplearning4j_tpu.optim.updater import GraphUpdater  # noqa: F401

@@ -99,6 +99,9 @@ def main(argv=None) -> Dict[str, float]:
     p.add_argument("--fid-samples", type=int, default=10000,
                    help="generator samples for the end-of-run FID "
                         "(0 disables)")
+    p.add_argument("--ema-decay", type=float, default=0.0,
+                   help="generator weight EMA decay (e.g. 0.999); adds a "
+                        "fid_ema metric from the averaged weights")
     from gan_deeplearning4j_tpu.runtime import backend
 
     backend.add_bf16_flag(p)
@@ -121,6 +124,7 @@ def main(argv=None) -> Dict[str, float]:
         resume=args.resume,
         steps_per_call=args.steps_per_call,
         async_dumps=not args.sync_dumps,
+        ema_decay=args.ema_decay,
     )
     from gan_deeplearning4j_tpu.utils import maybe_trace
 
@@ -164,6 +168,18 @@ def evaluate(trainer: GANTrainer, fid_samples: int = 10000) -> Dict[str, float]:
             trainer.gen, trainer.classifier,
             real[:fid_samples].astype("float32"), n_samples=fid_samples,
             z_size=c.z_size)
+        ema = getattr(trainer.gen, "ema_params", None)
+        if ema is not None:
+            # score the EMA weights too (trajectory-averaged generator)
+            orig = trainer.gen.params
+            trainer.gen.params = ema
+            try:
+                out["fid_ema"] = fid_lib.generator_fid(
+                    trainer.gen, trainer.classifier,
+                    real[:fid_samples].astype("float32"),
+                    n_samples=fid_samples, z_size=c.z_size)
+            finally:
+                trainer.gen.params = orig
     return out
 
 

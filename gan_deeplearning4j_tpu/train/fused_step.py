@@ -55,6 +55,11 @@ class ProtocolState(NamedTuple):
     clf_opt: Dict
     gen_params: Dict
     it: jax.Array
+    # exponential moving average of gen_params (None when disabled):
+    # sampling/FID from the EMA weights averages over the adversarial
+    # trajectory, damping the equilibrium's rounding sensitivity
+    # (RESULTS.md FID variance note).  A capability over the reference.
+    ema_gen: Optional[Dict] = None
 
 
 def _apply_sync(dst_params: Dict, src_params: Dict, mapping) -> Dict:
@@ -78,6 +83,7 @@ def make_protocol_step(
     donate: bool = True,
     data_on_device: bool = False,
     steps_per_call: int = 1,
+    ema_decay: float = 0.0,
 ):
     """Build the fused step:
     (state, real, labels, z_key, rng_key, y_real, y_fake, ones) ->
@@ -181,9 +187,17 @@ def make_protocol_step(
             {classifier.input_names[0]: real},
             {classifier.output_names[0]: labels},
             reduce, axis_name)
+        if ema_decay:
+            # one elementwise pass over gen params (~3% of the step);
+            # traced out entirely when disabled
+            ema_gen = jax.tree_util.tree_map(
+                lambda e, p: ema_decay * e + (1.0 - ema_decay) * p,
+                state.ema_gen, gen_params)
+        else:
+            ema_gen = state.ema_gen
         new_state = ProtocolState(
             dis_params, dis_opt, gan_params, gan_opt,
-            clf_params, clf_opt, gen_params, step_idx + 1)
+            clf_params, clf_opt, gen_params, step_idx + 1, ema_gen)
         return new_state, (d_loss, g_loss, c_loss)
 
     if steps_per_call > 1:
@@ -225,12 +239,17 @@ def make_protocol_step(
     return jax.jit(sharded, donate_argnums=(0,) if donate else ())
 
 
-def state_from_graphs(dis, gen, gan, classifier,
-                      start_step: int = 0) -> ProtocolState:
+def state_from_graphs(dis, gen, gan, classifier, start_step: int = 0,
+                      ema: bool = False) -> ProtocolState:
+    """``ema``: seed the generator's EMA slot from its current params
+    (restores from ``gen.ema_params`` when a resumed graph carries one)."""
+    ema_gen = None
+    if ema:
+        ema_gen = getattr(gen, "ema_params", None) or gen.params
     return ProtocolState(
         dis.params, dis.opt_state, gan.params, gan.opt_state,
         classifier.params, classifier.opt_state, gen.params,
-        jnp.asarray(start_step, jnp.int32))
+        jnp.asarray(start_step, jnp.int32), ema_gen)
 
 
 def state_to_graphs(state: ProtocolState, dis, gen, gan, classifier) -> None:
@@ -238,3 +257,4 @@ def state_to_graphs(state: ProtocolState, dis, gen, gan, classifier) -> None:
     gan.params, gan.opt_state = state.gan_params, state.gan_opt
     classifier.params, classifier.opt_state = state.clf_params, state.clf_opt
     gen.params = state.gen_params
+    gen.ema_params = state.ema_gen  # None unless the step maintains an EMA

@@ -96,6 +96,11 @@ class GANTrainerConfig:
     checkpoint_keep: int = 3
     resume: bool = False
     metrics: bool = True
+    # Generator EMA decay (0 = off).  >0 maintains an exponential moving
+    # average of the generator weights inside the fused step; sampling/FID
+    # from it damps the adversarial equilibrium's rounding sensitivity
+    # (RESULTS.md FID variance note).  Fused path only.
+    ema_decay: float = 0.0
     # Artifact dumps: device compute is dispatched on the training thread
     # (exact step-k snapshot), readback + CSV write run on a background
     # worker so the device never idles on the ~70ms tunnel round trip.
@@ -303,6 +308,10 @@ class GANTrainer:
             ).reshape(-1, config.z_size)
         )
 
+        if not 0.0 <= config.ema_decay < 1.0:
+            raise ValueError(
+                f"ema_decay must be in [0, 1), got {config.ema_decay} "
+                "(1.0 would pin the EMA at initialization forever)")
         self.batch_counter = 0
         self._test_batches = None
         self._steps_per_call = 1
@@ -378,11 +387,18 @@ class GANTrainer:
             self._dumper.flush()
             # no RNG state needed: the z-stream is counter-based, derived
             # from batch_counter (the checkpoint step) alone
+            extra = {"soften_real": self.soften_real,
+                     "soften_fake": self.soften_fake}
+            # the generator EMA is state the graphs' params don't carry;
+            # without it a crash-resume would silently restart the
+            # trajectory average from the current weights
+            ema = getattr(self.gen, "ema_params", None)
+            if ema is not None:
+                for layer, lp in ema.items():
+                    for n, v in lp.items():
+                        extra[f"ema:{layer}:{n}"] = v
             self.checkpointer.save(
-                self.batch_counter, self._graphs(),
-                extra={"soften_real": self.soften_real,
-                       "soften_fake": self.soften_fake},
-            )
+                self.batch_counter, self._graphs(), extra=extra)
 
     def _maybe_resume(self, iter_train: RecordReaderDataSetIterator) -> None:
         if not (self.c.resume and self.checkpointer
@@ -392,6 +408,16 @@ class GANTrainer:
         self.batch_counter = step
         self.soften_real = jnp.asarray(extra["soften_real"])
         self.soften_fake = jnp.asarray(extra["soften_fake"])
+        ema = {}
+        for k, v in extra.items():
+            if k.startswith("ema:"):
+                _, layer, name = k.split(":", 2)
+                ema.setdefault(layer, {})[name] = jnp.asarray(v)
+        if ema:
+            # mirror gen.params' full layer structure: stateless layers
+            # (e.g. upsample) carry empty dicts the flat keys can't encode
+            self.gen.ema_params = {
+                layer: ema.get(layer, {}) for layer in self.gen.params}
         # (older checkpoints carried a "z_key" entry; the z-stream is now
         # counter-based and needs no restored state)
         # Fast-forward the data iterator (views, cheap), replaying the
@@ -434,6 +460,7 @@ class GANTrainer:
                 kw = dict(
                     z_size=c.z_size, num_features=c.num_features,
                     mesh=self._mesh, data_on_device=resident,
+                    ema_decay=c.ema_decay,
                 )
                 graphs = (self.dis, self.gen, self.gan, self.classifier)
                 maps = (self.w.dis_to_gan, self.w.gan_to_gen,
@@ -452,7 +479,7 @@ class GANTrainer:
                 ones + self.soften_real, self.soften_fake, ones)
             fused_state = self._fused_lib.state_from_graphs(
                 self.dis, self.gen, self.gan, self.classifier,
-                start_step=self.batch_counter)
+                start_step=self.batch_counter, ema=c.ema_decay > 0)
 
         # artifact materialization runs on a background worker for the
         # whole loop; the with-block guarantees every dump is on disk (or

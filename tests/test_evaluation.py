@@ -143,3 +143,61 @@ def test_evaluation_binary_sigmoid_column():
     assert ev.accuracy() == pytest.approx(0.75)
     with pytest.raises(ValueError, match="binary sigmoid"):
         Evaluation(3).eval(np.array([0, 1]), np.array([[0.2], [0.8]]))
+
+
+def test_schedules_match_dl4j_formulas():
+    import jax.numpy as jnp
+
+    from gan_deeplearning4j_tpu.optim import (
+        ExponentialSchedule,
+        PolySchedule,
+        SigmoidSchedule,
+        StepSchedule,
+    )
+
+    t = jnp.asarray(10.0)
+    assert float(StepSchedule(0.1, 0.5, 4)(t)) == pytest.approx(0.1 * 0.5 ** 2)
+    assert float(ExponentialSchedule(0.1, 0.9)(t)) == pytest.approx(
+        0.1 * 0.9 ** 10)
+    assert float(PolySchedule(0.1, 2.0, 100)(t)) == pytest.approx(
+        0.1 * 0.9 ** 2)
+    assert float(SigmoidSchedule(0.1, 0.5, 10)(t)) == pytest.approx(0.05)
+    # DL4J/Caffe sign: positive gamma RAMPS toward initial_lr past step
+    assert float(SigmoidSchedule(0.1, 0.5, 10)(jnp.asarray(20.0))
+                 ) == pytest.approx(0.1 / (1 + np.exp(-5.0)))
+    # past max_iter the poly schedule clamps at 0, not a negative power
+    assert float(PolySchedule(0.1, 2.0, 100)(jnp.asarray(200.0))) == 0.0
+
+
+def test_scheduled_wrapper_threads_rate_through_recurrence():
+    """Scheduled(Sgd, Exponential) at step t uses lr*gamma^t exactly; with
+    Nesterovs the scheduled rate enters the velocity recurrence (not a
+    post-hoc scale), matching DL4J's updater+ISchedule composition."""
+    import jax.numpy as jnp
+
+    from gan_deeplearning4j_tpu.optim import (
+        ExponentialSchedule,
+        Nesterovs,
+        Scheduled,
+        Sgd,
+    )
+
+    g = jnp.asarray([2.0])
+    sch = Scheduled(Sgd(), ExponentialSchedule(0.1, 0.5))
+    st = sch.init_leaf(g)
+    u0, st = sch.update_leaf(g, st)
+    u1, st = sch.update_leaf(g, st)
+    np.testing.assert_allclose(u0, 0.1 * 2.0)
+    np.testing.assert_allclose(u1, 0.05 * 2.0)
+
+    mu = 0.9
+    sch = Scheduled(Nesterovs(momentum=mu), ExponentialSchedule(0.1, 0.5))
+    st = sch.init_leaf(g)
+    v = np.zeros(1)
+    for t in range(3):
+        upd, st = sch.update_leaf(g, st)
+        lr = 0.1 * 0.5 ** t
+        v_new = mu * v - lr * np.asarray(g)
+        np.testing.assert_allclose(upd, mu * v - (1 + mu) * v_new, rtol=1e-6)
+        v = v_new
+    assert st["t"] == 3.0
