@@ -157,6 +157,10 @@ def evaluate(trainer: GANTrainer, fid_samples: int = 10000) -> Dict[str, float]:
     test_csv = os.path.join(c.res_path, "mnist_test.csv")
     if os.path.exists(pred_csv) and os.path.exists(test_csv):
         out["test_accuracy"] = metrics_lib.mnist_accuracy(pred_csv, test_csv)
+        out.update(metrics_lib.write_evaluation_report(
+            c.res_path, pred_csv, test_csv, c.label_index, c.num_classes,
+            metrics_jsonl=os.path.join(
+                c.res_path, f"{c.dataset_name}_metrics.jsonl")))
     grid_csv = os.path.join(c.res_path, f"{c.dataset_name}_out_{step}.csv")
     if os.path.exists(grid_csv):
         save_grid_png(

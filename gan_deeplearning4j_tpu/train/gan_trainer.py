@@ -260,6 +260,11 @@ class GANTrainer:
         self._fused_step = None
         self._fused_enabled = (
             config.fused and config.dp_mode == "gradient_sync")
+        if config.ema_decay > 0 and not self._fused_enabled:
+            raise ValueError(
+                "ema_decay > 0 requires the fused step (fused=True, "
+                "dp_mode='gradient_sync') — only it maintains the EMA; "
+                "silently training without one would misreport fid_ema")
         mesh = data_mesh(config.n_devices) if config.n_devices > 1 else None
         self._mesh = mesh
         if self._fused_enabled:
@@ -454,6 +459,7 @@ class GANTrainer:
         start_counter = self.batch_counter
         self._steady_t0 = None
         self._steady_start_step = start_counter
+        run_t0 = time.perf_counter()
         resident = self._fused_enabled and self._resident_data_ok(iter_train)
         if self._fused_enabled:
             if self._fused_step is None:
@@ -550,6 +556,11 @@ class GANTrainer:
         if self._steady_t0 is not None and steps_timed > 0:
             steady = steps_timed * c.batch_size / (
                 time.perf_counter() - self._steady_t0)
+        elif self.batch_counter > start_counter:
+            # the whole run fit in the first (compile-paying) chunk: the
+            # only honest rate is whole-run wall including the compile
+            steady = ((self.batch_counter - start_counter) * c.batch_size
+                      / (time.perf_counter() - run_t0))
 
         # end-of-run model zips, exactly the reference's four files (:529-533)
         name = c.dataset_name
@@ -568,6 +579,8 @@ class GANTrainer:
             "steps": self.batch_counter,
             "examples_per_sec": (
                 steady if steady is not None else self.metrics.throughput()),
+            "examples_per_sec_includes_compile": (
+                self._steady_t0 is None or steps_timed <= 0),
             "d_loss": float(self.dis.score),
             "g_loss": float(self.gan.score),
         }

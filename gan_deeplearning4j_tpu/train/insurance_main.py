@@ -149,6 +149,11 @@ def evaluate(trainer: GANTrainer) -> Dict[str, float]:
     test_csv = os.path.join(c.res_path, "insurance_test.csv")
     if os.path.exists(pred_csv) and os.path.exists(test_csv):
         out["test_auroc"] = metrics_lib.insurance_auroc(pred_csv, test_csv)
+        out.update(metrics_lib.write_evaluation_report(
+            c.res_path, pred_csv, test_csv, c.label_index, num_classes=2,
+            f1_cls=1,
+            metrics_jsonl=os.path.join(c.res_path,
+                                       "insurance_metrics.jsonl")))
     grid_csv = os.path.join(c.res_path, f"insurance_out_{step}.csv")
     if os.path.exists(grid_csv):
         save_grid_png(

@@ -57,9 +57,13 @@ def plot_losses(metrics_jsonl: str, out_png: Optional[str] = None,
     fallback = itertools.cycle(_FALLBACK_COLORS)
     for key in keys:
         vals = np.array([r.get(key, np.nan) for r in records], dtype=float)
-        if smooth > 1:
-            kernel = np.ones(smooth) / smooth
-            vals = np.convolve(vals, kernel, mode="same")
+        w = max(1, min(smooth, len(vals)))
+        if w > 1:
+            # normalized windowed mean: edges average over the window
+            # actually present instead of drooping toward zero padding
+            kernel = np.ones(w)
+            vals = (np.convolve(vals, kernel, mode="same")
+                    / np.convolve(np.ones_like(vals), kernel, mode="same"))
         color = _SERIES_COLORS.get(key) or next(fallback)
         ax.plot(steps, vals, color=color, linewidth=1.6, label=key)
     ax.set_xlabel("step")
