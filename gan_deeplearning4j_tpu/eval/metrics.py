@@ -55,13 +55,12 @@ def grid_to_lattices(grid_csv_or_array, rows: int, cols: int) -> np.ndarray:
     return arr.reshape(arr.shape[0], rows, cols)
 
 
-def write_evaluation_report(res_path: str, pred_csv: str, test_csv: str,
-                            label_index: int, num_classes: int,
-                            f1_cls=None, metrics_jsonl=None,
-                            smooth: int = 25) -> dict:
+def write_evaluation_report(res_path: str, predictions, labels,
+                            num_classes: int, f1_cls=None,
+                            metrics_jsonl=None, smooth: int = 25) -> dict:
     """Shared end-of-run report for the mains: DL4J-style Evaluation over
-    the final prediction dump (stats block written to
-    ``evaluation_stats.txt``) plus, when a metrics JSONL exists, the
+    the (already loaded) final prediction dump — stats block written to
+    ``evaluation_stats.txt`` — plus, when a metrics JSONL has records, the
     loss-curve PNG.  Returns {"test_f1": ...} (class ``f1_cls`` if given,
     else macro)."""
     import os
@@ -69,8 +68,7 @@ def write_evaluation_report(res_path: str, pred_csv: str, test_csv: str,
     from gan_deeplearning4j_tpu.eval.evaluation import Evaluation
 
     ev = Evaluation(num_classes)
-    ev.eval(read_csv_matrix(test_csv)[:, label_index],
-            read_csv_matrix(pred_csv))
+    ev.eval(labels, predictions)
     with open(os.path.join(res_path, "evaluation_stats.txt"), "w") as f:
         f.write(ev.stats() + "\n")
     if metrics_jsonl and os.path.exists(metrics_jsonl):
@@ -80,4 +78,6 @@ def write_evaluation_report(res_path: str, pred_csv: str, test_csv: str,
             plot_losses(metrics_jsonl, smooth=smooth)
         except ImportError:
             pass  # matplotlib is an optional extra
+        except ValueError:
+            pass  # e.g. a resumed-to-completion run truncates the jsonl
     return {"test_f1": ev.f1(f1_cls) if f1_cls is not None else ev.f1()}

@@ -236,6 +236,7 @@ class ComputationGraph:
         self._step_count = 0
         self._jit_infer = jax.jit(functools.partial(self._forward_outputs, train=False))
         self._jit_fit = jax.jit(self._train_step)
+        self._jit_score = jax.jit(self._score)
 
     # -- init ---------------------------------------------------------------
 
@@ -336,6 +337,23 @@ class ComputationGraph:
             merged.update(upd)
             new_params[lname] = merged
         return new_params, new_opt_state, loss
+
+    def _score(self, params, inputs, labels):
+        values, _ = self._forward(params, inputs, False, None)
+        return self._loss({n: values[n] for n in self.output_names}, labels)
+
+    def score_on(self, features, labels) -> float:
+        """Inference-mode loss on a batch (no update, running BN stats, no
+        dropout) — DL4J ``ComputationGraph.score(DataSet)``."""
+        inputs = (
+            features if isinstance(features, dict)
+            else dict(zip(self.input_names, [features]))
+        )
+        label_map = (
+            labels if isinstance(labels, dict)
+            else dict(zip(self.output_names, [labels]))
+        )
+        return float(self._jit_score(self.params, inputs, label_map))
 
     def fit(self, features, labels) -> float:
         """One optimization step on a batch — the unit the reference's

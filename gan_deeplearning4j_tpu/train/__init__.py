@@ -2,10 +2,17 @@
 re-built on the framework: the three-graph GAN protocol engine plus the
 CV DCGAN and insurance MLP-GAN entry points."""
 
+from gan_deeplearning4j_tpu.train.early_stopping import (
+    EarlyStoppingConfig,
+    EarlyStoppingGraphTrainer,
+    EarlyStoppingResult,
+)
 from gan_deeplearning4j_tpu.train.gan_trainer import (
     GANTrainer,
     GANTrainerConfig,
     Workload,
 )
 
-__all__ = ["GANTrainer", "GANTrainerConfig", "Workload"]
+__all__ = ["EarlyStoppingConfig", "EarlyStoppingGraphTrainer",
+           "EarlyStoppingResult", "GANTrainer", "GANTrainerConfig",
+           "Workload"]

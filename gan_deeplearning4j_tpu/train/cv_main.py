@@ -156,9 +156,14 @@ def evaluate(trainer: GANTrainer, fid_samples: int = 10000) -> Dict[str, float]:
         c.res_path, f"{c.dataset_name}_test_predictions_{step}.csv")
     test_csv = os.path.join(c.res_path, "mnist_test.csv")
     if os.path.exists(pred_csv) and os.path.exists(test_csv):
-        out["test_accuracy"] = metrics_lib.mnist_accuracy(pred_csv, test_csv)
+        from gan_deeplearning4j_tpu.data import read_csv_matrix
+
+        preds = read_csv_matrix(pred_csv)
+        labels = read_csv_matrix(test_csv)[:, c.label_index]
+        out["test_accuracy"] = metrics_lib.accuracy_from_predictions(
+            preds, labels)
         out.update(metrics_lib.write_evaluation_report(
-            c.res_path, pred_csv, test_csv, c.label_index, c.num_classes,
+            c.res_path, preds, labels, c.num_classes,
             metrics_jsonl=os.path.join(
                 c.res_path, f"{c.dataset_name}_metrics.jsonl")))
     grid_csv = os.path.join(c.res_path, f"{c.dataset_name}_out_{step}.csv")

@@ -148,10 +148,13 @@ def evaluate(trainer: GANTrainer) -> Dict[str, float]:
         c.res_path, f"insurance_test_predictions_{step}.csv")
     test_csv = os.path.join(c.res_path, "insurance_test.csv")
     if os.path.exists(pred_csv) and os.path.exists(test_csv):
-        out["test_auroc"] = metrics_lib.insurance_auroc(pred_csv, test_csv)
+        from gan_deeplearning4j_tpu.data import read_csv_matrix
+
+        preds = read_csv_matrix(pred_csv)
+        labels = read_csv_matrix(test_csv)[:, c.label_index]
+        out["test_auroc"] = metrics_lib.auroc_from_predictions(preds, labels)
         out.update(metrics_lib.write_evaluation_report(
-            c.res_path, pred_csv, test_csv, c.label_index, num_classes=2,
-            f1_cls=1,
+            c.res_path, preds, labels, num_classes=2, f1_cls=1,
             metrics_jsonl=os.path.join(c.res_path,
                                        "insurance_metrics.jsonl")))
     grid_csv = os.path.join(c.res_path, f"insurance_out_{step}.csv")

@@ -1,0 +1,121 @@
+"""EarlyStoppingGraphTrainer: termination conditions, best-model restore,
+score_on (inference-mode loss) semantics."""
+
+import numpy as np
+import pytest
+
+from gan_deeplearning4j_tpu.data.csv import DataSet
+from gan_deeplearning4j_tpu.train.early_stopping import (
+    EarlyStoppingConfig,
+    EarlyStoppingGraphTrainer,
+)
+
+
+class ListIterator:
+    """Minimal DataSetIterator over in-memory batches."""
+
+    def __init__(self, batches):
+        self.batches = batches
+        self.i = 0
+
+    def has_next(self):
+        return self.i < len(self.batches)
+
+    def next(self):
+        ds = self.batches[self.i]
+        self.i += 1
+        return ds
+
+    def reset(self):
+        self.i = 0
+
+
+def _toy_graph(lr=0.05):
+    from gan_deeplearning4j_tpu.graph.graph import GraphBuilder, InputSpec
+    from gan_deeplearning4j_tpu.graph.layers import Dense, Output
+    from gan_deeplearning4j_tpu.optim import Sgd
+
+    g = (GraphBuilder(seed=666)
+         .add_inputs("in")
+         .set_input_types(InputSpec.feed_forward(4))
+         .add_layer("h", Dense(n_out=16, activation="tanh",
+                               updater=Sgd(lr)), "in")
+         .add_layer("out", Output(n_out=1, activation="sigmoid",
+                                  loss="xent", updater=Sgd(lr)), "h")
+         .set_outputs("out")
+         .build())
+    g.init()
+    return g
+
+
+def _toy_data(n=128, seed=0):
+    rng = np.random.RandomState(seed)
+    x = rng.rand(n, 4).astype(np.float32)
+    y = (x.sum(axis=1, keepdims=True) > 2.0).astype(np.float32)
+    return x, y
+
+
+def test_early_stopping_trains_and_restores_best(tmp_path):
+    x, y = _toy_data(128)
+    xv, yv = _toy_data(64, seed=1)
+    g = _toy_graph()
+    save = str(tmp_path / "best.zip")
+    trainer = EarlyStoppingGraphTrainer(
+        g, ListIterator([DataSet(x[i:i + 32], y[i:i + 32])
+                         for i in range(0, 128, 32)]),
+        ListIterator([DataSet(xv, yv)]),
+        EarlyStoppingConfig(max_epochs=20, patience=5, save_path=save))
+    before = g.score_on(xv, yv)
+    res = trainer.fit()
+    assert res.best_score < before          # it learned
+    assert res.best_epoch >= 1
+    assert res.reason in ("max_epochs", "patience")
+    # restored params actually score best_score
+    assert g.score_on(xv, yv) == pytest.approx(res.best_score, rel=1e-5)
+    import os
+
+    assert os.path.exists(save)
+
+
+def test_early_stopping_patience_stops_before_max():
+    x, y = _toy_data(64)
+    g = _toy_graph(lr=0.0)  # frozen: no improvement is possible
+    trainer = EarlyStoppingGraphTrainer(
+        g, ListIterator([DataSet(x, y)]), ListIterator([DataSet(x, y)]),
+        EarlyStoppingConfig(max_epochs=50, patience=2))
+    res = trainer.fit()
+    assert res.reason == "patience"
+    assert res.total_epochs <= 5            # 1 best + patience+1 stale
+
+
+def test_early_stopping_max_score_aborts():
+    x, y = _toy_data(64)
+    g = _toy_graph()
+    trainer = EarlyStoppingGraphTrainer(
+        g, ListIterator([DataSet(x, y)]), ListIterator([DataSet(x, y)]),
+        EarlyStoppingConfig(max_epochs=10, max_score=1e-12))
+    res = trainer.fit()
+    assert res.reason == "max_score"
+    assert res.total_epochs == 1
+
+
+def test_score_on_is_inference_mode_and_pure():
+    x, y = _toy_data(64)
+    g = _toy_graph()
+    s1 = g.score_on(x, y)
+    s2 = g.score_on(x, y)
+    assert s1 == s2                          # no state mutation, no dropout
+    g.fit(x, y)
+    assert g.score_on(x, y) != s1            # params moved after a fit
+
+
+def test_nan_score_aborts():
+    x, y = _toy_data(64)
+    g = _toy_graph()
+    trainer = EarlyStoppingGraphTrainer(
+        g, ListIterator([DataSet(x, y)]), None,
+        EarlyStoppingConfig(max_epochs=10, max_score=100.0),
+        score_fn=lambda graph: float("nan"))
+    res = trainer.fit()
+    assert res.reason == "nan_score"
+    assert res.total_epochs == 1
