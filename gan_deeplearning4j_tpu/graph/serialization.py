@@ -24,22 +24,59 @@ import numpy as np
 from gan_deeplearning4j_tpu.graph.graph import ComputationGraph, GraphBuilder, InputSpec
 from gan_deeplearning4j_tpu.graph.layers import LAYER_TYPES
 from gan_deeplearning4j_tpu.graph.preprocessors import PREPROCESSOR_TYPES
+from gan_deeplearning4j_tpu.optim.adagrad import AdaGrad
 from gan_deeplearning4j_tpu.optim.adam import Adam
 from gan_deeplearning4j_tpu.optim.rmsprop import RmsProp
+from gan_deeplearning4j_tpu.optim.schedules import (
+    ExponentialSchedule,
+    PolySchedule,
+    Scheduled,
+    SigmoidSchedule,
+    StepSchedule,
+)
+from gan_deeplearning4j_tpu.optim.sgd import Nesterovs, Sgd
 
 FORMAT_VERSION = 1
 
-# updater kinds by type-tag; legacy configs without a tag are RmsProp
-_UPDATER_TYPES = {"RmsProp": RmsProp, "Adam": Adam}
+# updater/schedule kinds by type-tag; legacy configs without a tag are
+# RmsProp.  Scheduled nests a base updater and a schedule, so encoding
+# recurses over dataclass-valued fields.
+_UPDATER_TYPES = {
+    "RmsProp": RmsProp, "Adam": Adam, "Sgd": Sgd, "Nesterovs": Nesterovs,
+    "AdaGrad": AdaGrad, "Scheduled": Scheduled,
+    "StepSchedule": StepSchedule, "ExponentialSchedule": ExponentialSchedule,
+    "PolySchedule": PolySchedule, "SigmoidSchedule": SigmoidSchedule,
+}
+
+
+def _updater_to_dict(u) -> dict:
+    name = type(u).__name__
+    if _UPDATER_TYPES.get(name) is not type(u):
+        raise TypeError(
+            f"cannot serialize updater/schedule {type(u)!r}: register it in "
+            "serialization._UPDATER_TYPES (plain-callable schedules are "
+            "trainable but not serializable — use a schedule dataclass)")
+    d = {"__type__": name}
+    for f in dataclasses.fields(u):
+        v = getattr(u, f.name)
+        d[f.name] = _updater_to_dict(v) if dataclasses.is_dataclass(v) else v
+    return d
+
+
+def _updater_from_dict(d: dict):
+    d = dict(d)
+    cls = _UPDATER_TYPES[d.pop("__type__", "RmsProp")]
+    return cls(**{
+        k: (_updater_from_dict(v)
+            if isinstance(v, dict) and "__type__" in v else v)
+        for k, v in d.items()
+    })
 
 
 def _layer_to_dict(layer) -> dict:
     d = dataclasses.asdict(layer)
     if d.get("updater") is not None:
-        d["updater"] = {
-            **dataclasses.asdict(layer.updater),
-            "__type__": type(layer.updater).__name__,
-        }
+        d["updater"] = _updater_to_dict(layer.updater)
     d["__type__"] = type(layer).__name__
     return d
 
@@ -48,9 +85,7 @@ def _layer_from_dict(d: dict):
     d = dict(d)
     cls = LAYER_TYPES[d.pop("__type__")]
     if d.get("updater") is not None:
-        up = dict(d["updater"])
-        up_cls = _UPDATER_TYPES[up.pop("__type__", "RmsProp")]
-        d["updater"] = up_cls(**up)
+        d["updater"] = _updater_from_dict(d["updater"])
     return cls(**d)
 
 

@@ -119,3 +119,4 @@ def test_nan_score_aborts():
     res = trainer.fit()
     assert res.reason == "nan_score"
     assert res.total_epochs == 1
+

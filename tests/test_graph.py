@@ -212,3 +212,51 @@ class TestSerialization:
         g = small_mlp()
         s = g.summary()
         assert "bn" in s and "Total params" in s
+
+
+def test_new_updaters_and_schedules_roundtrip_model_zip(tmp_path):
+    """write_model/load_model preserves Sgd/Nesterovs/AdaGrad and nested
+    Scheduled(updater, schedule) configs plus their updater state."""
+    from gan_deeplearning4j_tpu.graph import serialization
+    from gan_deeplearning4j_tpu.graph.graph import GraphBuilder, InputSpec
+    from gan_deeplearning4j_tpu.graph.layers import Dense, Output
+    from gan_deeplearning4j_tpu.optim import (
+        AdaGrad,
+        Nesterovs,
+        Scheduled,
+        StepSchedule,
+    )
+
+    g = (GraphBuilder(seed=666)
+         .add_inputs("in")
+         .set_input_types(InputSpec.feed_forward(4))
+         .add_layer("h", Dense(n_out=8, activation="tanh",
+                               updater=Scheduled(Nesterovs(0.1, 0.9),
+                                                 StepSchedule(0.1, 0.5, 3))),
+                    "in")
+         .add_layer("out", Output(n_out=1, activation="sigmoid", loss="xent",
+                                  updater=AdaGrad(0.05)), "h")
+         .set_outputs("out")
+         .build())
+    g.init()
+    rng = np.random.RandomState(0)
+    x = rng.rand(32, 4).astype(np.float32)
+    y = (x.sum(axis=1, keepdims=True) > 2.0).astype(np.float32)
+    for _ in range(4):
+        g.fit(x, y)  # populate momentum/history/t state
+    path = str(tmp_path / "m.zip")
+    serialization.write_model(g, path)
+    g2 = serialization.read_model(path)
+    assert isinstance(g2.nodes["h"].layer.updater, Scheduled)
+    assert isinstance(g2.nodes["h"].layer.updater.base, Nesterovs)
+    assert isinstance(g2.nodes["h"].layer.updater.schedule, StepSchedule)
+    assert g2.nodes["h"].layer.updater.schedule.step == 3
+    assert isinstance(g2.nodes["out"].layer.updater, AdaGrad)
+    # updater STATE round-trips too: another fit step matches exactly
+    g.fit(x, y)
+    g2.fit(x, y)
+    for layer in g.params:
+        for name, v in g.params[layer].items():
+            np.testing.assert_array_equal(
+                np.asarray(v), np.asarray(g2.params[layer][name]),
+                err_msg=f"{layer}/{name}")
