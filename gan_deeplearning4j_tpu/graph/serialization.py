@@ -59,7 +59,15 @@ def _updater_to_dict(u) -> dict:
     d = {"__type__": name}
     for f in dataclasses.fields(u):
         v = getattr(u, f.name)
-        d[f.name] = _updater_to_dict(v) if dataclasses.is_dataclass(v) else v
+        if dataclasses.is_dataclass(v):
+            d[f.name] = _updater_to_dict(v)
+        elif isinstance(v, (int, float, str, bool, type(None))):
+            d[f.name] = v
+        else:  # e.g. a plain-callable schedule on Scheduled
+            raise TypeError(
+                f"cannot serialize {name}.{f.name}={v!r}: not a registered "
+                "dataclass or JSON scalar (plain-callable schedules are "
+                "trainable but not serializable — use a schedule dataclass)")
     return d
 
 

@@ -245,7 +245,11 @@ def state_from_graphs(dis, gen, gan, classifier, start_step: int = 0,
     (restores from ``gen.ema_params`` when a resumed graph carries one)."""
     ema_gen = None
     if ema:
-        ema_gen = getattr(gen, "ema_params", None) or gen.params
+        src = getattr(gen, "ema_params", None) or gen.params
+        # fresh buffers, NOT aliases of gen_params: the state pytree is
+        # donated, and donating the same buffer under two leaves is
+        # undefined (observed as a wedged CPU collective rendezvous)
+        ema_gen = jax.tree_util.tree_map(jnp.copy, src)
     return ProtocolState(
         dis.params, dis.opt_state, gan.params, gan.opt_state,
         classifier.params, classifier.opt_state, gen.params,

@@ -119,4 +119,3 @@ def test_nan_score_aborts():
     res = trainer.fit()
     assert res.reason == "nan_score"
     assert res.total_epochs == 1
-

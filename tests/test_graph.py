@@ -260,3 +260,24 @@ def test_new_updaters_and_schedules_roundtrip_model_zip(tmp_path):
             np.testing.assert_array_equal(
                 np.asarray(v), np.asarray(g2.params[layer][name]),
                 err_msg=f"{layer}/{name}")
+
+
+def test_plain_callable_schedule_rejected_at_write(tmp_path):
+    from gan_deeplearning4j_tpu.graph import serialization
+    from gan_deeplearning4j_tpu.graph.graph import GraphBuilder, InputSpec
+    from gan_deeplearning4j_tpu.graph.layers import Output
+    from gan_deeplearning4j_tpu.optim import Scheduled, Sgd
+
+    g = (GraphBuilder(seed=666)
+         .add_inputs("in")
+         .set_input_types(InputSpec.feed_forward(4))
+         .add_layer("out", Output(n_out=1, activation="sigmoid", loss="xent",
+                                  updater=Scheduled(Sgd(0.1), lambda t: 0.1)),
+                    "in")
+         .set_outputs("out")
+         .build())
+    g.init()
+    import pytest
+
+    with pytest.raises(TypeError, match="schedule dataclass"):
+        serialization.write_model(g, str(tmp_path / "m.zip"))
