@@ -9,6 +9,7 @@ from gan_deeplearning4j_tpu.graph.layers import (  # noqa: F401
     ConvTranspose2D,
     Dense,
     Dropout,
+    ElementWise,
     MaxPool2D,
     Merge,
     Output,

@@ -168,7 +168,12 @@ class GraphBuilder:
                 layer = dataclasses.replace(layer, weight_init=self.weight_init)
             pre = self._preprocessors.get(name)
             in_shapes = [shapes[i] for i in node.inputs]
-            if isinstance(layer, Merge):
+            if layer.multi_input:
+                if pre is not None:
+                    raise ValueError(
+                        f"vertex {name!r}: preprocessors are not supported "
+                        "on multi-input vertices (attach one to the "
+                        "consuming layer instead)")
                 in_shape: Union[Tuple[int, ...], List[Tuple[int, ...]]] = in_shapes
             else:
                 if len(in_shapes) != 1:
@@ -276,7 +281,7 @@ class ComputationGraph:
             values[inp] = x
         state_updates: Dict[str, Dict[str, jax.Array]] = {}
         for name, node in self.nodes.items():
-            if isinstance(node.layer, Merge):
+            if node.layer.multi_input:
                 x = [values[i] for i in node.inputs]
             else:
                 x = values[node.inputs[0]]

@@ -88,6 +88,11 @@ class Layer:
     def has_params(self) -> bool:
         return True
 
+    @property
+    def multi_input(self) -> bool:
+        """Vertices taking a LIST of inputs (Merge, ElementWise)."""
+        return False
+
     def resolved(self, default_activation: str, default_updater: Optional[RmsProp]):
         new = dataclasses.replace(self)
         if new.activation is None:
@@ -341,6 +346,10 @@ class Merge(Layer):
     def has_params(self):
         return False
 
+    @property
+    def multi_input(self):
+        return True
+
     def out_shape(self, in_shape):
         # in_shape is a list of shapes for multi-input vertices.
         shapes = in_shape
@@ -353,10 +362,64 @@ class Merge(Layer):
         return jnp.concatenate(xs, axis=axis), None
 
 
+@dataclasses.dataclass
+class ElementWise(Layer):
+    """DL4J ElementWiseVertex equivalent: combine same-shaped inputs
+    elementwise.  ``op``: "add" | "product" | "subtract" | "average" |
+    "max" (subtract requires exactly two inputs, like DL4J).
+
+    DL4J's vertex applies no activation; the explicit "identity" default
+    pins that even under a graph-level default activation (an activation
+    passed explicitly still applies, as a convenience DL4J lacks)."""
+
+    op: str = "add"
+    activation: Optional[str] = "identity"
+
+    @property
+    def has_params(self):
+        return False
+
+    @property
+    def multi_input(self):
+        return True
+
+    def out_shape(self, in_shape):
+        shapes = in_shape
+        if self.op == "subtract" and len(shapes) != 2:
+            raise ValueError("subtract takes exactly two inputs")
+        first = tuple(shapes[0])
+        for s in shapes[1:]:
+            if tuple(s) != first:
+                raise ValueError(
+                    f"ElementWise inputs must share a shape; got {shapes}")
+        return first
+
+    def apply(self, params, xs, train, rng, axis_name=None):
+        if self.op == "add":
+            out = sum(xs[1:], xs[0])
+        elif self.op == "product":
+            out = xs[0]
+            for x in xs[1:]:
+                out = out * x
+        elif self.op == "subtract":
+            if len(xs) != 2:
+                raise ValueError("subtract takes exactly two inputs")
+            out = xs[0] - xs[1]
+        elif self.op == "average":
+            out = sum(xs[1:], xs[0]) / len(xs)
+        elif self.op == "max":
+            out = xs[0]
+            for x in xs[1:]:
+                out = jnp.maximum(out, x)
+        else:
+            raise ValueError(f"unknown ElementWise op {self.op!r}")
+        return self._act(out), None
+
+
 LAYER_TYPES = {
     cls.__name__: cls
     for cls in [
         Dense, Output, Conv2D, ConvTranspose2D, MaxPool2D, Upsampling2D,
-        BatchNorm, Dropout, Merge,
+        BatchNorm, Dropout, Merge, ElementWise,
     ]
 }
