@@ -55,15 +55,23 @@ def wasserstein(critic_out: jax.Array, labels: jax.Array) -> jax.Array:
     return -jnp.mean(critic_out * labels)
 
 
-def gradient_penalty(critic_fn, real: jax.Array, fake: jax.Array, rng: jax.Array) -> jax.Array:
+def gradient_penalty(critic_fn, real: jax.Array, fake: jax.Array,
+                     rng: jax.Array, alpha: jax.Array = None) -> jax.Array:
     """WGAN-GP penalty E[(||∇_x D(x̂)||₂ - 1)²] on interpolates x̂.
 
     ``critic_fn`` must be a pure fn of the input batch; second-order autodiff
     flows through it (the reference's SameDiff could not express this —
     BASELINE.json lists it as a stress config).
+
+    ``alpha``: optional pre-drawn interpolation weights [n, 1, ...] — SPMD
+    callers draw the GLOBAL batch's alphas and pass each shard its slice so
+    replicas don't reuse one replicated key (gan_pair._d_step).
     """
     alpha_shape = (real.shape[0],) + (1,) * (real.ndim - 1)
-    alpha = jax.random.uniform(rng, alpha_shape, dtype=real.dtype)
+    if alpha is None:
+        alpha = jax.random.uniform(rng, alpha_shape, dtype=real.dtype)
+    else:
+        alpha = alpha.reshape(alpha_shape).astype(real.dtype)
     interp = alpha * real + (1.0 - alpha) * fake
 
     def scalar_critic(x_single):

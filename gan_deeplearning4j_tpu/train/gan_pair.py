@@ -105,8 +105,21 @@ class GANPair:
                     c = {k: v[:n] for k, v in cond_real.items()}
                     o, _ = self._dis_forward(p, xi, c, False, None)
                     return o
+                gp_key = prng.stream(rng, "gp")
+                alpha = None
+                if axis_name is not None:
+                    # the step rng is replicated: draw the GLOBAL batch's
+                    # alphas on every replica and slice this shard's —
+                    # replicated draws would correlate the GP estimator
+                    # across shards and break mesh==single-device parity
+                    n_shards = self.mesh.shape[self.axis]
+                    n = real.shape[0]
+                    galpha = jax.random.uniform(
+                        gp_key, (n * n_shards, 1), dtype=real.dtype)
+                    alpha = lax.dynamic_slice_in_dim(
+                        galpha, lax.axis_index(axis_name) * n, n)
                 gp = loss_lib.gradient_penalty(
-                    critic, real, fake, prng.stream(rng, "gp"))
+                    critic, real, fake, gp_key, alpha=alpha)
                 loss = loss + self.gp_weight * gp
             return loss, updates
 
@@ -182,25 +195,36 @@ class GANPair:
         — the same dispatch-amortization as the protocol trainer's
         steps_per_call (train/fused_step.py), for the roadmap engine.
 
-        Single-device only (the mesh path keeps per-step dispatch);
-        donation is off (donation + scan crashes the axon TPU runtime).
+        Under a mesh the whole scan is ONE shard_map SPMD program: the
+        table/labels/keys are replicated, every replica draws the full
+        GLOBAL batch (bitwise the single-device stream) and slices its own
+        shard, and grads/losses/BN stats pmean over the axis — the
+        multi-replica fast path for the CelebA roadmap config.
+        Donation is off (donation + scan crashes the axon TPU runtime).
         Returns (step_fn, state0):
           step_fn(state) -> (state', (d_losses[K], g_losses[K]))
           state = (params_g, opt_g, params_d, opt_d, it)
         """
-        if self.mesh is not None:
-            raise ValueError("multistep is single-device; mesh users keep "
-                             "the per-step path")
+        n_shards = self.mesh.shape[self.axis] if self.mesh is not None else 1
+        if batch_size % n_shards != 0:
+            raise ValueError(
+                f"batch_size {batch_size} must divide over {n_shards} "
+                f"devices on the '{self.axis}' axis")
+        local_b = batch_size // n_shards
         n_rows = table_x.shape[0]
         key0 = (seed_key if seed_key is not None
                 else prng.stream(prng.root_key(self.gen.seed), "pair-multi"))
-        y_real_v = jnp.full((batch_size, 1), real_label, jnp.float32)
-        y_fake_v = (-jnp.ones((batch_size, 1), jnp.float32)
+        # constant-fill label vectors: build at the per-shard size (==
+        # batch_size when unmeshed) so the scan body never has to slice
+        y_real_v = jnp.full((local_b, 1), real_label, jnp.float32)
+        y_fake_v = (-jnp.ones((local_b, 1), jnp.float32)
                     if self.mode == "wgan-gp"
-                    else jnp.zeros((batch_size, 1), jnp.float32))
-        y_gen_v = jnp.ones((batch_size, 1), jnp.float32)
+                    else jnp.zeros((local_b, 1), jnp.float32))
+        y_gen_v = jnp.ones((local_b, 1), jnp.float32)
         label_name = self.gen.input_names[1] if len(
             self.gen.input_names) > 1 else None
+
+        axis_name = self.axis if self.mesh is not None else None
 
         def _multi(state, table_x, table_cond, y_real_v, y_fake_v, y_gen_v,
                    key0):
@@ -209,12 +233,18 @@ class GANPair:
             # tunneled PJRT backend closure-captured device constants
             # cost per-execution overhead and bloat the program
             def draw(key, which):
+                # GLOBAL draws on every replica (bitwise the single-device
+                # stream), then each shard takes its own slice
                 k = jax.random.fold_in(key, which)
                 idx = jax.random.randint(
                     jax.random.fold_in(k, 0), (batch_size,), 0, n_rows)
                 z = jax.random.uniform(
                     jax.random.fold_in(k, 1), (batch_size, z_size),
                     minval=-1.0, maxval=1.0)
+                if axis_name is not None:
+                    off = lax.axis_index(axis_name) * local_b
+                    idx = lax.dynamic_slice_in_dim(idx, off, local_b)
+                    z = lax.dynamic_slice_in_dim(z, off, local_b)
                 return idx, z
 
             def cond_of(idx):
@@ -233,19 +263,33 @@ class GANPair:
                     z_in.update(c)
                     pd, od, d_loss = self._d_step(
                         pd, od, pg, prng.stream(key, f"d{j}"),
-                        table_x[idx], z_in, c, c, y_real_v, y_fake_v)
+                        table_x[idx], z_in, c, c, y_real_v, y_fake_v,
+                        axis_name=axis_name)
                 idx, z = draw(key, n_critic)
                 z_in = {self.gen.input_names[0]: z}
                 c = cond_of(idx)
                 z_in.update(c)
                 pg, og, g_loss = self._g_step(
-                    pg, og, pd, prng.stream(key, "g"), z_in, c, y_gen_v)
+                    pg, og, pd, prng.stream(key, "g"), z_in, c, y_gen_v,
+                    axis_name=axis_name)
                 return (pg, og, pd, od, it + 1), (d_loss, g_loss)
 
             return lax.scan(one_iteration, state, None,
                             length=steps_per_call)
 
-        jit_multi = jax.jit(_multi)
+        if self.mesh is None:
+            jit_multi = jax.jit(_multi)
+        else:
+            # everything replicated: state, the resident table, label
+            # vectors and keys; each shard slices its own batch rows.
+            # Losses come out pmean'd (replicated).
+            jit_multi = jax.jit(shard_map(
+                _multi,
+                mesh=self.mesh,
+                in_specs=(P(),) * 7,
+                out_specs=(P(), P()),
+                check_vma=False,
+            ))
         invariants = (table_x, table_cond, y_real_v, y_fake_v, y_gen_v,
                       key0)
 

@@ -127,7 +127,6 @@ def train(family: str, iterations: int, batch_size: int, res_path: str,
     root = prng.root_key(cfg.seed)
     z_key = prng.stream(root, "roadmap-z")
     metrics = MetricsLogger(os.path.join(res_path, f"{family}_metrics.jsonl"))
-    rng_np = np.random.RandomState(cfg.seed)
     # fixed evaluation grid (8x8) like the reference's latent-grid dumps;
     # drawn from the TRAINING latent law U[-1,1] (a normal draw would put
     # ~1/3 of components outside the trained support and misrepresent
@@ -171,93 +170,48 @@ def train(family: str, iterations: int, batch_size: int, res_path: str,
         steady_t0 = None
         steady_start = 0
         d_loss = g_loss = jnp.zeros(())
-        if mesh is None:
-            # fused multi-iteration fast path: ONE dispatch per K iterations
-            # (dispatch latency otherwise bounds the loop — same rationale as
-            # the protocol trainer's steps_per_call)
-            import math
+        # fused multi-iteration fast path: ONE dispatch per K iterations
+        # (dispatch latency otherwise bounds the loop — same rationale
+        # as the protocol trainer's steps_per_call); under a mesh the
+        # scan is one shard_map SPMD program (GANPair.make_multistep)
+        import math
 
-            from gan_deeplearning4j_tpu.train.fused_step import (
-                MAX_STEPS_PER_CALL,
-            )
+        from gan_deeplearning4j_tpu.train.fused_step import (
+            MAX_STEPS_PER_CALL,
+        )
 
-            g = math.gcd(math.gcd(iterations, print_every), 100)
-            K = max(d for d in range(1, min(MAX_STEPS_PER_CALL, g) + 1)
-                    if g % d == 0)
-            step_fn, state = pair.make_multistep(
-                jnp.asarray(x), None if y is None else jnp.asarray(y),
-                batch_size=batch_size, steps_per_call=K, n_critic=n_critic,
-                real_label=real_label, z_size=cfg.z_size,
-                seed_key=z_key)
-            it = 0
-            while it < iterations:
-                state, (dl, gl) = step_fn(state)
-                if steady_t0 is None:
-                    device_fence((dl, gl))
-                    steady_t0 = time.perf_counter()
-                    steady_start = it + K
-                # per-step LOSSES are real; per-step wall-clock is not (K
-                # steps land in one dispatch), so omit examples — the
-                # run-level examples_per_sec in the result is the throughput
-                # record.  ONE chunk record keeps the (K,) loss arrays
-                # stacked on device (per-step slicing is host work that
-                # scales with steps — see MetricsLogger.log_chunk).
-                metrics.log_chunk(it + 1, K, 0, {"d_loss": dl, "g_loss": gl})
-                it += K
-                d_loss, g_loss = dl[-1], gl[-1]
-                if it % 100 == 0:
-                    log(f"[{family}] iteration {it}: d={float(d_loss):.4f} "
-                        f"g={float(g_loss):.4f}")
-                if it % print_every == 0 or it >= iterations:
-                    pair.adopt_state(state)
-                    dump_samples(it)
-            pair.adopt_state(state)
-            iterations = it
-        else:
-            draw = 0
-            for it in range(1, iterations + 1):
-                for _ in range(n_critic):
-                    idx = rng_np.randint(0, n_train, batch_size)
-                    real = jnp.asarray(x[idx])
-                    draw += 1
-                    z = jax.random.uniform(
-                        jax.random.fold_in(z_key, draw),
-                        (batch_size, cfg.z_size), minval=-1.0, maxval=1.0)
-                    z_in: Dict = {"z": z}
-                    cond_r = cond_f = None
-                    if y is not None:
-                        lab = jnp.asarray(y[idx])
-                        z_in["label"] = lab
-                        cond_r = cond_f = {"label": lab}
-                    y_real = y_fake = None
-                    if real_label != 1.0:
-                        y_real = jnp.full((batch_size, 1), real_label,
-                                          jnp.float32)
-                        y_fake = jnp.zeros((batch_size, 1), jnp.float32)
-                    d_loss = pair.d_step(real, z_in, cond_r, cond_f, y_real,
-                                         y_fake)
-                draw += 1
-                z = jax.random.uniform(
-                    jax.random.fold_in(z_key, draw),
-                    (batch_size, cfg.z_size), minval=-1.0, maxval=1.0)
-                z_in = {"z": z}
-                cond_f = None
-                if y is not None:
-                    lab = jnp.asarray(y[rng_np.randint(0, n_train, batch_size)])
-                    z_in["label"] = lab
-                    cond_f = {"label": lab}
-                g_loss = pair.g_step(z_in, cond_f)
-                if steady_t0 is None:
-                    device_fence((d_loss, g_loss))
-                    steady_t0 = time.perf_counter()
-                    steady_start = it
-                metrics.log_step(it, examples=batch_size * (n_critic + 1),
-                                 d_loss=d_loss, g_loss=g_loss)
-                if it % 100 == 0:
-                    log(f"[{family}] iteration {it}: d={float(d_loss):.4f} "
-                        f"g={float(g_loss):.4f}")
-                if it % print_every == 0 or it == iterations:
-                    dump_samples(it)
+        g = math.gcd(math.gcd(iterations, print_every), 100)
+        K = max(d for d in range(1, min(MAX_STEPS_PER_CALL, g) + 1)
+                if g % d == 0)
+        step_fn, state = pair.make_multistep(
+            jnp.asarray(x), None if y is None else jnp.asarray(y),
+            batch_size=batch_size, steps_per_call=K, n_critic=n_critic,
+            real_label=real_label, z_size=cfg.z_size,
+            seed_key=z_key)
+        it = 0
+        while it < iterations:
+            state, (dl, gl) = step_fn(state)
+            if steady_t0 is None:
+                device_fence((dl, gl))
+                steady_t0 = time.perf_counter()
+                steady_start = it + K
+            # per-step LOSSES are real; per-step wall-clock is not (K
+            # steps land in one dispatch), so omit examples — the
+            # run-level examples_per_sec in the result is the throughput
+            # record.  ONE chunk record keeps the (K,) loss arrays
+            # stacked on device (per-step slicing is host work that
+            # scales with steps — see MetricsLogger.log_chunk).
+            metrics.log_chunk(it + 1, K, 0, {"d_loss": dl, "g_loss": gl})
+            it += K
+            d_loss, g_loss = dl[-1], gl[-1]
+            if it % 100 == 0:
+                log(f"[{family}] iteration {it}: d={float(d_loss):.4f} "
+                    f"g={float(g_loss):.4f}")
+            if it % print_every == 0 or it >= iterations:
+                pair.adopt_state(state)
+                dump_samples(it)
+        pair.adopt_state(state)
+        iterations = it
 
     device_fence((d_loss, g_loss))
     steps_timed = iterations - steady_start if steady_t0 is not None else 0
