@@ -13,6 +13,10 @@ from gan_deeplearning4j_tpu.data.csv import (
     read_csv_matrix,
     write_csv_matrix,
 )
+from gan_deeplearning4j_tpu.data.normalizers import (  # noqa: F401
+    NormalizerMinMaxScaler,
+    NormalizerStandardize,
+)
 from gan_deeplearning4j_tpu.data.datasets import (
     ensure_insurance_csv,
     ensure_mnist_csv,

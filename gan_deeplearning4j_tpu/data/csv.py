@@ -124,6 +124,7 @@ class RecordReaderDataSetIterator:
                 # numClasses=1: raw sigmoid target column (insurance path)
                 self._labels = raw.reshape(-1, 1).astype(dtype)
         self._cursor = 0
+        self._preprocessor = None
 
     @property
     def features(self) -> np.ndarray:
@@ -151,7 +152,16 @@ class RecordReaderDataSetIterator:
             if self._labels is not None
             else np.zeros((hi - lo, 0), dtype=feats.dtype)
         )
-        return DataSet(feats, labels)
+        ds = DataSet(feats.copy() if self._preprocessor else feats, labels)
+        if self._preprocessor is not None:
+            self._preprocessor.preprocess(ds)
+        return ds
+
+    def set_preprocessor(self, preprocessor) -> None:
+        """ND4J ``iterator.setPreProcessor(normalizer)``: applied to every
+        ``next()``'s DataSet (data/normalizers.py fit/transform objects,
+        or any callable-free object with ``preprocess(DataSet)``)."""
+        self._preprocessor = preprocessor
 
     def reset(self) -> None:
         self._cursor = 0

@@ -632,6 +632,10 @@ class GANTrainer:
         c = self.c
         if iter_train.num_examples() < c.batch_size:
             return False
+        if getattr(iter_train, "_preprocessor", None) is not None:
+            # the resident path reads the raw backing table; a per-batch
+            # preprocessor would be silently skipped there
+            return False
         if c.data_on_device is not None:
             return bool(c.data_on_device)
         size = iter_train.features.nbytes + iter_train.labels.nbytes

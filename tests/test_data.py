@@ -301,3 +301,75 @@ def test_roadmap_image_folder_nonten_classes(tmp_path):
                 "--res-path", str(tmp_path / "run"), "--data-dir",
                 str(data)])
     assert out["steps"] == 2 and np.isfinite(out["d_loss"])
+
+
+def test_normalizers_fit_transform_revert(tmp_path):
+    """ND4J DataNormalization equivalents: fit on the TRAIN iterator,
+    transform every batch via set_preprocessor, revert round-trips, and
+    stats persist to disk."""
+    import numpy as np
+
+    from gan_deeplearning4j_tpu.data import (
+        NormalizerMinMaxScaler,
+        NormalizerStandardize,
+        RecordReaderDataSetIterator,
+        write_csv_matrix,
+    )
+
+    rng = np.random.RandomState(0)
+    table = np.hstack([rng.rand(40, 3) * np.array([10.0, 2.0, 1.0]) + 5.0,
+                       rng.randint(0, 2, (40, 1)).astype(float)])
+    csv = str(tmp_path / "t.csv")
+    write_csv_matrix(csv, table)
+    it = RecordReaderDataSetIterator(csv, 8, label_index=3, num_classes=1)
+
+    mm = NormalizerMinMaxScaler().fit(it)
+    it.set_preprocessor(mm)
+    batches = [it.next().features for _ in range(5)]
+    x = np.vstack(batches)
+    assert x.min() >= 0.0 and x.max() <= 1.0
+    assert np.isclose(x.min(axis=0), 0.0).all()
+    assert np.isclose(x.max(axis=0), 1.0).all()
+    # labels untouched
+    it.reset()
+    np.testing.assert_array_equal(it.next().labels.ravel(), table[:8, 3])
+    # revert inverts transform
+    raw = table[:8, :3].astype(np.float32)
+    np.testing.assert_allclose(mm.revert(mm.transform(raw)), raw, rtol=1e-5)
+
+    st = NormalizerStandardize().fit(table[:, :3])
+    z = st.transform(table[:, :3])
+    np.testing.assert_allclose(z.mean(axis=0), 0.0, atol=1e-5)
+    np.testing.assert_allclose(z.std(axis=0), 1.0, atol=1e-4)
+    np.testing.assert_allclose(st.revert(z), table[:, :3], rtol=1e-4)
+
+    # persistence round-trip (the train-time scaling restorable anywhere)
+    p = str(tmp_path / "norm.npz")
+    mm.save(p)
+    mm2 = NormalizerMinMaxScaler.load(p)
+    np.testing.assert_allclose(mm2.transform(raw), mm.transform(raw))
+
+    # unfit use fails fast
+    import pytest
+
+    with pytest.raises(ValueError, match="must be fit"):
+        NormalizerStandardize().transform(raw)
+
+
+def test_normalizer_constant_column():
+    import numpy as np
+
+    from gan_deeplearning4j_tpu.data import (
+        NormalizerMinMaxScaler,
+        NormalizerStandardize,
+    )
+
+    x = np.hstack([np.full((10, 1), 7.0), np.arange(10.0).reshape(-1, 1)])
+    mm = NormalizerMinMaxScaler().fit(x)
+    out = mm.transform(x)
+    assert np.isfinite(out).all()
+    np.testing.assert_allclose(out[:, 0], 0.0)  # constant -> min_range
+    st = NormalizerStandardize().fit(x)
+    out = st.transform(x)
+    assert np.isfinite(out).all()
+    np.testing.assert_allclose(out[:, 0], 0.0)
