@@ -28,6 +28,8 @@ from gan_deeplearning4j_tpu.data.datasets import (
 )
 
 __all__ = [
+    "NormalizerMinMaxScaler",
+    "NormalizerStandardize",
     "CSVRecordReader",
     "DataSet",
     "RecordReaderDataSetIterator",

@@ -152,15 +152,22 @@ class RecordReaderDataSetIterator:
             if self._labels is not None
             else np.zeros((hi - lo, 0), dtype=feats.dtype)
         )
-        ds = DataSet(feats.copy() if self._preprocessor else feats, labels)
+        ds = DataSet(feats, labels)
         if self._preprocessor is not None:
+            # contract: preprocess REPLACES ds.features (the normalizers
+            # do), never mutates it — feats is a view of the backing table
             self._preprocessor.preprocess(ds)
         return ds
+
+    @property
+    def preprocessor(self):
+        return self._preprocessor
 
     def set_preprocessor(self, preprocessor) -> None:
         """ND4J ``iterator.setPreProcessor(normalizer)``: applied to every
         ``next()``'s DataSet (data/normalizers.py fit/transform objects,
-        or any callable-free object with ``preprocess(DataSet)``)."""
+        or any object with ``preprocess(DataSet)`` that REPLACES
+        ``features`` rather than mutating the passed view)."""
         self._preprocessor = preprocessor
 
     def reset(self) -> None:

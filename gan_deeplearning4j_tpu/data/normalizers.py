@@ -24,10 +24,15 @@ import numpy as np
 
 
 class _FitNormalizer:
-    """fit over an iterator or array; transform features in place on a
-    DataSet (labels untouched, like ND4J's default)."""
+    """fit over an iterator or array; transform features on a DataSet
+    (labels untouched, like ND4J's default).
 
-    _STAT_NAMES: tuple = ()
+    ``preprocess`` REPLACES ``dataset.features`` with a new array — it
+    must not mutate the passed array, which may be a view of the
+    iterator's backing table."""
+
+    _STAT_NAMES: tuple = ()     # fitted arrays persisted in save()
+    _CONFIG_NAMES: tuple = ()   # constructor scalars persisted in save()
 
     def __init__(self):
         for n in self._STAT_NAMES:
@@ -36,8 +41,13 @@ class _FitNormalizer:
     # -- fitting -------------------------------------------------------------
 
     def fit(self, data) -> "_FitNormalizer":
-        """``data``: a DataSetIterator (reset + drained) or a [N, F] array."""
-        if hasattr(data, "reset") and hasattr(data, "next"):
+        """``data``: a DataSetIterator or a [N, F] array.  Stats are
+        always computed on the RAW features — an iterator's backing table
+        is read directly, so a preprocessor already attached to it (even
+        this one) cannot leak into the fit."""
+        if hasattr(data, "features") and not isinstance(data, np.ndarray):
+            x = np.asarray(data.features)
+        elif hasattr(data, "reset") and hasattr(data, "next"):
             data.reset()
             batches = []
             while data.has_next():
@@ -77,7 +87,8 @@ class _FitNormalizer:
     def save(self, path: str) -> None:
         self._check_fit()
         np.savez(path, __type__=type(self).__name__,
-                 **{n: getattr(self, n) for n in self._STAT_NAMES})
+                 **{n: getattr(self, n)
+                    for n in self._STAT_NAMES + self._CONFIG_NAMES})
 
     @staticmethod
     def load(path: str) -> "_FitNormalizer":
@@ -88,6 +99,9 @@ class _FitNormalizer:
             out = cls()
             for n in cls._STAT_NAMES:
                 setattr(out, n, f[n])
+            for n in cls._CONFIG_NAMES:
+                if n in f:  # older files lack config scalars
+                    setattr(out, n, float(f[n]))
         return out
 
 
@@ -96,6 +110,7 @@ class NormalizerMinMaxScaler(_FitNormalizer):
     ND4J NormalizerMinMaxScaler (the notebook's insurance scaling)."""
 
     _STAT_NAMES = ("data_min", "data_max")
+    _CONFIG_NAMES = ("min_range", "max_range")
 
     def __init__(self, min_range: float = 0.0, max_range: float = 1.0):
         super().__init__()

@@ -632,9 +632,15 @@ class GANTrainer:
         c = self.c
         if iter_train.num_examples() < c.batch_size:
             return False
-        if getattr(iter_train, "_preprocessor", None) is not None:
+        if getattr(iter_train, "preprocessor", None) is not None:
             # the resident path reads the raw backing table; a per-batch
             # preprocessor would be silently skipped there
+            if c.data_on_device:
+                import logging
+
+                logging.getLogger(__name__).warning(
+                    "data_on_device=True overridden: the iterator has a "
+                    "preprocessor, which the resident path cannot apply")
             return False
         if c.data_on_device is not None:
             return bool(c.data_on_device)

@@ -349,6 +349,20 @@ def test_normalizers_fit_transform_revert(tmp_path):
     mm2 = NormalizerMinMaxScaler.load(p)
     np.testing.assert_allclose(mm2.transform(raw), mm.transform(raw))
 
+    # non-default range survives persistence (tanh-GAN [-1, 1] scaling)
+    tanh = NormalizerMinMaxScaler(min_range=-1.0, max_range=1.0).fit(it)
+    p2 = str(tmp_path / "tanh.npz")
+    tanh.save(p2)
+    tanh2 = NormalizerMinMaxScaler.load(p2)
+    assert tanh2.min_range == -1.0 and tanh2.max_range == 1.0
+    np.testing.assert_allclose(tanh2.transform(raw), tanh.transform(raw))
+
+    # fitting FROM an iterator with a preprocessor attached still sees
+    # the raw table (no double-normalized stats)
+    refit = NormalizerMinMaxScaler().fit(it)   # it has mm attached
+    np.testing.assert_allclose(refit.data_min, mm.data_min)
+    np.testing.assert_allclose(refit.data_max, mm.data_max)
+
     # unfit use fails fast
     import pytest
 
