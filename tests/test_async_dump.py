@@ -88,3 +88,26 @@ def test_flush_timeout_raises_on_stalled_worker():
         w.flush(timeout=0.2)
     gate.set()
     w.close()
+
+
+def test_device_helpers_roundtrip():
+    """overlap_device_get / start_host_copy / device_fence: materialize
+    arbitrary pytrees with non-array leaves passing through."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from gan_deeplearning4j_tpu.utils import (
+        device_fence,
+        overlap_device_get,
+        start_host_copy,
+    )
+
+    tree = {"a": jnp.arange(4.0), "b": [jnp.ones((2, 2)), "label"],
+            "c": (3, None)}
+    assert start_host_copy(tree) is tree  # passthrough, non-blocking
+    out = overlap_device_get(tree)
+    np.testing.assert_array_equal(out["a"], np.arange(4.0))
+    assert isinstance(out["a"], np.ndarray)
+    np.testing.assert_array_equal(out["b"][0], np.ones((2, 2)))
+    assert out["b"][1] == "label" and out["c"] == (3, None)
+    device_fence(tree)  # completes without error
