@@ -1,0 +1,110 @@
+"""Acceptance battery: the reference's own evidence runs, as one command.
+
+Probes the device link first (a tunneled PJRT backend can wedge — see
+utils.device docs), then runs, on the attached device:
+
+  1. ``bench.py``              — step/multistep/MFU/e2e JSON line
+  2. CV DCGAN 10k acceptance   — accuracy + FID (+ fid_ema with --ema-decay)
+  3. insurance 5k acceptance   — weighted AUROC
+
+and prints ONE summary JSON.  This is the reproduce-everything command
+behind RESULTS.md §1/§2 (the reference's 97.07% / 91.63% evidence style,
+gan.ipynb raw lines 373-374).
+
+Run: ``python benchmarks/acceptance.py [--out-dir DIR] [--ema-decay 0.999]
+[--skip-insurance] [--probe-timeout 90]``
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+
+
+def probe_device(timeout_s: float) -> float:
+    """Round-trip ms for a small dispatch+readback in a subprocess (a
+    wedged tunnel then times out the child, not this process).  Returns
+    the measured ms, or raises RuntimeError."""
+    code = (
+        "import os, time, numpy as np, jax, jax.numpy as jnp\n"
+        # honor an explicit JAX_PLATFORMS in this FRESH child interpreter
+        # (safe here: no in-process override to clobber — see the NOTE in
+        # runtime/backend.py for why the library itself must not do this)
+        "if os.environ.get('JAX_PLATFORMS'):\n"
+        "    jax.config.update('jax_platforms', os.environ['JAX_PLATFORMS'])\n"
+        "f = jax.jit(lambda a: a @ a)\n"
+        "x = jnp.ones((64, 64)); np.asarray(f(x))\n"
+        "t0 = time.perf_counter()\n"
+        "for _ in range(5): np.asarray(f(x))\n"
+        "print((time.perf_counter() - t0) * 200)\n"
+    )
+    try:
+        out = subprocess.run([sys.executable, "-c", code],
+                             capture_output=True, text=True,
+                             timeout=timeout_s)
+    except subprocess.TimeoutExpired:
+        raise RuntimeError(
+            f"device link unresponsive (> {timeout_s:.0f}s for a small "
+            "round trip); retry when the tunnel recovers") from None
+    if out.returncode != 0:
+        raise RuntimeError(f"device probe failed:\n{out.stderr[-800:]}")
+    return float(out.stdout.strip().splitlines()[-1])
+
+
+def main(argv=None) -> dict:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--out-dir", default="outputs/acceptance")
+    p.add_argument("--ema-decay", type=float, default=0.999)
+    p.add_argument("--skip-bench", action="store_true")
+    p.add_argument("--skip-insurance", action="store_true")
+    p.add_argument("--probe-timeout", type=float, default=90.0)
+    args = p.parse_args(argv)
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    summary: dict = {}
+
+    rt_ms = probe_device(args.probe_timeout)
+    summary["probe_round_trip_ms"] = round(rt_ms, 1)
+    print(f"[acceptance] device round trip {rt_ms:.1f} ms", flush=True)
+
+    def run(cmd, tag):
+        t0 = time.perf_counter()
+        out = subprocess.run([sys.executable] + cmd, cwd=repo,
+                             capture_output=True, text=True)
+        dt = time.perf_counter() - t0
+        if out.returncode != 0:
+            raise RuntimeError(f"{tag} failed:\n{out.stderr[-1500:]}")
+        last = out.stdout.strip().splitlines()[-1]
+        print(f"[acceptance] {tag} done in {dt:.0f}s: {last}", flush=True)
+        return last, dt
+
+    if not args.skip_bench:
+        line, dt = run(["bench.py"], "bench")
+        summary["bench"] = json.loads(line)
+        summary["bench_wall_s"] = round(dt, 1)
+
+    cv_cmd = ["-m", "gan_deeplearning4j_tpu.train.cv_main",
+              "--res-path", os.path.join(args.out_dir, "cv")]
+    if args.ema_decay:
+        cv_cmd += ["--ema-decay", str(args.ema_decay)]
+    line, dt = run(cv_cmd, "cv-10k")
+    summary["cv"] = json.loads(line)
+    summary["cv_wall_s"] = round(dt, 1)
+
+    if not args.skip_insurance:
+        line, dt = run(["-m", "gan_deeplearning4j_tpu.train.insurance_main",
+                        "--res-path", os.path.join(args.out_dir, "insurance")],
+                       "insurance-5k")
+        summary["insurance"] = json.loads(line)
+        summary["insurance_wall_s"] = round(dt, 1)
+
+    print(json.dumps(summary))
+    return summary
+
+
+if __name__ == "__main__":
+    main()

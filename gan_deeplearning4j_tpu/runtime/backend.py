@@ -22,6 +22,14 @@ from typing import Optional, Sequence
 import jax
 import numpy as np
 
+# NOTE on platform selection: the axon TPU image force-sets jax_platforms
+# via sitecustomize AND exports JAX_PLATFORMS=axon ambiently, so a
+# module-level "re-apply the env var" here is NOT safe — it would clobber
+# an explicit in-process override (e.g. tests/conftest.py forcing cpu)
+# with the ambient value.  Platform forcing therefore stays the caller's
+# job: ``jax.config.update("jax_platforms", ...)`` before the first op
+# (conftest.py and the dryrun re-exec both do this).
+
 
 @dataclasses.dataclass
 class RuntimeConfig:

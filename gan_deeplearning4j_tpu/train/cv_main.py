@@ -134,7 +134,11 @@ def main(argv=None) -> Dict[str, float]:
             lambda: CVWorkload(n_train=args.n_train, n_test=args.n_test),
             max_restarts=args.max_restarts)
     result.update(evaluate(trainer, fid_samples=args.fid_samples))
-    print(result)
+    import json
+
+    # one JSON line (numpy scalars coerced) — machine-consumable, cf.
+    # bench.py and benchmarks/acceptance.py
+    print(json.dumps(result, default=float))
     return result
 
 

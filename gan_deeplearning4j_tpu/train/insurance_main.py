@@ -130,7 +130,11 @@ def main(argv=None) -> Dict[str, float]:
         trainer, result = run_with_recovery(
             config, InsuranceWorkload, max_restarts=args.max_restarts)
     result.update(evaluate(trainer))
-    print(result)
+    import json
+
+    # one JSON line (numpy scalars coerced) — machine-consumable, cf.
+    # bench.py and benchmarks/acceptance.py
+    print(json.dumps(result, default=float))
     return result
 
 

@@ -256,7 +256,11 @@ def main(argv=None) -> Dict[str, float]:
     result = train(args.family, args.iterations, args.batch_size, res,
                    args.n_train, args.print_every, args.n_devices,
                    data_dir=args.data_dir)
-    print(result)
+    import json
+
+    # one JSON line (numpy scalars coerced) — machine-consumable, cf.
+    # bench.py and benchmarks/acceptance.py
+    print(json.dumps(result, default=float))
     return result
 
 
