@@ -1,21 +1,215 @@
-"""Driver-facing benchmark shim — the implementation lives in
-``gan_deeplearning4j_tpu.bench`` (namespaced so the installed wheel does
-not drop a generically-named top-level ``bench`` module into
-site-packages).  Kept at the repo root because the driver invokes
-``python bench.py`` here; prints ONE JSON line (see the package module's
-docstring for the schema)."""
+"""Driver-facing benchmark entry, hardened against a wedged device link.
 
+The implementation lives in ``gan_deeplearning4j_tpu.bench``; this shim is
+what the driver runs (``python bench.py``) and its contract is strict:
+
+  print ONE final JSON line and exit 0 — ALWAYS.
+
+Two shapes of that line:
+
+  healthy link   -> the inner benchmark's own JSON
+                    ({"metric": "dcgan_mnist_img_per_sec", "value": N, ...});
+                    the payload is also cached to ``BENCH_LASTGOOD.json``
+                    (with probe context) when it was measured on a real
+                    accelerator, so a later wedged round can cite it.
+  unreachable    -> {"metric": ..., "value": null, "skipped": true,
+                     "reason": "...", "cached": {... last verified device
+                     run, clearly labeled ...}}
+
+Why this exists: the PJRT link to the chip is a shared tunnel whose
+round-trip latency has been observed anywhere from ~70ms to wedged-for-
+minutes within one day.  ``jax.devices()`` on a wedged tunnel blocks
+indefinitely, so the parent process NEVER initializes a JAX backend; all
+device contact happens in bounded-timeout subprocesses:
+
+  1. probe:  ``utils/probe.py``'s dispatch+readback child, bounded by
+             BENCH_PROBE_TIMEOUT, retried BENCH_PROBE_ATTEMPTS times with
+             backoff (a wedged tunnel often recovers within minutes);
+  2. run:    the real benchmark child, BENCH_RUN_TIMEOUT bound, one
+             re-probe-and-retry on TRANSIENT failure (a tunnel can die
+             mid-run); deterministic failures (argparse rc 2) skip
+             immediately.
+
+Knobs (env, all optional): BENCH_PROBE_TIMEOUT (s, default 90),
+BENCH_PROBE_ATTEMPTS (default 3), BENCH_PROBE_BACKOFF (s, default 45),
+BENCH_RUN_TIMEOUT (s, default 2400).  CLI flags are passed through to the
+inner benchmark (see ``python -m gan_deeplearning4j_tpu.bench --help``).
+
+Verified failure path: run with the tunnel down (or
+``JAX_PLATFORMS=tpu`` on a host with no TPU) — the skip line appears
+within attempts*(timeout+backoff) seconds; tests/test_bench_entry.py
+pins this behavior with a guaranteed-dead backend.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+# re-exported for tests/test_tpu_smoke.py and interactive use; the inner
+# module imports no JAX at module scope, so this cannot wedge
 from gan_deeplearning4j_tpu.bench import (  # noqa: F401
     BATCH,
     METHODOLOGY_VERSION,
     _build_step_and_args,
     _fence,
     e2e_img_per_sec,
-    main,
     protocol_step_time,
 )
+from gan_deeplearning4j_tpu.utils.probe import probe_device
+
+REPO = os.path.dirname(os.path.abspath(__file__))
+LASTGOOD_PATH = os.path.join(REPO, "BENCH_LASTGOOD.json")
+
+
+def _env_num(name: str, default: float, cast=float):
+    """A malformed env knob must degrade to the default, not crash the
+    shim before it can print its JSON line."""
+    try:
+        return cast(os.environ.get(name, default))
+    except (TypeError, ValueError):
+        print(f"[bench] ignoring malformed {name}={os.environ[name]!r}; "
+              f"using {default}", file=sys.stderr, flush=True)
+        return default
+
+
+PROBE_TIMEOUT = _env_num("BENCH_PROBE_TIMEOUT", 90.0)
+PROBE_ATTEMPTS = _env_num("BENCH_PROBE_ATTEMPTS", 3, int)
+PROBE_BACKOFF = _env_num("BENCH_PROBE_BACKOFF", 45.0)
+RUN_TIMEOUT = _env_num("BENCH_RUN_TIMEOUT", 2400.0)
+
+
+def _log(msg: str) -> None:
+    print(f"[bench] {msg}", file=sys.stderr, flush=True)
+
+
+def probe_with_retry():
+    """Probe with bounded retry/backoff (a wedged tunnel often recovers
+    within minutes).  Returns (platform, rt_ms) or raises RuntimeError
+    carrying every attempt's reason."""
+    reasons = []
+    for attempt in range(1, PROBE_ATTEMPTS + 1):
+        try:
+            platform, rt_ms = probe_device(PROBE_TIMEOUT, cwd=REPO)
+            _log(f"probe ok (attempt {attempt}): platform={platform} "
+                 f"round-trip {rt_ms:.1f}ms")
+            return platform, rt_ms
+        except RuntimeError as e:
+            reasons.append(f"attempt {attempt}: {e}")
+            _log(reasons[-1])
+            if attempt < PROBE_ATTEMPTS:
+                _log(f"backing off {PROBE_BACKOFF:.0f}s before re-probe")
+                time.sleep(PROBE_BACKOFF)
+    raise RuntimeError("; ".join(reasons))
+
+
+def _emit(payload: dict) -> int:
+    print(json.dumps(payload), flush=True)
+    return 0
+
+
+def _skip(reason: str) -> int:
+    payload = {
+        "metric": "dcgan_mnist_img_per_sec",
+        "value": None,
+        "unit": "img/sec/chip",
+        "skipped": True,
+        "reason": reason,
+    }
+    if os.path.exists(LASTGOOD_PATH):
+        try:
+            with open(LASTGOOD_PATH) as f:
+                payload["cached"] = json.load(f)
+            payload["cached_note"] = (
+                "last verified accelerator run (see cached.captured_*); "
+                "NOT measured this round")
+        except (OSError, ValueError):
+            pass
+    return _emit(payload)
+
+
+def _record_lastgood(payload: dict, platform: str, rt_ms: float) -> None:
+    # only a default-shaped run (reference batch 200, e2e included) may
+    # replace the cached headline — a debug invocation (--batch 8,
+    # --skip-e2e) must not become what a later wedged round cites
+    if payload.get("batch") != 200 or "e2e_img_per_sec" not in payload:
+        _log("non-default run; BENCH_LASTGOOD.json left untouched")
+        return
+    try:
+        with open(LASTGOOD_PATH, "w") as f:
+            json.dump({
+                **payload,
+                "captured_platform": platform,
+                "captured_probe_rt_ms": round(rt_ms, 1),
+                "captured_unix_time": int(time.time()),
+            }, f, indent=1)
+    except OSError as e:  # a read-only checkout must not fail the bench
+        _log(f"could not write {LASTGOOD_PATH}: {e}")
+
+
+def _main_inner(argv) -> int:
+    try:
+        platform, rt_ms = probe_with_retry()
+    except RuntimeError as e:
+        return _skip(f"probe exhausted {PROBE_ATTEMPTS} attempts: {e}")
+
+    cmd = [sys.executable, "-m", "gan_deeplearning4j_tpu.bench"] + argv
+    for attempt in (1, 2):
+        try:
+            out = subprocess.run(cmd, cwd=REPO, capture_output=True,
+                                 text=True, timeout=RUN_TIMEOUT)
+        except subprocess.TimeoutExpired:
+            fail = f"benchmark run exceeded {RUN_TIMEOUT:.0f}s"
+            out = None
+        else:
+            if out.returncode == 0:
+                break
+            fail = ("benchmark run failed: "
+                    + " | ".join(out.stderr.strip().splitlines()[-3:])[-500:])
+            if out.returncode == 2:  # argparse usage error: deterministic
+                return _skip(fail)
+        _log(fail)
+        if attempt == 1:
+            # the tunnel may have died mid-run; one bounded re-probe
+            # decides between retry and structured skip
+            try:
+                platform, rt_ms = probe_with_retry()
+            except RuntimeError as e:
+                return _skip(f"{fail}; re-probe also failed: {e}")
+            _log("re-probe ok; retrying benchmark once")
+    else:
+        return _skip(f"benchmark failed twice with a live probe: {fail}")
+
+    for line in out.stdout.strip().splitlines()[:-1]:
+        _log(f"inner: {line}")
+    for line in out.stderr.strip().splitlines()[-20:]:
+        _log(f"inner! {line}")
+    try:
+        payload = json.loads(out.stdout.strip().splitlines()[-1])
+    except (ValueError, IndexError):
+        return _skip(
+            f"benchmark printed no JSON line: {out.stdout[-300:]!r}")
+    if platform != "cpu":
+        _record_lastgood(payload, platform, rt_ms)
+    return _emit(payload)
+
+
+def main(argv=None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    try:
+        return _main_inner(argv)
+    except Exception as e:  # the contract: one JSON line, exit 0, ALWAYS
+        try:
+            return _skip(f"unexpected shim error: {e!r}")
+        except Exception:
+            print(json.dumps({"metric": "dcgan_mnist_img_per_sec",
+                              "value": None, "skipped": True,
+                              "reason": "unexpected shim error"}))
+            return 0
+
 
 if __name__ == "__main__":
-    import sys
-
     sys.exit(main())

@@ -287,6 +287,8 @@ def main(argv=None) -> None:
 
     from gan_deeplearning4j_tpu.utils import maybe_trace
 
+    backend.apply_env_platform()  # __main__-entry env honor (see its doc)
+
     default = jax.devices()[0]
     cpu = jax.devices("cpu")[0]
 

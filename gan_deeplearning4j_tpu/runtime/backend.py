@@ -28,7 +28,31 @@ import numpy as np
 # an explicit in-process override (e.g. tests/conftest.py forcing cpu)
 # with the ambient value.  Platform forcing therefore stays the caller's
 # job: ``jax.config.update("jax_platforms", ...)`` before the first op
-# (conftest.py and the dryrun re-exec both do this).
+# (conftest.py and the dryrun re-exec both do this).  PROCESS ENTRY POINTS
+# (a ``__main__``/fresh subprocess, where no in-process override can exist
+# yet) may honor an explicit env request via ``apply_env_platform()``.
+
+
+def apply_env_platform() -> None:
+    """Honor an explicit ``JAX_PLATFORMS`` env request at a process entry
+    point.  The sitecustomize clobber (NOTE above) means the env var alone
+    does nothing; call this from ``__main__``-style entries ONLY — never
+    at library import time (it would override conftest-style in-process
+    forcing with the ambient value).
+
+    The host CPU backend is always kept on the list (the image exports the
+    bare ``JAX_PLATFORMS=axon``, but bench's baseline and several fallbacks
+    need ``jax.devices("cpu")`` — sitecustomize itself forces "axon,cpu").
+    Appending cpu does NOT mask a dead accelerator: with an explicit
+    platform list, JAX raises if any NAMED backend fails to initialize
+    (verified against a bogus libtpu)."""
+    value = os.environ.get("JAX_PLATFORMS")
+    if value is None:
+        return
+    platforms = [p.strip() for p in value.split(",") if p.strip()]
+    if platforms and "cpu" not in platforms:
+        platforms.append("cpu")
+    jax.config.update("jax_platforms", ",".join(platforms))
 
 
 @dataclasses.dataclass

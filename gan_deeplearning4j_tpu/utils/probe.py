@@ -1,0 +1,54 @@
+"""Bounded device-link probe, shared by every driver-facing entry.
+
+The PJRT link to the chip is a shared tunnel that can wedge outright
+(``jax.devices()`` then blocks indefinitely), so a process that must not
+hang probes from a FRESH child interpreter under a timeout: the child
+wedges and is killed, never the caller.  Used by the repo-root ``bench.py``
+shim and ``benchmarks/acceptance.py`` — one implementation, no drift.
+"""
+
+from __future__ import annotations
+
+import json
+import subprocess
+import sys
+
+# One small dispatch + readback; prints a single JSON line with the chosen
+# platform and the measured round trip.  Honors an explicit JAX_PLATFORMS
+# via the shared entry-point helper (the sitecustomize clobber makes the
+# raw env var a no-op — runtime/backend.py NOTE).
+_PROBE_CODE = """
+import json, time
+import numpy as np, jax, jax.numpy as jnp
+from gan_deeplearning4j_tpu.runtime.backend import apply_env_platform
+apply_env_platform()
+f = jax.jit(lambda a: a @ a)
+x = jnp.ones((64, 64)); np.asarray(f(x))
+t0 = time.perf_counter()
+for _ in range(5): np.asarray(f(x))
+print(json.dumps({"platform": jax.default_backend(),
+                  "rt_ms": (time.perf_counter() - t0) * 200}))
+"""
+
+
+def probe_device(timeout_s: float, cwd: str | None = None):
+    """(platform, round_trip_ms) via a bounded subprocess, or raise
+    RuntimeError with a one-line reason.  ``cwd`` must make the package
+    importable in the child (the repo root, or anywhere once installed)."""
+    try:
+        out = subprocess.run([sys.executable, "-c", _PROBE_CODE],
+                             cwd=cwd, capture_output=True, text=True,
+                             timeout=timeout_s)
+    except subprocess.TimeoutExpired:
+        raise RuntimeError(
+            f"device link unresponsive (>{timeout_s:.0f}s for a 64x64 "
+            "dispatch+readback)") from None
+    if out.returncode != 0:
+        tail = " | ".join(out.stderr.strip().splitlines()[-2:])
+        raise RuntimeError(f"device probe failed: {tail[-400:]}")
+    try:
+        rec = json.loads(out.stdout.strip().splitlines()[-1])
+        return rec["platform"], float(rec["rt_ms"])
+    except (ValueError, KeyError, IndexError):
+        raise RuntimeError(
+            f"unparseable probe output: {out.stdout[-200:]!r}") from None

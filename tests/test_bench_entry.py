@@ -1,0 +1,78 @@
+"""The driver bench contract (VERDICT r2 next-step #1): ``python bench.py``
+prints ONE final JSON line and exits 0 regardless of device-link state.
+
+Two rounds of driver captures failed with raw tracebacks (BENCH_r01: stale
+step signature; BENCH_r02: wedged tunnel crashing ``jax.devices()``), so
+this module pins the hardened entry's behavior with:
+
+  * a guaranteed-dead backend (``JAX_PLATFORMS=tpu`` with no libtpu, plus
+    a bogus plugin dir) -> structured skip line, rc 0, cached last-good
+    payload attached;
+  * a healthy CPU backend -> the real benchmark JSON (cached-baseline
+    path, ``--skip-e2e`` keeps it fast).
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run_bench(extra_env, args=(), timeout=600):
+    env = {k: v for k, v in os.environ.items()}
+    # strip the suite's virtual-device flag: the child must see a normal
+    # host; also drop any inherited platform pin before applying the
+    # test's own
+    env["XLA_FLAGS"] = " ".join(
+        f for f in env.get("XLA_FLAGS", "").split()
+        if "xla_force_host_platform_device_count" not in f)
+    env.pop("JAX_PLATFORMS", None)
+    env.update(extra_env)
+    return subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py"), *args],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=timeout)
+
+
+def _last_json(out):
+    lines = out.stdout.strip().splitlines()
+    assert lines, f"no stdout; stderr tail: {out.stderr[-500:]}"
+    return json.loads(lines[-1])
+
+
+def test_dead_backend_emits_structured_skip():
+    """A backend that cannot initialize must yield rc 0 + a parseable
+    skip line carrying the cached last-good number — never a traceback."""
+    out = _run_bench({
+        # 'tpu' with no libtpu and a bogus plugin dir fails initialization
+        # quickly and deterministically on this CPU host
+        "JAX_PLATFORMS": "tpu",
+        "PJRT_DEVICE": "TPU",
+        "TPU_LIBRARY_PATH": "/nonexistent/libtpu.so",
+        "BENCH_PROBE_ATTEMPTS": "2",
+        "BENCH_PROBE_BACKOFF": "1",
+        "BENCH_PROBE_TIMEOUT": "60",
+    })
+    assert out.returncode == 0, (out.stdout[-500:], out.stderr[-500:])
+    rec = _last_json(out)
+    assert rec["skipped"] is True
+    assert rec["value"] is None
+    assert "reason" in rec and rec["reason"]
+    assert "attempt 2" in rec["reason"]  # the retry loop actually ran
+    # the committed last-good payload rides along, clearly labeled
+    assert rec["cached"]["metric"] == "dcgan_mnist_img_per_sec"
+    assert "NOT measured this round" in rec["cached_note"]
+
+
+def test_healthy_cpu_backend_emits_benchmark_json():
+    """With a live (CPU) backend the entry passes through the inner
+    benchmark's JSON: the cached batch-200 CPU baseline, no skip."""
+    out = _run_bench({"JAX_PLATFORMS": "cpu"}, args=("--skip-e2e",))
+    assert out.returncode == 0, (out.stdout[-500:], out.stderr[-800:])
+    rec = _last_json(out)
+    assert rec.get("skipped") is not True
+    assert rec["metric"] == "dcgan_mnist_img_per_sec"
+    assert rec["value"] > 0
+    assert rec["unit"] == "img/sec/chip"
+    assert "vs_baseline" in rec
