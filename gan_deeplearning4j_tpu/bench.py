@@ -287,8 +287,6 @@ def main(argv=None) -> None:
 
     from gan_deeplearning4j_tpu.utils import maybe_trace
 
-    backend.apply_env_platform()  # __main__-entry env honor (see its doc)
-
     default = jax.devices()[0]
     cpu = jax.devices("cpu")[0]
 
@@ -367,4 +365,9 @@ def main(argv=None) -> None:
 
 
 if __name__ == "__main__":
+    from gan_deeplearning4j_tpu.runtime import backend as _backend
+
+    # process entry ONLY — an in-process caller may already have forced a
+    # platform that the ambient env must not clobber
+    _backend.apply_env_platform()
     sys.exit(main())

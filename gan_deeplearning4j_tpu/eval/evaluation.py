@@ -8,9 +8,10 @@ stats block off the accumulated confusion matrix.  The reference's own
 notebook computes plain accuracy (gan.ipynb cell 7); this object is the
 framework-level equivalent a DL4J user expects for everything beyond it.
 
-Macro averages are taken over classes that APPEAR (in labels or
-predictions); a class with zero predicted positives contributes precision
-0 — DL4J's convention for reported columns.
+Macro averages follow DL4J's ``EvaluationAveraging.Macro``: classes whose
+denominator is zero (the metric is undefined there — e.g. zero predicted
+positives for precision) are EXCLUDED from the average, not counted as 0.
+F1 averages over classes with any tp/fp/fn at all (2tp+fp+fn > 0).
 """
 
 from __future__ import annotations
@@ -80,7 +81,7 @@ class Evaluation:
         per = self._per_class(tp, pred_pos)
         if cls is not None:
             return float(per[cls])
-        return self._macro(per)
+        return self._macro(per, defined=pred_pos > 0)
 
     def recall(self, cls: Optional[int] = None) -> float:
         tp = np.diag(self._confusion).astype(float)
@@ -88,7 +89,7 @@ class Evaluation:
         per = self._per_class(tp, actual_pos)
         if cls is not None:
             return float(per[cls])
-        return self._macro(per)
+        return self._macro(per, defined=actual_pos > 0)
 
     def f1(self, cls: Optional[int] = None) -> float:
         if cls is not None:
@@ -97,10 +98,16 @@ class Evaluation:
         per = np.array([self.f1(c) for c in range(self.num_classes)])
         return self._macro(per)
 
-    def _macro(self, per_class: np.ndarray) -> float:
-        """Average over classes that appear in labels or predictions."""
-        present = (self._confusion.sum(axis=0) + self._confusion.sum(axis=1)) > 0
-        return float(per_class[present].mean()) if present.any() else 0.0
+    def _macro(self, per_class: np.ndarray,
+               defined: Optional[np.ndarray] = None) -> float:
+        """DL4J Macro averaging: mean over classes where the metric is
+        DEFINED (nonzero denominator), skipping the rest entirely.  The
+        default mask (classes appearing in labels or predictions at all)
+        is F1's definedness condition, 2tp+fp+fn > 0."""
+        if defined is None:
+            defined = (self._confusion.sum(axis=0)
+                       + self._confusion.sum(axis=1)) > 0
+        return float(per_class[defined].mean()) if defined.any() else 0.0
 
     # -- report --------------------------------------------------------------
 

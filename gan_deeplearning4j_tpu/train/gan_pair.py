@@ -292,6 +292,16 @@ class GANPair:
             ))
         invariants = (table_x, table_cond, y_real_v, y_fake_v, y_gen_v,
                       key0)
+        if self.mesh is not None:
+            # commit the invariants (dataset table included) to an explicit
+            # replicated placement ONCE — otherwise every chunk dispatch
+            # re-broadcasts the whole table host->devices, the exact
+            # per-call transfer the resident path exists to avoid (same
+            # rule as gan_trainer.train's device_put of the dataset)
+            rep = jax.sharding.NamedSharding(self.mesh, P())
+            invariants = tuple(
+                None if x is None else jax.device_put(x, rep)
+                for x in invariants)
 
         def step_fn(state):
             return jit_multi(state, *invariants)

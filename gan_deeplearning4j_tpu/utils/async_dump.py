@@ -75,15 +75,26 @@ class AsyncArtifactWriter:
 
     # -- API -----------------------------------------------------------------
 
-    def submit(self, job: Callable[[], None]) -> None:
-        """Enqueue a write job (blocking when ``max_pending`` jobs wait)."""
+    def submit(self, job: Callable[[], None],
+               timeout: float = 600.0) -> None:
+        """Enqueue a write job (blocking when ``max_pending`` jobs wait).
+
+        Bounded: a worker wedged on a stalled disk/readback surfaces as
+        the same 'artifact writer stalled' RuntimeError that flush()/
+        close() raise, instead of deadlocking the training thread at the
+        next submit."""
         self._reraise()
         if self._synchronous or self._closed:
             # after close() the worker is gone — run inline rather than
             # letting the job vanish into a dead queue
             job()
             return
-        self._q.put(job)
+        try:
+            self._q.put(job, timeout=timeout)
+        except queue.Full:
+            raise RuntimeError(
+                f"artifact writer stalled: queue full ({self._q.maxsize} "
+                f"pending) after {timeout:.0f}s") from None
 
     def _drain(self, timeout: float) -> None:
         """queue.join with a deadline: a hung write job (stalled disk,

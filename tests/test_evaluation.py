@@ -62,6 +62,20 @@ def test_evaluation_absent_class_excluded_from_macro():
     assert ev.precision() == pytest.approx(want)
 
 
+def test_evaluation_zero_denominator_class_excluded_from_macro():
+    """DL4J Macro averaging: a class PRESENT in labels but never predicted
+    has undefined precision and is excluded from the macro (sklearn's
+    zero_division=0 would count it as 0 — a different convention)."""
+    ev = Evaluation(2)
+    ev.eval(np.array([0, 0, 1]), np.array([0, 0, 0]))
+    # precision: class 0 = 2/3; class 1 undefined (0 predictions) -> skip
+    assert ev.precision() == pytest.approx(2 / 3)
+    # recall: both classes appear in labels -> (1.0 + 0.0) / 2
+    assert ev.recall() == pytest.approx(0.5)
+    # f1: class 1 has fn > 0 so it IS defined (= 0); macro = (0.8 + 0) / 2
+    assert ev.f1() == pytest.approx(0.4)
+
+
 def test_sgd_nesterovs_adagrad_rules():
     import jax.numpy as jnp
 
