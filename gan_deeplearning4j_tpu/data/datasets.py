@@ -53,8 +53,37 @@ def _digit_bitmap(d: int) -> np.ndarray:
     return bits.reshape(7, 5).astype(np.float32)
 
 
+# Symmetric confusable-glyph pairing for the calibrated difficulty tier:
+# morphing happens WITHIN these pairs, and symmetry is what creates a
+# genuine Bayes floor (a blend of 4-and-9 at mix 0.5 is equally likely to
+# have come from either class; an asymmetric pairing would leak the source
+# class through the pair identity and the ceiling would silently return
+# to 1.0).
+_CONFUSABLE = {0: 8, 8: 0, 1: 7, 7: 1, 3: 5, 5: 3, 4: 9, 9: 4, 2: 6, 6: 2}
+
+# difficulty presets: affine pose ranges + the morph mixture
+_MNIST_DIFFICULTY = {
+    # v1 (rounds 1-2): clean glyphs, mild pose — classifier saturates at
+    # 1.000 by step 2000 (RESULTS r2 §1), so the headline metric could
+    # not move.  Kept for comparison runs.
+    "v1": dict(theta=0.26, smin=2.4, smax=3.2, shear=0.15, trans=2.0,
+               p_tail=0.0, morph=False),
+    # calibrated (VERDICT r2 next-step #2): harder pose + confusable-pair
+    # morphing with mix alpha ~ 95% U(0,.3) + 5% U(.3,.7).  P(alpha>.5) =
+    # 0.025 puts the Bayes accuracy ceiling at ~0.975 BY CONSTRUCTION
+    # (those samples are past the class midpoint, labeled by source);
+    # raw-pixel linear probe measures 0.930 (real MNIST: ~0.92), so a
+    # strong classifier lands in a discriminative 0.95-0.975 band that
+    # CAN regress — honestly comparable in kind to the reference's 97.07%
+    # (gan.ipynb raw line 373).
+    "calibrated": dict(theta=0.35, smin=2.2, smax=3.3, shear=0.22,
+                       trans=2.5, p_tail=0.05, morph=True),
+}
+
+
 def synthetic_mnist(
-    n: int, seed: int = SEED, noise: float = 0.08, chunk: int = 4096
+    n: int, seed: int = SEED, noise: float = 0.08, chunk: int = 4096,
+    difficulty: str = "calibrated",
 ) -> Tuple[np.ndarray, np.ndarray]:
     """Deterministic MNIST-like digits: bitmap glyphs pushed through a
     random affine (rotation, anisotropic scale, shear, translation) with
@@ -69,11 +98,24 @@ def synthetic_mnist(
     un-augmented v1 of this generator.  Handwriting-like pose variation
     keeps D challenged the way real MNIST does.
 
+    ``difficulty`` picks the ``_MNIST_DIFFICULTY`` preset: "calibrated"
+    (default) adds confusable-pair glyph morphing whose mixture tail sets
+    a ~0.975 Bayes accuracy ceiling, de-saturating the headline metric;
+    "v1" is the rounds-1/2 separable tier.
+
     Returns (features[n,784] float32, labels[n] int64).
     """
+    cfg = _MNIST_DIFFICULTY[difficulty]
     rng = np.random.RandomState(seed)
     labels = rng.randint(0, 10, size=n)
     glyphs = np.stack([_digit_bitmap(d) for d in range(10)])  # [10, 7, 5]
+    partners = np.array([_CONFUSABLE[d] for d in range(10)])
+    if cfg["morph"]:
+        tail = rng.rand(n) < cfg["p_tail"]
+        alpha = np.where(tail, rng.uniform(0.3, 0.7, n),
+                         rng.uniform(0.0, 0.3, n)).astype(np.float32)
+    else:
+        alpha = np.zeros(n, dtype=np.float32)
     out = np.empty((n, 784), dtype=np.float32)
     # output pixel grid, centered
     yy, xx = np.meshgrid(np.arange(28, dtype=np.float32),
@@ -82,13 +124,14 @@ def synthetic_mnist(
         hi = min(lo + chunk, n)
         m = hi - lo
         lab = labels[lo:hi]
+        al = alpha[lo:hi, None, None]
         # per-sample affine params (inverse map: output px -> glyph coords)
-        theta = rng.uniform(-0.26, 0.26, m).astype(np.float32)      # ~±15°
-        sx = rng.uniform(2.4, 3.2, m).astype(np.float32)            # x zoom
-        sy = rng.uniform(2.4, 3.2, m).astype(np.float32)            # y zoom
-        shear = rng.uniform(-0.15, 0.15, m).astype(np.float32)
-        tx = rng.uniform(-2.0, 2.0, m).astype(np.float32)
-        ty = rng.uniform(-2.0, 2.0, m).astype(np.float32)
+        theta = rng.uniform(-cfg["theta"], cfg["theta"], m).astype(np.float32)
+        sx = rng.uniform(cfg["smin"], cfg["smax"], m).astype(np.float32)
+        sy = rng.uniform(cfg["smin"], cfg["smax"], m).astype(np.float32)
+        shear = rng.uniform(-cfg["shear"], cfg["shear"], m).astype(np.float32)
+        tx = rng.uniform(-cfg["trans"], cfg["trans"], m).astype(np.float32)
+        ty = rng.uniform(-cfg["trans"], cfg["trans"], m).astype(np.float32)
         cos, sin = np.cos(theta), np.sin(theta)
         # centered output coords [m, 28, 28]
         xo = xx[None] - 13.5 - tx[:, None, None]
@@ -103,7 +146,10 @@ def synthetic_mnist(
         x0 = np.floor(gx).astype(np.int32)
         y0 = np.floor(gy).astype(np.int32)
         fx, fy = gx - x0, gy - y0
-        g = glyphs[lab]                     # [m, 7, 5]
+        # the morph blend commutes with the (linear) bilinear sampling, so
+        # the rendered image is exactly (1-a)*render(c) + a*render(partner)
+        # at the SAME pose — a true pixel-space class interpolation
+        g = (1.0 - al) * glyphs[lab] + al * glyphs[partners[lab]]
         gpad = np.pad(g, ((0, 0), (1, 1), (1, 1)))  # zero border
         x0c = np.clip(x0 + 1, 0, 5 + 1)
         y0c = np.clip(y0 + 1, 0, 7 + 1)
@@ -177,7 +223,8 @@ N_TYPES = 3         # tensorDimTwoSize (:71)
 
 
 def synthetic_transactions(
-    n_policies: int = N_POLICIES, seed: int = SEED
+    n_policies: int = N_POLICIES, seed: int = SEED,
+    difficulty: str = "calibrated",
 ) -> Tuple[np.ndarray, np.ndarray]:
     """Label-dependent transaction lattices: (transactions[n,4,3], risk[n]).
 
@@ -186,14 +233,33 @@ def synthetic_transactions(
     High-risk policies (P=0.3) have escalating claim-type activity across
     periods; low-risk have flat premium-type activity — a structure a GAN
     discriminator's features can separate, like the real data's.
+
+    ``difficulty="calibrated"`` (default; VERDICT r2 next-step #2) makes
+    the risk signal heterogeneous so AUROC cannot saturate: each risky
+    policy's escalation is scaled by a Gamma(2) random effect (some risky
+    policies look benign) and 8% of benign policies get claim bursts
+    (look risky).  Raw-feature logistic probe: AUROC 0.907 +/- 0.011
+    across seeds — a discriminative counterpart to the reference's 91.63%
+    (gan.ipynb raw line 374).  "v1" is the rounds-1/2 cleanly separable
+    tier (AUROC pinned at 1.000).
     """
     rng = np.random.RandomState(seed)
     risk = (rng.rand(n_policies) < 0.3).astype(np.int64)
     base = np.array([[6.0, 3.0, 0.5]] * N_PERIODS)  # premium, service, claim
     lam = np.tile(base, (n_policies, 1, 1))
     escalate = np.array([0.5, 1.0, 2.0, 4.0]).reshape(1, N_PERIODS)
-    lam[:, :, 2] += risk.reshape(-1, 1) * escalate * 2.0
-    lam[:, :, 0] -= risk.reshape(-1, 1) * escalate * 0.8
+    if difficulty == "calibrated":
+        gamma = rng.gamma(2.0, 0.5, n_policies)     # mean-1 random effect
+        eff = risk * gamma
+        burst = (risk == 0) & (rng.rand(n_policies) < 0.08)
+        eff = eff + burst * rng.uniform(0.4, 1.0, n_policies)
+        lam[:, :, 2] += eff.reshape(-1, 1) * escalate * 1.5
+        lam[:, :, 0] -= eff.reshape(-1, 1) * escalate * 0.5
+    elif difficulty == "v1":
+        lam[:, :, 2] += risk.reshape(-1, 1) * escalate * 2.0
+        lam[:, :, 0] -= risk.reshape(-1, 1) * escalate * 0.8
+    else:
+        raise KeyError(difficulty)
     lam = np.clip(lam, 0.1, None)
     trans = rng.poisson(lam).astype(np.float64)
     return trans, risk
@@ -274,7 +340,13 @@ def synthetic_cifar10(
     labels[n] int64) — tanh-range, matching the cGAN generator head.
     """
     rng = np.random.RandomState(seed)
-    gray, labels = synthetic_mnist(n, seed=seed + 1, noise=0.04)
+    # v1 difficulty: the cGAN's conditioning wants crisp class identity —
+    # the calibrated tier's cross-class morphs would put mixed-label
+    # samples into a CONDITIONAL model's training set, which is a data
+    # bug, not a difficulty calibration (no headline metric saturates
+    # on this family)
+    gray, labels = synthetic_mnist(n, seed=seed + 1, noise=0.04,
+                                   difficulty="v1")
     gray = gray.reshape(n, 28, 28)
     # class hues spread around the wheel; shape colored, background tinted
     hues = np.linspace(0.0, 1.0, 10, endpoint=False)

@@ -10,8 +10,12 @@ choice (MLP graphs, ~15s on host CPU for 600 iterations); the CV bar at
 full scale lives in the accelerator tier (test_tpu_smoke.py) and the
 headline numbers in RESULTS.md.
 
-Calibration (host CPU, seed 666): AUROC 0.19 @ 150 steps, 0.48 @ 300,
-0.81 @ 450, 0.966 @ 600 — the 0.9 bar has ~7-point margin at 600.
+Calibration on the CALIBRATED surrogate tier (host CPU, seed 666 — the
+heterogeneous-risk data whose raw-feature logistic ceiling is ~0.91,
+data/datasets.py): AUROC 0.836 @ 600 steps, 0.906 @ 900, 0.921 @ 1500 —
+the 1500-iter value matches the reference's 91.63% in kind AND magnitude.
+The CI bar is 0.85 @ 900 (~5-point margin) so a dynamics regression is
+visible without paying for the full 5k acceptance run.
 """
 
 import os
@@ -25,15 +29,16 @@ def test_insurance_protocol_clears_auroc_bar(tmp_path):
 
     d = str(tmp_path)
     config = insurance_main.default_config(
-        num_iterations=600, batch_size=50, res_path=d,
-        print_every=10 ** 9, save_every=600, metrics=False, n_devices=1,
+        num_iterations=900, batch_size=50, res_path=d,
+        print_every=10 ** 9, save_every=900, metrics=False, n_devices=1,
     )
     trainer = GANTrainer(insurance_main.InsuranceWorkload(), config)
     trainer.train(log=lambda s: None)
     auc = insurance_auroc(
-        os.path.join(d, "insurance_test_predictions_600.csv"),
+        os.path.join(d, "insurance_test_predictions_900.csv"),
         os.path.join(d, "insurance_test.csv"),
     )
-    assert auc >= 0.90, (
-        f"protocol failed the learning bar: AUROC {auc:.4f} < 0.90 after "
-        "600 iterations (calibrated headroom: 0.966 at seed 666)")
+    assert auc >= 0.85, (
+        f"protocol failed the learning bar: AUROC {auc:.4f} < 0.85 after "
+        "900 iterations (calibrated headroom: 0.906 at seed 666; ceiling "
+        "~0.92 — the de-saturated tier CAN regress, by design)")

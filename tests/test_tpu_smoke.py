@@ -21,6 +21,10 @@ import textwrap
 
 import pytest
 
+# accelerator tier: needs (or probes for) the real chip — run explicitly
+# or via the full suite, not the fast `-m "not slow"` lane
+pytestmark = pytest.mark.slow
+
 _PROBE = textwrap.dedent("""
     import json, sys
     import jax
@@ -125,8 +129,10 @@ _QUALITY = textwrap.dedent("""
 def test_accelerator_cv_quality_bar():
     """On-chip CV learning bar (the 97.07%-style evidence at test scale,
     gan.ipynb raw line 373): 3,000 protocol iterations at the reference's
-    batch 200 must put classifier accuracy over 0.95 on the synthetic
-    surrogate (headline 10k run: 1.000 from step 2000 — RESULTS.md)."""
+    batch 200 must put classifier accuracy over 0.88 on the CALIBRATED
+    surrogate (Bayes ceiling ~0.975 by construction — data/datasets.py;
+    the v1 tier saturated at 1.000 from step 2000, RESULTS r2 §1, which
+    made this bar unable to catch regressions)."""
     platform = _default_platform()
     if platform == "cpu":
         pytest.skip("accelerator quality bar; CPU bar is tests/test_quality.py")
@@ -134,4 +140,4 @@ def test_accelerator_cv_quality_bar():
     run = _run_clean(_QUALITY)
     assert run.returncode == 0, run.stderr[-2000:]
     acc = json.loads(run.stdout.strip().splitlines()[-1])["acc"]
-    assert acc >= 0.95, f"accuracy {acc:.4f} < 0.95 after 3000 iterations"
+    assert acc >= 0.88, f"accuracy {acc:.4f} < 0.88 after 3000 iterations"
