@@ -104,18 +104,18 @@ def compute_fid(classifier, real: np.ndarray, generated: np.ndarray,
     return fid_from_features(f_r, f_g)
 
 
-def generator_fid(gen, classifier, real: np.ndarray, n_samples: int,
-                  z_size: int = 2, seed: int = 666,
-                  layer: str = DEFAULT_FEATURE_LAYER,
-                  batch_size: int = 500,
-                  rng: Optional[np.random.RandomState] = None) -> float:
-    """End-to-end generator FID: synthesize ``n_samples`` images from
-    z ~ U[-1,1]^z (the training latent law, dl4jGANComputerVision.java:397)
-    and score them against ``real``."""
+def synthesize_pixels(gen, n_samples: int, num_features: int,
+                      z_size: int = 2, seed: int = 666,
+                      batch_size: int = 500,
+                      rng: Optional[np.random.RandomState] = None
+                      ) -> np.ndarray:
+    """``n_samples`` generator outputs from z ~ U[-1,1]^z (the training
+    latent law, dl4jGANComputerVision.java:397), flattened to
+    [n, num_features] — synthesized once, scoreable in several feature
+    spaces."""
     import jax.numpy as jnp
 
     rng = rng or np.random.RandomState(seed)
-    num_features = int(np.prod(real.shape[1:]))
     pending = []
     for i in range(0, n_samples, batch_size):
         k = min(batch_size, n_samples - i)
@@ -125,8 +125,19 @@ def generator_fid(gen, classifier, real: np.ndarray, n_samples: int,
     from gan_deeplearning4j_tpu.utils import overlap_device_get
 
     pending = overlap_device_get(pending)
-    generated = np.concatenate(
+    return np.concatenate(
         [np.asarray(o).reshape(batch_size, num_features)[:k]
          for o, k in pending])
+
+
+def generator_fid(gen, classifier, real: np.ndarray, n_samples: int,
+                  z_size: int = 2, seed: int = 666,
+                  layer: str = DEFAULT_FEATURE_LAYER,
+                  batch_size: int = 500,
+                  rng: Optional[np.random.RandomState] = None) -> float:
+    """End-to-end generator FID: synthesize then score against ``real``."""
+    num_features = int(np.prod(real.shape[1:]))
+    generated = synthesize_pixels(gen, n_samples, num_features, z_size,
+                                  seed, batch_size, rng)
     return compute_fid(classifier, real.reshape(-1, num_features), generated,
                        layer, batch_size)

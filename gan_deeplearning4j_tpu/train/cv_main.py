@@ -148,6 +148,8 @@ def evaluate(trainer: GANTrainer, fid_samples: int = 10000) -> Dict[str, float]:
     digit-grid PNG (gan.ipynb cell 7's visual artifact)."""
     import os
 
+    import numpy as np
+
     from gan_deeplearning4j_tpu.data import datasets
     from gan_deeplearning4j_tpu.eval import fid as fid_lib
     from gan_deeplearning4j_tpu.eval import metrics as metrics_lib
@@ -176,36 +178,68 @@ def evaluate(trainer: GANTrainer, fid_samples: int = 10000) -> Dict[str, float]:
             os.path.join(c.res_path, "DCGAN_Generated_Images.png"),
             grid_csv, (28, 28))
     if fid_samples and os.path.exists(test_csv):
+        from gan_deeplearning4j_tpu.eval import fid_extractor as fx
+
         real, _ = datasets.load_split(test_csv, c.label_index)
-        out["fid"] = fid_lib.generator_fid(
-            trainer.gen, trainer.classifier,
-            real[:fid_samples].astype("float32"), n_samples=fid_samples,
-            z_size=c.z_size)
+        real = real[:fid_samples].astype("float32")
+        try:
+            frozen = fx.load_extractor()
+        except FileNotFoundError:
+            frozen = None  # asset not built; legacy metric still reported
+
+        # feature spaces: the run's own classifier (legacy, run-dependent)
+        # and the FROZEN extractor — comparable across runs/rounds, the
+        # headline (fid_extractor.py; VERDICT r2 next-step #3).  Real-set
+        # moments are computed ONCE per space and shared across the base
+        # and EMA scorings.
+        spaces = [("", trainer.classifier, fid_lib.DEFAULT_FEATURE_LAYER)]
+        if frozen is not None:
+            spaces.append(("_frozen", frozen, fx.FEATURE_LAYER))
+        real_moments = {}
+        for tag, graph, layer in spaces:
+            f = fid_lib.extract_features(graph, real, layer)
+            real_moments[tag] = (f.mean(axis=0), np.cov(f, rowvar=False))
+
+        def score(suffix: str) -> None:
+            # one synthesis per weight set, scored in every space
+            generated = fid_lib.synthesize_pixels(
+                trainer.gen, fid_samples, real.shape[1], z_size=c.z_size)
+            for tag, graph, layer in spaces:
+                f = fid_lib.extract_features(graph, generated, layer)
+                mu_r, cov_r = real_moments[tag]
+                out[f"fid{tag}{suffix}"] = fid_lib.frechet_distance(
+                    mu_r, cov_r, f.mean(axis=0), np.cov(f, rowvar=False))
+
+        score("")
         ema = getattr(trainer.gen, "ema_params", None)
         if ema is not None:
             # score the EMA weights too (trajectory-averaged generator)
             orig = trainer.gen.params
             trainer.gen.params = ema
             try:
-                out["fid_ema"] = fid_lib.generator_fid(
-                    trainer.gen, trainer.classifier,
-                    real[:fid_samples].astype("float32"),
-                    n_samples=fid_samples, z_size=c.z_size)
+                score("_ema")
             finally:
                 trainer.gen.params = orig
+        # one primary headline: frozen space, EMA weights when available
+        for k in ("fid_frozen_ema", "fid_frozen", "fid_ema", "fid"):
+            if k in out:
+                out["fid_primary"] = out[k]
+                out["fid_primary_source"] = k
+                break
     return out
 
 
 def cli(argv=None) -> None:
-    """Console-script entry point: swallow main()'s result dict so the
-    setuptools wrapper's sys.exit() sees None (exit status 0)."""
+    """Console-script / python -m entry: swallow main()'s result dict
+    so the setuptools wrapper's sys.exit() sees None (exit status 0),
+    and honor JAX_PLATFORMS — a fresh process by definition, so this
+    cannot clobber an in-process override (unlike main(), which tests
+    import and call under a conftest-forced CPU platform)."""
+    from gan_deeplearning4j_tpu.runtime import backend as _backend
+
+    _backend.apply_env_platform()
     main(argv)
 
 
 if __name__ == "__main__":
-    from gan_deeplearning4j_tpu.runtime import backend as _backend
-
-    # process entry ONLY: tests import main() in-process under a
-    # conftest-forced CPU platform that this must not clobber
-    _backend.apply_env_platform()
-    main()
+    cli()
