@@ -25,6 +25,21 @@ Both collapse here into jitted SPMD programs over a ``Mesh``:
     jobs; ``fit_batches`` runs k minibatches per replica averaging every
     ``averaging_frequency``, for multi-batch jobs.
 
+  - ``mode="async_gradient_sharing"`` (fidelity): the DORMANT lane on the
+    reference's classpath — ``dl4j-spark-parameterserver`` + Aeron UDP
+    (Java/pom.xml:114-118; SURVEY.md §2c "async gradient sharing").  There,
+    workers push gradient updates computed against STALE parameters and a
+    parameter server applies them as they arrive.  The TPU-native
+    formulation keeps the defining property (updates computed at stale
+    params, applied sequentially) as one deterministic SPMD program:
+    every worker grads against its own last-pulled copy in parallel, the
+    pushes land on the server state in replica order (Hogwild-style
+    within-round interleaving: worker w's gradient predates workers
+    <w's pushes), and workers re-pull the server params every
+    ``staleness`` rounds — staleness-k bounded asynchrony, reproducible
+    run to run (an actual Aeron race would not be).  With one replica and
+    staleness 1 this degenerates to exact sequential SGD (tested).
+
 No host serialization ever happens: arrays stay device-resident and the
 "averaging reduce" is an XLA collective riding ICI, not a Spark shuffle.
 """
@@ -62,19 +77,28 @@ class DataParallelGraph:
         axis: str = "data",
         mode: str = "gradient_sync",
         averaging_frequency: int = 1,
+        staleness: int = 1,
     ):
-        if mode not in ("gradient_sync", "param_averaging"):
+        if mode not in ("gradient_sync", "param_averaging",
+                        "async_gradient_sharing"):
             raise ValueError(f"unknown mode {mode!r}")
         self.graph = graph
         self.mesh = mesh if mesh is not None else mesh_lib.data_mesh()
         self.axis = axis
         self.mode = mode
         self.averaging_frequency = averaging_frequency
+        if staleness < 1:
+            raise ValueError(f"staleness must be >= 1, got {staleness}")
+        self.staleness = staleness
         self.num_replicas = self.mesh.shape[axis]
         self._fit_count = 0
         self._step_rng = prng.stream(prng.root_key(graph.seed), "dp-step")
         if mode == "gradient_sync":
             self._jit_step = self._build_gradient_sync_step()
+        elif mode == "async_gradient_sharing":
+            self._jit_step = self._build_async_step()
+            self._round = 0
+            self._local_params = None  # seeded from the server at first fit
         else:
             self._jit_step = self._build_param_avg_step(num_batches=1)
             self._multi_cache = {}
@@ -160,6 +184,82 @@ class DataParallelGraph:
             check_vma=False,
         ))
 
+    def _build_async_step(self):
+        """Staleness-k asynchronous gradient sharing as ONE SPMD round.
+
+        Per round: every worker computes a gradient against its own
+        last-pulled (stale) parameter copy on its batch shard — in
+        parallel — then the pushes are applied to the server params and
+        updater state SEQUENTIALLY in replica order (each push was
+        computed without knowledge of the pushes landing before it, the
+        async-PS property).  The replica-order serialization stands in
+        for Aeron's arrival order: deterministic, so convergence under
+        staleness is testable.  BN running-stat updates are pmean-ed onto
+        the server (a stale-BN per-worker write order would be
+        meaningless).  Grads ride an ``all_gather`` over the mesh axis —
+        ICI, not UDP."""
+        graph, axis = self.graph, self.axis
+        n = self.num_replicas
+
+        def round_fn(server_params, opt_state, local_params, rng,
+                     inputs, labels):
+            mine = jax.tree.map(lambda x: x[0], local_params)  # [1,...] shard
+            rng = prng.fold_in_index(rng, lax.axis_index(axis))
+
+            def loss_fn(p):
+                values, state_updates = graph._forward(
+                    p, inputs, True, rng, axis)
+                outputs = {k: values[k] for k in graph.output_names}
+                return graph._loss(outputs, labels), state_updates
+
+            (loss, state_updates), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(mine)
+            pushes = jax.tree.map(lambda g: lax.all_gather(g, axis), grads)
+            params = server_params
+            for w in range(n):  # static unroll: n pushes land in order
+                g_w = jax.tree.map(lambda g: g[w], pushes)
+                params, opt_state = graph.updater.apply(
+                    params, g_w, opt_state)
+            state_updates = lax.pmean(state_updates, axis)
+            for lname, upd in state_updates.items():
+                merged = dict(params[lname])
+                merged.update(upd)
+                params[lname] = merged
+            return params, opt_state, lax.pmean(loss, axis)
+
+        return jax.jit(shard_map(
+            round_fn,
+            mesh=self.mesh,
+            in_specs=(P(), P(), P(self.axis), P(), P(self.axis),
+                      P(self.axis)),
+            out_specs=(P(), P(), P()),
+            check_vma=False,
+        ))
+
+    def _refresh_locals(self) -> None:
+        """The workers' pull: the server params as an [n, ...]-stacked
+        pytree, one stale copy per replica, sharded over the mesh axis.
+
+        Built shard-by-shard (every shard of the leading axis is the SAME
+        single copy) rather than via ``jnp.stack([x] * n)`` + reshard,
+        which would materialize an n-fold replicated intermediate on every
+        device before resharding — a transient n-times parameter-memory
+        spike on each pull."""
+        from jax.sharding import NamedSharding
+
+        import numpy as np
+
+        n = self.num_replicas
+        mesh = self.mesh
+
+        def stack_sharded(x):
+            host = np.asarray(x)  # one host copy, reused for every shard
+            return jax.make_array_from_callback(
+                (n, *host.shape), NamedSharding(mesh, P(self.axis)),
+                lambda idx: host[None])
+
+        self._local_params = jax.tree.map(stack_sharded, self.graph.params)
+
     # -- public API ----------------------------------------------------------
 
     @property
@@ -189,12 +289,25 @@ class DataParallelGraph:
         sh = mesh_lib.batch_sharding(self.mesh, self.axis)
         inputs = {k: jax.device_put(jnp.asarray(v), sh) for k, v in inputs.items()}
         label_map = {k: jax.device_put(jnp.asarray(v), sh) for k, v in label_map.items()}
-        new_params, new_opt, loss = self._jit_step(
-            self.graph.params, self.graph.opt_state, self._next_rng(),
-            inputs, label_map,
-        )
-        self.graph.params = new_params
-        self.graph.opt_state = new_opt
+        if self.mode == "async_gradient_sharing":
+            if self._local_params is None:
+                self._refresh_locals()
+            new_params, new_opt, loss = self._jit_step(
+                self.graph.params, self.graph.opt_state, self._local_params,
+                self._next_rng(), inputs, label_map,
+            )
+            self.graph.params = new_params
+            self.graph.opt_state = new_opt
+            self._round += 1
+            if self._round % self.staleness == 0:
+                self._refresh_locals()  # the workers' periodic pull
+        else:
+            new_params, new_opt, loss = self._jit_step(
+                self.graph.params, self.graph.opt_state, self._next_rng(),
+                inputs, label_map,
+            )
+            self.graph.params = new_params
+            self.graph.opt_state = new_opt
         self.graph.score = loss
         return loss
 
