@@ -274,6 +274,7 @@ def test_image_record_reader_unlabelled(tmp_path):
     assert x2.shape == (4, 300) and y2 is None and c2 == []
 
 
+@pytest.mark.slow
 def test_roadmap_trains_from_image_folder(tmp_path):
     """The DataVec-style image pipeline feeds the roadmap trainer
     end-to-end (real-data path, --data-dir)."""
@@ -289,6 +290,7 @@ def test_roadmap_trains_from_image_folder(tmp_path):
     assert np.isfinite(out["d_loss"])
 
 
+@pytest.mark.slow
 def test_roadmap_image_folder_nonten_classes(tmp_path):
     """A --data-dir tree with a class count other than 10 resizes the
     conditional model's label input to match."""

@@ -10,6 +10,7 @@ sync-BN and host-drawn z make this exact, not approximate.
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from gan_deeplearning4j_tpu.models import mlpgan_insurance as M
 from gan_deeplearning4j_tpu.parallel import data_mesh
@@ -46,6 +47,7 @@ def _run(mesh, steps=3):
     return state, losses
 
 
+@pytest.mark.slow
 def test_fused_multi_device_parity(cpu_devices):
     state1, losses1 = _run(mesh=None)
     state4, losses4 = _run(mesh=data_mesh(4))
@@ -134,6 +136,7 @@ def test_multistep_requires_resident_data():
             z_size=2, num_features=12, steps_per_call=4)
 
 
+@pytest.mark.slow
 def test_ema_generator_tracks_trajectory(tmp_path):
     """With ema_decay>0 the fused state carries an EMA of the generator
     weights: after N steps it lies strictly between the initial and final
@@ -175,6 +178,7 @@ def test_ema_generator_tracks_trajectory(tmp_path):
         rtol=1e-6, atol=1e-7)
 
 
+@pytest.mark.slow
 def test_ema_survives_checkpoint_resume(tmp_path):
     """The generator EMA is checkpointed and restored: a resumed run's
     final EMA equals the uninterrupted run's (the trajectory average is

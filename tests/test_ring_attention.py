@@ -26,7 +26,8 @@ def _qkv(b=2, h=3, t=32, d=8, seed=0):
 
 
 @pytest.mark.parametrize("causal", [False, True])
-@pytest.mark.parametrize("ring", [2, 4, 8])
+@pytest.mark.parametrize(
+    "ring", [2, 4, pytest.param(8, marks=pytest.mark.slow)])
 def test_ring_attention_matches_vanilla(cpu_devices, causal, ring):
     mesh = make_mesh({"seq": ring})
     q, k, v = _qkv(t=32)
@@ -43,6 +44,7 @@ def test_ring_attention_rejects_ragged_seq(cpu_devices):
         ring_attention(q, k, v, mesh)
 
 
+@pytest.mark.slow
 def test_ring_attention_long_context_memory_shape(cpu_devices):
     """The point of the ring: per-device score blocks are (T/R)^2, so a
     longer sequence over a bigger ring still runs. Just exercises T=256
@@ -100,6 +102,7 @@ class TestUlysses:
         with pytest.raises(ValueError, match="head count"):
             ulysses_attention(q, q, q, mesh)
 
+    @pytest.mark.slow
     def test_matches_ring(self, cpu_devices):
         """The two SP idioms agree with each other, not just with the
         reference — ring and all-to-all are interchangeable backends."""

@@ -14,6 +14,7 @@ from gan_deeplearning4j_tpu.parallel import data_mesh
 from gan_deeplearning4j_tpu.train.gan_pair import GANPair
 
 
+@pytest.mark.slow
 def test_cgan_shapes_and_step():
     cfg = cgan_cifar10.CGANConfig(base_filters=8, z_size=16)
     gen = cgan_cifar10.build_generator(cfg)
@@ -83,6 +84,7 @@ def test_wgan_gp_training_dynamics():
     assert out.shape == (B, 1)
 
 
+@pytest.mark.slow
 def test_celeba_dcgan_shapes_and_dp_step(cpu_devices):
     """64x64 DCGAN 'multi-replica': a D/G round over a 4-device mesh."""
     cfg = dcgan_celeba.CelebAConfig(base_filters=8, z_size=16)
@@ -101,6 +103,7 @@ def test_celeba_dcgan_shapes_and_dp_step(cpu_devices):
     assert np.isfinite(float(d)) and np.isfinite(float(g))
 
 
+@pytest.mark.slow
 def test_gan_pair_dp_matches_single_device(cpu_devices):
     """GANPair's pmean reduce: DP-4 == single-device, same seeds."""
     cfg = dcgan_celeba.CelebAConfig(base_filters=4, z_size=8)
@@ -124,6 +127,7 @@ def test_gan_pair_dp_matches_single_device(cpu_devices):
                 rtol=1e-4, atol=1e-5, err_msg=f"{layer}/{name}")
 
 
+@pytest.mark.slow
 def test_roadmap_main_end_to_end(tmp_path):
     """The roadmap CLI trains each family for a few iterations and dumps
     the sample grid + model zips (reference artifact style)."""
@@ -142,6 +146,7 @@ def test_roadmap_main_end_to_end(tmp_path):
         assert os.path.exists(os.path.join(d, f)), f
 
 
+@pytest.mark.slow
 def test_multistep_mesh_matches_single_device():
     """GANPair.make_multistep under a 4-device mesh (one shard_map SPMD
     scan, global draws sliced per shard, pmean'd grads + sync-BN) ends at
@@ -190,6 +195,7 @@ def test_multistep_mesh_matches_single_device():
                     rtol=1e-2, atol=1e-3, err_msg=f"{net}/{layer}/{name}")
 
 
+@pytest.mark.slow
 def test_multistep_mesh_matches_single_device_wgan_gp():
     """Same parity for WGAN-GP: the gradient penalty's interpolation
     alphas are drawn as ONE global stream and sliced per shard, so the

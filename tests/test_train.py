@@ -16,6 +16,7 @@ from gan_deeplearning4j_tpu.eval import (
 )
 
 
+@pytest.mark.slow
 def test_insurance_end_to_end(tmp_path):
     from gan_deeplearning4j_tpu.train.insurance_main import main
 
@@ -46,6 +47,7 @@ def test_insurance_end_to_end(tmp_path):
     assert 0.0 <= auc <= 1.0
 
 
+@pytest.mark.slow
 def test_cv_end_to_end(tmp_path):
     from gan_deeplearning4j_tpu.train.cv_main import main
 
@@ -63,6 +65,7 @@ def test_cv_end_to_end(tmp_path):
     assert lat.shape == (100, 28, 28)
 
 
+@pytest.mark.slow
 def test_checkpoint_resume_determinism(tmp_path):
     """A run checkpointed at step 2 and resumed to step 4 must equal an
     uninterrupted 4-step run (params bitwise-close) — the capability the
@@ -95,6 +98,7 @@ def test_checkpoint_resume_determinism(tmp_path):
             )
 
 
+@pytest.mark.slow
 def test_resume_with_partial_epoch_tail(tmp_path):
     """Row count NOT divisible by batch_size: the loop consumes-and-skips
     the partial tail without counting it as a step; resume must replay the
@@ -151,6 +155,7 @@ def test_eval_metric_units():
     assert auroc_from_predictions(scores, y) == pytest.approx(1.0)
 
 
+@pytest.mark.slow
 def test_train_with_recovery_resumes_after_failure(tmp_path):
     """Failure recovery (SURVEY §5): a crash mid-run restarts from the
     latest checkpoint and finishes with the same final state a
@@ -213,6 +218,7 @@ def test_train_with_recovery_resumes_after_failure(tmp_path):
     np.testing.assert_array_equal(a, b)
 
 
+@pytest.mark.slow
 def test_async_dumps_match_sync_dumps(tmp_path):
     """Artifacts produced by the background artifact writer are bitwise
     the files the synchronous (reference-style) path writes: device
@@ -234,6 +240,7 @@ def test_async_dumps_match_sync_dumps(tmp_path):
         assert a == s, f
 
 
+@pytest.mark.slow
 def test_chunked_metrics_match_per_step(tmp_path):
     """The multistep path's chunk metrics records (one stacked device
     array per loss per dispatch, MetricsLogger.log_chunk) expand to the
