@@ -364,10 +364,15 @@ def main(argv=None) -> None:
     print(json.dumps(out))
 
 
-if __name__ == "__main__":
-    from gan_deeplearning4j_tpu.runtime import backend as _backend
+def cli(argv=None) -> None:
+    """Console-script entry (gan4j-bench): a fresh process by definition,
+    so honoring the env platform here cannot clobber an in-process
+    override — unlike main(), which tests may import and call."""
+    from gan_deeplearning4j_tpu.runtime import backend
 
-    # process entry ONLY — an in-process caller may already have forced a
-    # platform that the ambient env must not clobber
-    _backend.apply_env_platform()
-    sys.exit(main())
+    backend.apply_env_platform()
+    main(argv)
+
+
+if __name__ == "__main__":
+    sys.exit(cli())

@@ -170,15 +170,16 @@ def evaluate(trainer: GANTrainer) -> Dict[str, float]:
 
 
 def cli(argv=None) -> None:
-    """Console-script entry point: swallow main()'s result dict so the
-    setuptools wrapper's sys.exit() sees None (exit status 0)."""
+    """Console-script / python -m entry: swallow main()'s result dict
+    so the setuptools wrapper's sys.exit() sees None (exit status 0),
+    and honor JAX_PLATFORMS — a fresh process by definition, so this
+    cannot clobber an in-process override (unlike main(), which tests
+    import and call under a conftest-forced CPU platform)."""
+    from gan_deeplearning4j_tpu.runtime import backend as _backend
+
+    _backend.apply_env_platform()
     main(argv)
 
 
 if __name__ == "__main__":
-    from gan_deeplearning4j_tpu.runtime import backend as _backend
-
-    # process entry ONLY: tests import main() in-process under a
-    # conftest-forced CPU platform that this must not clobber
-    _backend.apply_env_platform()
-    main()
+    cli()

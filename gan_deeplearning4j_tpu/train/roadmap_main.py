@@ -270,9 +270,4 @@ def cli(argv=None) -> None:
 
 
 if __name__ == "__main__":
-    from gan_deeplearning4j_tpu.runtime import backend as _backend
-
-    # process entry ONLY: tests import main() in-process under a
-    # conftest-forced CPU platform that this must not clobber
-    _backend.apply_env_platform()
-    main()
+    cli()
