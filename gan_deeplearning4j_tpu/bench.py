@@ -278,7 +278,21 @@ def main(argv=None) -> None:
     from gan_deeplearning4j_tpu.runtime import backend
 
     backend.add_bf16_flag(p)
+    p.add_argument("--s2d", action="store_true",
+                   help="space-to-depth rewrite of the C_in=1 first conv "
+                        "(exact reindexing; ops/conv.py) — the RESULTS r2 "
+                        "§4 MFU-sink attack, measured A/B with this flag")
+    p.add_argument("--pallas-updater", action="store_true",
+                   help="Pallas one-pass RmsProp update chain for big "
+                        "leaves (ops/pallas/fused_update.py)")
     args = p.parse_args(argv)
+
+    # idempotent (not latch-on): repeated in-process main() calls — the
+    # A/B measurement pattern — must reset state for the baseline run
+    backend.configure(conv_s2d=args.s2d)
+    from gan_deeplearning4j_tpu.ops import pallas as pallas_mod
+
+    pallas_mod.enable(args.pallas_updater)
 
     global BATCH
     BATCH = args.batch

@@ -32,6 +32,42 @@ def conv2d_out_size(in_size: int, kernel: int, stride: int, pad: int) -> int:
     return (in_size + 2 * pad - kernel) // stride + 1
 
 
+def _s2d_eligible(x: jax.Array, w: jax.Array, stride, padding) -> bool:
+    return (w.shape[1] == 1 and tuple(stride) == (2, 2)
+            and tuple(padding) == (0, 0)
+            and x.shape[2] % 2 == 0 and x.shape[3] % 2 == 0
+            and w.shape[2] >= 3 and w.shape[3] >= 3)
+
+
+def _space_to_depth_rewrite(x: jax.Array, w: jax.Array):
+    """Exact reindexing of a C_in=1 stride-2 conv as a denser stride-1
+    conv on 2x2 space-to-depth blocks (RESULTS r2 §4's named MFU sink:
+    at C_in=1 the MXU contraction is kh*kw=25-deep — 1/8-utilized; after
+    the rewrite it is ceil(k/2)^2*4=36-deep over a quarter the spatial
+    grid, and XLA tiles the denser channel axis onto the MXU lanes).
+
+      y[b,o,i,j] = sum_{p,q} x[b,0,2i+p,2j+q] w[o,0,p,q]
+                 = sum_{dy,dx,P,Q} X[b,dy*2+dx,i+P,j+Q] W'[o,dy*2+dx,P,Q]
+      with X[b,dy*2+dx,I,J] = x[b,0,2I+dy,2J+dx]  (p = 2P+dy, q = 2Q+dx)
+
+    Pure gather/pad of the SAME tensors at trace time — differentiable,
+    weight-layout-invisible to the user; only float summation order
+    changes."""
+    B, _, H, W = x.shape
+    O, _, kh, kw = w.shape
+    kh2, kw2 = (kh + 1) // 2, (kw + 1) // 2
+    xb = x.reshape(B, H // 2, 2, W // 2, 2).transpose(0, 2, 4, 1, 3)
+    xb = xb.reshape(B, 4, H // 2, W // 2)
+    planes = []
+    for dy in (0, 1):
+        for dx in (0, 1):
+            sub = w[:, 0, dy::2, dx::2]  # [O, ceil((kh-dy)/2), ...]
+            planes.append(jnp.pad(sub, (
+                (0, 0), (0, kh2 - sub.shape[1]), (0, kw2 - sub.shape[2]))))
+    wb = jnp.stack(planes, axis=1)  # [O, 4, kh2, kw2]
+    return xb, wb
+
+
 def conv2d(
     x: jax.Array,
     w: jax.Array,
@@ -52,6 +88,11 @@ def conv2d(
     preferred_element_type would leave the transpose/VJP conv with one
     bf16 and one f32 operand, which lax rejects); the MXU still
     accumulates partial products in f32 internally."""
+    from gan_deeplearning4j_tpu.runtime import backend
+
+    if backend.config().conv_s2d and _s2d_eligible(x, w, stride, padding):
+        x, w = _space_to_depth_rewrite(x, w)
+        stride, padding = (1, 1), (0, 0)
     orig_dtype = x.dtype
     if bf16:
         x = x.astype(jnp.bfloat16)

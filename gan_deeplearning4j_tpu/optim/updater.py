@@ -55,6 +55,24 @@ class GraphUpdater:
     def _updater_for(self, layer: str):
         return self.layer_updaters.get(layer) or _FROZEN
 
+    def _fused_chain(self, up, p, g, c, l2: float):
+        """Pallas one-pass update chain for big RmsProp leaves (opt-in via
+        ops.pallas.enable; ops/pallas/fused_update.py).  None = take the
+        plain-jnp path (small leaf, other updater kind, or Pallas off)."""
+        if not isinstance(up, RmsProp):
+            return None
+        from gan_deeplearning4j_tpu.ops import pallas as pallas_mod
+
+        if not pallas_mod.enabled():
+            return None
+        from gan_deeplearning4j_tpu.ops.pallas import fused_update
+
+        if p.size < fused_update.MIN_FUSED_SIZE:
+            return None
+        return fused_update.fused_rmsprop_chain(
+            p, g, c, lr=up.learning_rate, rho=up.rms_decay, eps=up.epsilon,
+            l2=l2, clip=self.clip_threshold)
+
     def init(self, params):
         return {
             layer: {
@@ -77,8 +95,13 @@ class GraphUpdater:
             new_cache[layer] = dict(cache.get(layer, {}))
             for pname, g in layer_grads.items():
                 p = params[layer][pname]
-                if self.l2 > 0.0 and pname in _L2_PARAM_NAMES:
-                    g = g + self.l2 * p
+                l2 = self.l2 if pname in _L2_PARAM_NAMES else 0.0
+                fused = self._fused_chain(up, p, g, cache[layer][pname], l2)
+                if fused is not None:
+                    new_params[layer][pname], new_cache[layer][pname] = fused
+                    continue
+                if l2 > 0.0:
+                    g = g + l2 * p
                 if self.clip_threshold is not None:
                     g = jnp.clip(g, -self.clip_threshold, self.clip_threshold)
                 update, c2 = up.update_leaf(g, cache[layer][pname])

@@ -65,6 +65,12 @@ class RuntimeConfig:
     dtype: np.dtype = np.float32
     # bfloat16 matmuls on the MXU; params/activations stay float32.
     matmul_bf16: bool = False
+    # space-to-depth rewrite of C_in=1 stride-2 convs (ops/conv.py): an
+    # exact reindexing that densifies the MXU contraction of the first
+    # conv (the profiled 1/8-utilized contraction, RESULTS r2 §4).
+    # Opt-in: summation order changes, so numerics differ by float
+    # rounding from the reference path.
+    conv_s2d: bool = False
     # seed 666 everywhere ("numberOfTheBeast", dl4jGANComputerVision.java:68).
     seed: int = 666
 

@@ -265,7 +265,13 @@ def main(argv=None) -> Dict[str, float]:
 
 
 def cli(argv=None) -> None:
-    """Console-script entry point (exit status 0)."""
+    """Console-script / python -m entry: honor JAX_PLATFORMS — a fresh
+    process by definition, so this cannot clobber an in-process override
+    (unlike main(), which tests import and call under a conftest-forced
+    CPU platform)."""
+    from gan_deeplearning4j_tpu.runtime import backend as _backend
+
+    _backend.apply_env_platform()
     main(argv)
 
 

@@ -225,3 +225,46 @@ def test_adam_updater_protocol():
     new_params, new_cache = up.apply(params, grads, cache)
     assert np.all(np.asarray(new_params["a"]["W"]) != np.asarray(p))
     assert float(new_cache["a"]["W"]["t"]) == 1.0
+
+
+def test_conv_s2d_rewrite_matches_reference():
+    """The space-to-depth rewrite of the C_in=1 stride-2 first conv is an
+    exact reindexing: forward and weight-gradient match the direct conv
+    up to float summation order (ops/conv.py; the RESULTS r2 §4 MFU
+    sink).  Ineligible shapes (stride 1, C_in>1) must not be rewritten."""
+    import jax
+
+    from gan_deeplearning4j_tpu.ops import conv as conv_ops
+    from gan_deeplearning4j_tpu.runtime import backend
+
+    rng = np.random.RandomState(3)
+    x = jnp.asarray(rng.randn(4, 1, 28, 28).astype(np.float32))
+    w = jnp.asarray(rng.randn(64, 1, 5, 5).astype(np.float32))
+    b = jnp.asarray(rng.randn(64).astype(np.float32))
+
+    ref = conv_ops.conv2d(x, w, b, stride=(2, 2))
+    ref_g = jax.grad(lambda w: (conv_ops.conv2d(x, w, b, stride=(2, 2))
+                                ** 2).sum())(w)
+    backend.configure(conv_s2d=True)
+    try:
+        # the rewrite must actually ENGAGE (allclose alone would also
+        # pass if _s2d_eligible silently regressed to always-False)
+        assert conv_ops._s2d_eligible(x, w, (2, 2), (0, 0))
+        out = conv_ops.conv2d(x, w, b, stride=(2, 2))
+        assert not np.array_equal(np.asarray(out), np.asarray(ref)), \
+            "s2d path bitwise-equal to direct conv: rewrite did not engage"
+        out_g = jax.grad(lambda w: (conv_ops.conv2d(x, w, b, stride=(2, 2))
+                                    ** 2).sum())(w)
+        # stride-1 shape is ineligible: bitwise-identical path
+        x1 = jnp.asarray(rng.randn(2, 3, 9, 9).astype(np.float32))
+        w1 = jnp.asarray(rng.randn(4, 3, 3, 3).astype(np.float32))
+        same = conv_ops.conv2d(x1, w1, None, stride=(1, 1))
+    finally:
+        backend.configure(conv_s2d=False)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(out_g), np.asarray(ref_g),
+                               rtol=1e-4, atol=1e-3)
+    np.testing.assert_array_equal(
+        np.asarray(same),
+        np.asarray(conv_ops.conv2d(x1, w1, None, stride=(1, 1))))
