@@ -71,6 +71,51 @@ def save_grid_png(path: str, grid_csv_or_array, sample_shape,
         path, arr.reshape(arr.shape[0], 1, h, w), grid_edge, w, h)
 
 
+def save_lattice_example_pngs(path_raw: str, path_plotted: str,
+                              grid_csv_or_array, sample_shape=(4, 3),
+                              index: int = 0) -> tuple:
+    """The reference's single-lattice artifacts
+    (``Python/DCGAN_Generated_Lattice_Example.png`` and
+    ``..._Example_Plotted.png``): one generated transaction lattice as a
+    raw pixel blow-up and as an annotated heatmap (period rows x
+    transaction-type columns, value-labeled cells)."""
+    import matplotlib
+
+    matplotlib.use("Agg")
+    import matplotlib.pyplot as plt
+
+    from gan_deeplearning4j_tpu.data import read_csv_matrix
+
+    arr = (read_csv_matrix(grid_csv_or_array)
+           if isinstance(grid_csv_or_array, str)
+           else np.asarray(grid_csv_or_array))
+    h, w = sample_shape
+    lattice = arr[index].reshape(h, w)
+
+    plt.figure(figsize=(3, 4))
+    plt.imshow(lattice, cmap="gray", interpolation="nearest")
+    plt.axis("off")
+    plt.tight_layout(pad=0)
+    plt.savefig(path_raw, dpi=150, bbox_inches="tight")
+    plt.close()
+
+    fig, ax = plt.subplots(figsize=(4, 5))
+    im = ax.imshow(lattice, cmap="viridis", interpolation="nearest")
+    ax.set_xlabel("transaction type")
+    ax.set_ylabel("period")
+    ax.set_xticks(range(w), ["premium", "service", "claim"][:w])
+    ax.set_yticks(range(h))
+    for i in range(h):
+        for j in range(w):
+            ax.text(j, i, f"{lattice[i, j]:.2f}", ha="center", va="center",
+                    color="white", fontsize=8)
+    fig.colorbar(im, ax=ax, shrink=0.8)
+    fig.tight_layout()
+    fig.savefig(path_plotted, dpi=150, bbox_inches="tight")
+    plt.close(fig)
+    return path_raw, path_plotted
+
+
 def save_rgb_grid_png(path: str, samples: np.ndarray, sample_shape,
                       grid_edge: Optional[int] = None,
                       value_range=(-1.0, 1.0)) -> str:

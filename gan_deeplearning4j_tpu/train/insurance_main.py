@@ -166,6 +166,16 @@ def evaluate(trainer: GANTrainer) -> Dict[str, float]:
         save_grid_png(
             os.path.join(c.res_path, "DCGAN_Generated_Lattices.png"),
             grid_csv, (4, 3))
+        # the reference's single-lattice artifacts (raw + annotated)
+        from gan_deeplearning4j_tpu.eval.plots import (
+            save_lattice_example_pngs,
+        )
+
+        save_lattice_example_pngs(
+            os.path.join(c.res_path, "DCGAN_Generated_Lattice_Example.png"),
+            os.path.join(c.res_path,
+                         "DCGAN_Generated_Lattice_Example_Plotted.png"),
+            grid_csv, (4, 3))
     return out
 
 

@@ -30,7 +30,11 @@ def test_insurance_end_to_end(tmp_path):
               "insurance_out_pred_2.csv", "insurance_out_pred_4.csv",
               "insurance_test_predictions_4.csv",
               "insurance_dis_model.zip", "insurance_gan_model.zip",
-              "insurance_gen_model.zip", "insurance_insurance_model.zip"]:
+              "insurance_gen_model.zip", "insurance_insurance_model.zip",
+              # the reference's three lattice image artifacts
+              "DCGAN_Generated_Lattices.png",
+              "DCGAN_Generated_Lattice_Example.png",
+              "DCGAN_Generated_Lattice_Example_Plotted.png"]:
         assert os.path.exists(os.path.join(d, f)), f
     # grid dump: 50x50 z-grid, 12 features, values in (0,1) (sigmoid head)
     grid = read_csv_matrix(os.path.join(d, "insurance_out_4.csv"))
