@@ -187,7 +187,7 @@ class GANPair:
     def make_multistep(self, table_x, table_cond=None, *,
                        batch_size: int, steps_per_call: int,
                        n_critic: int = 1, real_label: float = 1.0,
-                       z_size: int, seed_key=None):
+                       z_size: int, seed_key=None, ema_decay: float = 0.0):
         """Fused multi-iteration training: ONE jitted program advances
         ``steps_per_call`` full (n_critic D-steps + 1 G-step) iterations
         via ``lax.scan``, with the dataset device-resident and batches
@@ -253,7 +253,7 @@ class GANPair:
                 return {label_name: table_cond[idx]}
 
             def one_iteration(carry, _):
-                pg, og, pd, od, it = carry
+                pg, og, pd, od, it, ema = carry
                 key = jax.random.fold_in(key0, it)
                 d_loss = jnp.zeros(())
                 for j in range(n_critic):
@@ -272,7 +272,14 @@ class GANPair:
                 pg, og, g_loss = self._g_step(
                     pg, og, pd, prng.stream(key, "g"), z_in, c, y_gen_v,
                     axis_name=axis_name)
-                return (pg, og, pd, od, it + 1), (d_loss, g_loss)
+                if ema_decay:
+                    # trajectory-averaged generator (fused_step.py's EMA,
+                    # for the roadmap engine): damps the adversarial
+                    # equilibrium's rounding sensitivity
+                    ema = jax.tree.map(
+                        lambda e, p: ema_decay * e + (1.0 - ema_decay) * p,
+                        ema, pg)
+                return (pg, og, pd, od, it + 1, ema), (d_loss, g_loss)
 
             return lax.scan(one_iteration, state, None,
                             length=steps_per_call)
@@ -306,16 +313,25 @@ class GANPair:
         def step_fn(state):
             return jit_multi(state, *invariants)
 
+        ema0 = None
+        if ema_decay:
+            src = getattr(self.gen, "ema_params", None) or self.gen.params
+            # fresh buffers, not aliases of gen params (the fused_step.py
+            # rule: aliased leaves in one carry are undefined under
+            # donation and wedge CPU collectives)
+            ema0 = jax.tree.map(jnp.copy, src)
         state0 = (self.gen.params, self.gen.opt_state,
                   self.dis.params, self.dis.opt_state,
-                  jnp.asarray(0, jnp.int32))
+                  jnp.asarray(0, jnp.int32), ema0)
         return step_fn, state0
 
     def adopt_state(self, state) -> None:
         """Write a multistep scan state back into the graph objects (for
         artifact dumps / serialization)."""
         (self.gen.params, self.gen.opt_state,
-         self.dis.params, self.dis.opt_state, _) = state
+         self.dis.params, self.dis.opt_state, _, ema) = state
+        if ema is not None:
+            self.gen.ema_params = ema
 
     def d_step(self, real, z_inputs: Dict, cond_real: Optional[Dict] = None,
                cond_fake: Optional[Dict] = None,

@@ -108,7 +108,8 @@ def _data(family: str, n: int, seed: int, sample_shape=None,
 
 def train(family: str, iterations: int, batch_size: int, res_path: str,
           n_train: int, print_every: int, n_devices=None,
-          data_dir: str = None, log=print) -> Dict[str, float]:
+          data_dir: str = None, ema_decay: float = 0.0,
+          log=print) -> Dict[str, float]:
     os.makedirs(res_path, exist_ok=True)
     mesh = None
     if n_devices and n_devices > 1:
@@ -187,7 +188,7 @@ def train(family: str, iterations: int, batch_size: int, res_path: str,
             jnp.asarray(x), None if y is None else jnp.asarray(y),
             batch_size=batch_size, steps_per_call=K, n_critic=n_critic,
             real_label=real_label, z_size=cfg.z_size,
-            seed_key=z_key)
+            seed_key=z_key, ema_decay=ema_decay)
         it = 0
         while it < iterations:
             state, (dl, gl) = step_fn(state)
@@ -212,6 +213,14 @@ def train(family: str, iterations: int, batch_size: int, res_path: str,
                 dump_samples(it)
         pair.adopt_state(state)
         iterations = it
+        if getattr(pair.gen, "ema_params", None) is not None:
+            # final grid from the trajectory-averaged weights too
+            orig = pair.gen.params
+            pair.gen.params = pair.gen.ema_params
+            try:
+                dump_samples("ema")
+            finally:
+                pair.gen.params = orig
 
     device_fence((d_loss, g_loss))
     steps_timed = iterations - steady_start if steady_t0 is not None else 0
@@ -222,6 +231,14 @@ def train(family: str, iterations: int, batch_size: int, res_path: str,
     for name, graph in (("gen", pair.gen), ("dis", pair.dis)):
         serialization.write_model(
             graph, os.path.join(res_path, f"{family}_{name}_model.zip"))
+    if getattr(pair.gen, "ema_params", None) is not None:
+        orig = pair.gen.params
+        pair.gen.params = pair.gen.ema_params
+        try:
+            serialization.write_model(pair.gen, os.path.join(
+                res_path, f"{family}_gen_ema_model.zip"))
+        finally:
+            pair.gen.params = orig
     return {
         "family": family,
         "steps": iterations,
@@ -246,6 +263,10 @@ def main(argv=None) -> Dict[str, float]:
                    help="directory of real images (class subdirs for the "
                         "conditional family) instead of the synthetic "
                         "surrogate")
+    p.add_argument("--ema-decay", type=float, default=0.0,
+                   help="generator weight EMA decay (e.g. 0.999): the "
+                        "final sample grid is also rendered from the "
+                        "trajectory-averaged weights")
     from gan_deeplearning4j_tpu.runtime import backend
 
     backend.add_bf16_flag(p)
@@ -255,7 +276,7 @@ def main(argv=None) -> Dict[str, float]:
     res = args.res_path or os.path.join("outputs", args.family)
     result = train(args.family, args.iterations, args.batch_size, res,
                    args.n_train, args.print_every, args.n_devices,
-                   data_dir=args.data_dir)
+                   data_dir=args.data_dir, ema_decay=args.ema_decay)
     import json
 
     # one JSON line (numpy scalars coerced) — machine-consumable, cf.

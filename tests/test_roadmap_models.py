@@ -227,3 +227,49 @@ def test_multistep_mesh_matches_single_device_wgan_gp():
     dl4, gl4 = run(data_mesh(4))
     np.testing.assert_allclose(dl4, dl1, rtol=1e-3, atol=1e-4)
     np.testing.assert_allclose(gl4, gl1, rtol=1e-3, atol=1e-4)
+
+
+@pytest.mark.slow
+def test_multistep_ema_chunk_invariant():
+    """Generator EMA inside the multistep scan: one K=4 chunk ends at the
+    same EMA weights as four K=1 chunks (the scan-chunk-invariance
+    property the protocol trainer proves for its losses), and the EMA
+    differs from — while tracking — the live weights."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from gan_deeplearning4j_tpu.data import datasets
+    from gan_deeplearning4j_tpu.models import wgan_gp as M
+    from gan_deeplearning4j_tpu.runtime import prng
+    from gan_deeplearning4j_tpu.train.gan_pair import GANPair
+
+    x, _ = datasets.synthetic_mnist(24, seed=2)
+    cfg = M.WGANGPConfig()
+    key = prng.stream(prng.root_key(cfg.seed), "ema-chunk")
+
+    def run(k, calls):
+        pair = GANPair(M.build_generator(cfg), M.build_critic(cfg),
+                       mode="wgan-gp", gp_weight=cfg.gp_weight)
+        step_fn, state = pair.make_multistep(
+            jnp.asarray(x), batch_size=8, steps_per_call=k,
+            n_critic=cfg.n_critic, z_size=cfg.z_size, seed_key=key,
+            ema_decay=0.9)
+        for _ in range(calls):
+            state, _losses = step_fn(state)
+        pair.adopt_state(state)
+        return pair
+
+    p_one = run(4, 1)
+    p_four = run(1, 4)
+    ema_one = p_one.gen.ema_params
+    ema_four = p_four.gen.ema_params
+    assert ema_one is not None and ema_four is not None
+    for layer in ema_one:
+        for name, v in ema_one[layer].items():
+            np.testing.assert_allclose(
+                np.asarray(v), np.asarray(ema_four[layer][name]),
+                rtol=1e-5, atol=1e-6, err_msg=f"{layer}/{name}")
+    # EMA is not the live weights (decay 0.9 lags the trajectory)
+    w_live = np.asarray(p_one.gen.params["gen_dense"]["W"])
+    w_ema = np.asarray(ema_one["gen_dense"]["W"])
+    assert not np.allclose(w_live, w_ema)
