@@ -73,7 +73,9 @@ def save_grid_png(path: str, grid_csv_or_array, sample_shape,
 
 def save_lattice_example_pngs(path_raw: str, path_plotted: str,
                               grid_csv_or_array, sample_shape=(4, 3),
-                              index: int = 0) -> tuple:
+                              index: int = 0,
+                              col_labels=("premium", "service", "claim"),
+                              ) -> tuple:
     """The reference's single-lattice artifacts
     (``Python/DCGAN_Generated_Lattice_Example.png`` and
     ``..._Example_Plotted.png``): one generated transaction lattice as a
@@ -103,7 +105,10 @@ def save_lattice_example_pngs(path_raw: str, path_plotted: str,
     im = ax.imshow(lattice, cmap="viridis", interpolation="nearest")
     ax.set_xlabel("transaction type")
     ax.set_ylabel("period")
-    ax.set_xticks(range(w), ["premium", "service", "claim"][:w])
+    # fall back to numeric labels when the given names don't cover w
+    names = (list(col_labels) if col_labels and len(col_labels) >= w
+             else [str(j) for j in range(w)])
+    ax.set_xticks(range(w), names[:w])
     ax.set_yticks(range(h))
     for i in range(h):
         for j in range(w):

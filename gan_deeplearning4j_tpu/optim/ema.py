@@ -1,0 +1,27 @@
+"""Generator weight EMA — one rule shared by both training engines.
+
+The protocol trainer (train/fused_step.py) and the roadmap engine
+(train/gan_pair.py) carry the same trajectory-averaged generator; the
+seeding and update rules live here so the two cannot silently diverge.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def ema_init(gen):
+    """Seed an EMA tree from a generator graph: resume from a carried
+    ``ema_params`` when present, else the live params.  Fresh buffers,
+    NOT aliases of the live params — the carry pytree may be donated,
+    and donating the same buffer under two leaves is undefined (observed
+    as a wedged CPU collective rendezvous)."""
+    src = getattr(gen, "ema_params", None) or gen.params
+    return jax.tree_util.tree_map(jnp.copy, src)
+
+
+def ema_update(ema, params, decay: float):
+    """One EMA step: ema <- decay*ema + (1-decay)*params."""
+    return jax.tree_util.tree_map(
+        lambda e, p: decay * e + (1.0 - decay) * p, ema, params)

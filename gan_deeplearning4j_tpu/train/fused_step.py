@@ -26,6 +26,7 @@ import jax.numpy as jnp
 from jax import lax, shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
+from gan_deeplearning4j_tpu.optim import ema as ema_lib
 from gan_deeplearning4j_tpu.runtime import prng
 
 
@@ -189,10 +190,9 @@ def make_protocol_step(
             reduce, axis_name)
         if ema_decay:
             # one elementwise pass over gen params (~3% of the step);
-            # traced out entirely when disabled
-            ema_gen = jax.tree_util.tree_map(
-                lambda e, p: ema_decay * e + (1.0 - ema_decay) * p,
-                state.ema_gen, gen_params)
+            # traced out entirely when disabled (shared rule: optim/ema.py)
+            ema_gen = ema_lib.ema_update(state.ema_gen, gen_params,
+                                         ema_decay)
         else:
             ema_gen = state.ema_gen
         new_state = ProtocolState(
@@ -243,13 +243,9 @@ def state_from_graphs(dis, gen, gan, classifier, start_step: int = 0,
                       ema: bool = False) -> ProtocolState:
     """``ema``: seed the generator's EMA slot from its current params
     (restores from ``gen.ema_params`` when a resumed graph carries one)."""
-    ema_gen = None
-    if ema:
-        src = getattr(gen, "ema_params", None) or gen.params
-        # fresh buffers, NOT aliases of gen_params: the state pytree is
-        # donated, and donating the same buffer under two leaves is
-        # undefined (observed as a wedged CPU collective rendezvous)
-        ema_gen = jax.tree_util.tree_map(jnp.copy, src)
+    # fresh buffers, NOT aliases of gen_params — the donation rationale
+    # lives with the shared rule in optim/ema.py
+    ema_gen = ema_lib.ema_init(gen) if ema else None
     return ProtocolState(
         dis.params, dis.opt_state, gan.params, gan.opt_state,
         classifier.params, classifier.opt_state, gen.params,

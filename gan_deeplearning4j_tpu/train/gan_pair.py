@@ -36,6 +36,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from gan_deeplearning4j_tpu.graph.graph import ComputationGraph
 from gan_deeplearning4j_tpu.ops import losses as loss_lib
+from gan_deeplearning4j_tpu.optim import ema as ema_lib
 from gan_deeplearning4j_tpu.parallel import mesh as mesh_lib
 from gan_deeplearning4j_tpu.runtime import prng
 
@@ -203,7 +204,7 @@ class GANPair:
         Donation is off (donation + scan crashes the axon TPU runtime).
         Returns (step_fn, state0):
           step_fn(state) -> (state', (d_losses[K], g_losses[K]))
-          state = (params_g, opt_g, params_d, opt_d, it)
+          state = (params_g, opt_g, params_d, opt_d, it, ema_or_None)
         """
         n_shards = self.mesh.shape[self.axis] if self.mesh is not None else 1
         if batch_size % n_shards != 0:
@@ -273,12 +274,9 @@ class GANPair:
                     pg, og, pd, prng.stream(key, "g"), z_in, c, y_gen_v,
                     axis_name=axis_name)
                 if ema_decay:
-                    # trajectory-averaged generator (fused_step.py's EMA,
-                    # for the roadmap engine): damps the adversarial
-                    # equilibrium's rounding sensitivity
-                    ema = jax.tree.map(
-                        lambda e, p: ema_decay * e + (1.0 - ema_decay) * p,
-                        ema, pg)
+                    # trajectory-averaged generator (optim/ema.py — the
+                    # same rule as the protocol trainer's fused step)
+                    ema = ema_lib.ema_update(ema, pg, ema_decay)
                 return (pg, og, pd, od, it + 1, ema), (d_loss, g_loss)
 
             return lax.scan(one_iteration, state, None,
@@ -313,13 +311,7 @@ class GANPair:
         def step_fn(state):
             return jit_multi(state, *invariants)
 
-        ema0 = None
-        if ema_decay:
-            src = getattr(self.gen, "ema_params", None) or self.gen.params
-            # fresh buffers, not aliases of gen params (the fused_step.py
-            # rule: aliased leaves in one carry are undefined under
-            # donation and wedge CPU collectives)
-            ema0 = jax.tree.map(jnp.copy, src)
+        ema0 = ema_lib.ema_init(self.gen) if ema_decay else None
         state0 = (self.gen.params, self.gen.opt_state,
                   self.dis.params, self.dis.opt_state,
                   jnp.asarray(0, jnp.int32), ema0)

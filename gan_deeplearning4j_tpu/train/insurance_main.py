@@ -163,19 +163,21 @@ def evaluate(trainer: GANTrainer) -> Dict[str, float]:
                                        "insurance_metrics.jsonl")))
     grid_csv = os.path.join(c.res_path, f"insurance_out_{step}.csv")
     if os.path.exists(grid_csv):
-        save_grid_png(
-            os.path.join(c.res_path, "DCGAN_Generated_Lattices.png"),
-            grid_csv, (4, 3))
-        # the reference's single-lattice artifacts (raw + annotated)
+        from gan_deeplearning4j_tpu.data import read_csv_matrix
         from gan_deeplearning4j_tpu.eval.plots import (
             save_lattice_example_pngs,
         )
 
+        grid = read_csv_matrix(grid_csv)  # parsed once, both renders
+        save_grid_png(
+            os.path.join(c.res_path, "DCGAN_Generated_Lattices.png"),
+            grid, (4, 3))
+        # the reference's single-lattice artifacts (raw + annotated)
         save_lattice_example_pngs(
             os.path.join(c.res_path, "DCGAN_Generated_Lattice_Example.png"),
             os.path.join(c.res_path,
                          "DCGAN_Generated_Lattice_Example_Plotted.png"),
-            grid_csv, (4, 3))
+            grid, (4, 3))
     return out
 
 

@@ -235,8 +235,11 @@ def train(family: str, iterations: int, batch_size: int, res_path: str,
         orig = pair.gen.params
         pair.gen.params = pair.gen.ema_params
         try:
+            # inference-only artifact: the live Adam moments don't belong
+            # to the averaged weights
             serialization.write_model(pair.gen, os.path.join(
-                res_path, f"{family}_gen_ema_model.zip"))
+                res_path, f"{family}_gen_ema_model.zip"),
+                save_updater=False)
         finally:
             pair.gen.params = orig
     return {
