@@ -40,6 +40,15 @@ Both collapse here into jitted SPMD programs over a ``Mesh``:
     run to run (an actual Aeron race would not be).  With one replica and
     staleness 1 this degenerates to exact sequential SGD (tested).
 
+Multi-slice (DCN) topology: pass ``dcn_axis`` (+ a mesh from
+``multihost.hybrid_mesh``) and gradient_sync pmeans over both tiers
+(XLA splits it into an ICI reduce + a DCN reduce), while
+param_averaging runs a HIERARCHICAL schedule — every
+``averaging_frequency`` batches resync within the slice on ICI, and
+only every ``dcn_every``-th averaging point crosses DCN (the
+amortization a slow inter-host fabric needs; proven against a manual
+two-tier computation in tests/test_parallel.py).
+
 No host serialization ever happens: arrays stay device-resident and the
 "averaging reduce" is an XLA collective riding ICI, not a Spark shuffle.
 """
@@ -52,7 +61,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 from jax import shard_map
-from jax.sharding import Mesh, PartitionSpec as P
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from gan_deeplearning4j_tpu.graph.graph import ComputationGraph
 from gan_deeplearning4j_tpu.parallel import mesh as mesh_lib
@@ -78,6 +87,8 @@ class DataParallelGraph:
         mode: str = "gradient_sync",
         averaging_frequency: int = 1,
         staleness: int = 1,
+        dcn_axis: Optional[str] = None,
+        dcn_every: int = 1,
     ):
         if mode not in ("gradient_sync", "param_averaging",
                         "async_gradient_sharing"):
@@ -90,12 +101,30 @@ class DataParallelGraph:
         if staleness < 1:
             raise ValueError(f"staleness must be >= 1, got {staleness}")
         self.staleness = staleness
-        self.num_replicas = self.mesh.shape[axis]
+        # two-tier topology (multi-slice): ``axis`` is the within-slice
+        # ICI tier; ``dcn_axis`` the cross-slice tier.  param_averaging
+        # then averages over ICI every ``averaging_frequency`` batches
+        # but crosses DCN only every ``dcn_every``-th averaging point —
+        # the hierarchical schedule that keeps the frequent resyncs on
+        # the fast interconnect (multihost.hybrid_mesh's layout rule).
+        if dcn_axis is not None and dcn_axis not in self.mesh.shape:
+            raise ValueError(f"dcn_axis {dcn_axis!r} not in mesh "
+                             f"{dict(self.mesh.shape)}")
+        if dcn_every < 1:
+            raise ValueError(f"dcn_every must be >= 1, got {dcn_every}")
+        self.dcn_axis = dcn_axis
+        self.dcn_every = dcn_every
+        self.num_replicas = self.mesh.shape[axis] * (
+            self.mesh.shape[dcn_axis] if dcn_axis else 1)
         self._fit_count = 0
         self._step_rng = prng.stream(prng.root_key(graph.seed), "dp-step")
         if mode == "gradient_sync":
             self._jit_step = self._build_gradient_sync_step()
         elif mode == "async_gradient_sharing":
+            if dcn_axis is not None:
+                raise ValueError(
+                    "async_gradient_sharing is single-tier; model the "
+                    "slow tier with `staleness` instead of dcn_axis")
             self._jit_step = self._build_async_step()
             self._round = 0
             self._local_params = None  # seeded from the server at first fit
@@ -105,16 +134,40 @@ class DataParallelGraph:
 
     # -- step builders -------------------------------------------------------
 
+    def _sync_axes(self):
+        """The axis name(s) a full resync spans: ICI alone, or (DCN, ICI)
+        under a two-tier mesh — lax collectives take either form."""
+        return ((self.dcn_axis, self.axis) if self.dcn_axis
+                else self.axis)
+
+    def _batch_spec(self, leading_dims: int = 0) -> P:
+        """Batch rows split over every replica axis (both tiers);
+        ``leading_dims`` unsharded axes (the fit_batches [num_batches]
+        axis) come first.  The ONE source of truth for how batch data
+        lays out over the mesh."""
+        replica_axes = ((self.dcn_axis, self.axis) if self.dcn_axis
+                        else self.axis)
+        return P(*([None] * leading_dims), replica_axes)
+
+    def _replica_index(self):
+        idx = lax.axis_index(self.axis)
+        if self.dcn_axis:
+            idx = idx + lax.axis_index(self.dcn_axis) * self.mesh.shape[self.axis]
+        return idx
+
     def _build_gradient_sync_step(self):
-        graph, axis = self.graph, self.axis
+        graph = self.graph
+        axes = self._sync_axes()
 
         def reduce(loss, state_updates, grads):
             # The ICI all-reduce: these pmeans are the entire Spark/Aeron
             # replacement (SURVEY.md §5 "Distributed communication backend").
+            # Over a two-tier mesh XLA decomposes the pmean into a
+            # within-slice ICI reduce + a cross-slice DCN reduce.
             return (
-                lax.pmean(loss, axis),
-                lax.pmean(state_updates, axis),
-                lax.pmean(grads, axis),
+                lax.pmean(loss, axes),
+                lax.pmean(state_updates, axes),
+                lax.pmean(grads, axes),
             )
 
         def step(params, opt_state, rng, inputs, labels):
@@ -124,14 +177,14 @@ class DataParallelGraph:
             # single-device draw either way).  axis_name turns on sync-BN:
             # batch stats are global-batch stats, so BN graphs keep the
             # exact single-device equivalence too (ops/batchnorm.py).
-            rng = prng.fold_in_index(rng, lax.axis_index(axis))
+            rng = prng.fold_in_index(rng, self._replica_index())
             return graph._train_step(params, opt_state, rng, inputs, labels,
-                                     reduce, axis_name=axis)
+                                     reduce, axis_name=axes)
 
         return jax.jit(shard_map(
             step,
             mesh=self.mesh,
-            in_specs=(P(), P(), P(), P(self.axis), P(self.axis)),
+            in_specs=(P(), P(), P(), self._batch_spec(), self._batch_spec()),
             out_specs=(P(), P(), P()),
             check_vma=False,
         ))
@@ -146,9 +199,12 @@ class DataParallelGraph:
         Batched inputs arrive as [num_batches, local_B, ...] per replica.
         """
         graph, axis, avg_freq = self.graph, self.axis, self.averaging_frequency
+        full_axes = self._sync_axes()
+        dcn_every = self.dcn_every
 
         def job(params, opt_state, rng, inputs, labels):
-            rng = prng.fold_in_index(rng, lax.axis_index(axis))
+            rng = prng.fold_in_index(rng, self._replica_index())
+            avg_point = 0
             for i in range(num_batches):
                 x_i = {k: v[i] for k, v in inputs.items()}
                 y_i = {k: v[i] for k, v in labels.items()}
@@ -156,20 +212,29 @@ class DataParallelGraph:
                     params, opt_state, jax.random.fold_in(rng, i), x_i, y_i
                 )
                 if (i + 1) % avg_freq == 0 and i + 1 < num_batches:
-                    params = lax.pmean(params, axis)
-                    opt_state = lax.pmean(opt_state, axis)
+                    # two-tier schedule: every averaging point resyncs
+                    # within the slice (ICI); only every dcn_every-th one
+                    # crosses slices (DCN) — static unroll, so the tier
+                    # choice is baked into the program
+                    avg_point += 1
+                    tier = (full_axes if avg_point % dcn_every == 0
+                            else axis)
+                    params = lax.pmean(params, tier)
+                    opt_state = lax.pmean(opt_state, tier)
             # Job-end average (the reference's 1-batch-per-worker jobs hit
-            # only this one, making every fit() a full resync).
-            params = lax.pmean(params, axis)
-            opt_state = lax.pmean(opt_state, axis)
-            loss = lax.pmean(loss, axis)
+            # only this one, making every fit() a full resync) — always
+            # BOTH tiers, so a job ends globally synced.
+            params = lax.pmean(params, full_axes)
+            opt_state = lax.pmean(opt_state, full_axes)
+            loss = lax.pmean(loss, full_axes)
             return params, opt_state, loss
 
-        batched = P(self.axis)
+        batched = self._batch_spec()
+        multi = self._batch_spec(leading_dims=1)
         return jax.jit(shard_map(
             job,
             mesh=self.mesh,
-            in_specs=(P(), P(), P(), P(None, self.axis), P(None, self.axis)),
+            in_specs=(P(), P(), P(), multi, multi),
             out_specs=(P(), P(), P()),
             check_vma=False,
         )) if num_batches > 1 else jax.jit(shard_map(
@@ -245,8 +310,6 @@ class DataParallelGraph:
         which would materialize an n-fold replicated intermediate on every
         device before resharding — a transient n-times parameter-memory
         spike on each pull."""
-        from jax.sharding import NamedSharding
-
         import numpy as np
 
         n = self.num_replicas
@@ -286,7 +349,7 @@ class DataParallelGraph:
         """One distributed job on a global batch sharded over the mesh —
         ``sparkX.fit(sc.parallelize(...))``."""
         inputs, label_map = self._as_maps(features, labels)
-        sh = mesh_lib.batch_sharding(self.mesh, self.axis)
+        sh = NamedSharding(self.mesh, self._batch_spec())
         inputs = {k: jax.device_put(jnp.asarray(v), sh) for k, v in inputs.items()}
         label_map = {k: jax.device_put(jnp.asarray(v), sh) for k, v in label_map.items()}
         if self.mode == "async_gradient_sharing":
@@ -324,9 +387,7 @@ class DataParallelGraph:
         if step is None:
             step = self._build_param_avg_step(num_batches)
             self._multi_cache[num_batches] = step
-        from jax.sharding import NamedSharding
-
-        sh = NamedSharding(self.mesh, P(None, self.axis))
+        sh = NamedSharding(self.mesh, self._batch_spec(leading_dims=1))
         inputs = {k: jax.device_put(jnp.asarray(v), sh) for k, v in inputs.items()}
         label_map = {k: jax.device_put(jnp.asarray(v), sh) for k, v in label_map.items()}
         new_params, new_opt, loss = step(

@@ -38,6 +38,54 @@ def initialize(
     )
 
 
+def hybrid_mesh(ici_shape: Dict[str, int], dcn_axis: str,
+                num_slices: Optional[int] = None) -> Mesh:
+    """Mesh for multi-slice TPU jobs: ``dcn_axis`` spans slices (hosts),
+    every axis in ``ici_shape`` stays within a slice.  The standard
+    layout rule — bandwidth-hungry collectives (TP/SP/grad-sync) ride
+    ICI; only the outer axis's traffic crosses DCN.
+
+    Uses the devices' slice topology when exposed (real multi-slice
+    PJRT), else falls back to host-major order (virtual CPU meshes,
+    single slice) — so one code path serves tests and production."""
+    if dcn_axis in ici_shape:
+        raise ValueError(
+            f"dcn_axis {dcn_axis!r} collides with an ici_shape axis — "
+            "the DCN tier must be its own axis")
+    devices = jax.devices()
+    slice_ids = {getattr(d, "slice_index", 0) for d in devices}
+    real_topology = len(slice_ids) > 1
+    if num_slices is None:
+        if real_topology:
+            num_slices = len(slice_ids)
+        else:
+            # no slice topology exposed (single slice / virtual mesh):
+            # carve the DCN axis out of host-major order
+            per = int(np.prod(list(ici_shape.values())))
+            num_slices = len(devices) // per
+    if num_slices < 1:
+        raise ValueError("ici_shape larger than the device count")
+    shape = {dcn_axis: num_slices}
+    shape.update(ici_shape)
+    if not real_topology:
+        # virtual/CPU: no slice boundaries exist, host-major order IS the
+        # topology — a create_hybrid_device_mesh failure here would only
+        # be masked, never corrected, so don't attempt it
+        return global_mesh(shape, dcn_axis=dcn_axis)
+    from jax.experimental import mesh_utils
+
+    # real multi-slice hardware: any error (shape not matching the
+    # per-slice device count etc.) is a genuine topology error and MUST
+    # propagate — a host-major fallback could silently lay the "ICI"
+    # axis across DCN
+    arr = mesh_utils.create_hybrid_device_mesh(
+        tuple(ici_shape.values()), (num_slices,), devices=devices,
+        process_is_granule=False)
+    # create_hybrid_device_mesh puts DCN axes LAST; ours is first
+    arr = np.moveaxis(arr, -1, 0)
+    return Mesh(arr.reshape(tuple(shape.values())), tuple(shape.keys()))
+
+
 def global_mesh(shape: Dict[str, int],
                 dcn_axis: Optional[str] = None) -> Mesh:
     """Mesh over ALL processes' devices.  If ``dcn_axis`` names an axis, it

@@ -273,3 +273,127 @@ def test_dp_composes_with_setparam_sync(cpu_devices):
     # their magnitude is bounded by lr * steps
     w = np.asarray(g.get_param("h", "W"))
     assert np.abs(w).max() < 0.1
+
+
+def test_two_tier_gradient_sync_equals_single_device(cpu_devices):
+    """gradient_sync over a hybrid {host: 2, data: 4} mesh (the
+    multi-slice layout) is still EXACTLY the single-device full-batch
+    fit — pmean over both tiers == one global mean."""
+    from gan_deeplearning4j_tpu.parallel import make_mesh
+
+    x, y = _batch(32)
+    g_single = _small_graph()
+    g_dp = _small_graph()
+    dp = DataParallelGraph(g_dp, mesh=make_mesh({"host": 2, "data": 4}),
+                           axis="data", dcn_axis="host")
+    for _ in range(3):
+        l1 = g_single.fit(x, y)
+        l2 = dp.fit(x, y)
+    np.testing.assert_allclose(float(l1), float(l2), rtol=1e-5)
+    for layer in g_single.params:
+        for name, v in g_single.params[layer].items():
+            np.testing.assert_allclose(
+                np.asarray(v), np.asarray(g_dp.params[layer][name]),
+                rtol=1e-5, atol=1e-6, err_msg=f"{layer}/{name}")
+
+
+def test_two_tier_dcn_every_one_equals_flat(cpu_devices):
+    """A {host: 2, data: 2} two-tier mesh with dcn_every=1 is the SAME
+    protocol as a flat 4-replica mesh: same replica indices, same batch
+    split, every averaging point global."""
+    from gan_deeplearning4j_tpu.parallel import make_mesh
+
+    rng = np.random.RandomState(5)
+    k, B = 4, 32
+    x = {"in": rng.rand(k, B, 6).astype(np.float32)}
+    y = {"out": (rng.rand(k, B, 1) > 0.5).astype(np.float32)}
+
+    g_flat = _small_graph()
+    flat = DataParallelGraph(g_flat, mesh=data_mesh(4),
+                             mode="param_averaging", averaging_frequency=1)
+    flat.fit_batches(x, y)
+
+    g_two = _small_graph()
+    two = DataParallelGraph(g_two, mesh=make_mesh({"host": 2, "data": 2}),
+                            axis="data", dcn_axis="host", dcn_every=1,
+                            mode="param_averaging", averaging_frequency=1)
+    two.fit_batches(x, y)
+
+    for layer in g_flat.params:
+        for name, v in g_flat.params[layer].items():
+            np.testing.assert_allclose(
+                np.asarray(v), np.asarray(g_two.params[layer][name]),
+                rtol=1e-5, atol=1e-6, err_msg=f"{layer}/{name}")
+
+
+def test_two_tier_param_averaging_schedule_matches_manual(cpu_devices):
+    """The hierarchical schedule, pinned against a hand computation on a
+    {host: 2, data: 2} mesh (k=3 batches, avgFreq=1, dcn_every=2):
+    avg-point 1 averages within host only, avg-point 2 would cross DCN
+    (but lands at job end here), job end is a global average."""
+    import gan_deeplearning4j_tpu.runtime.prng as prng
+    from gan_deeplearning4j_tpu.parallel import make_mesh
+
+    rng_np = np.random.RandomState(7)
+    k, B = 2, 32
+    xs = rng_np.rand(k, B, 6).astype(np.float32)
+    ys = (rng_np.rand(k, B, 1) > 0.5).astype(np.float32)
+
+    g_two = _small_graph()
+    two = DataParallelGraph(g_two, mesh=make_mesh({"host": 2, "data": 2}),
+                            axis="data", dcn_axis="host", dcn_every=2,
+                            mode="param_averaging", averaging_frequency=1)
+    rng = jax.random.fold_in(two._step_rng, 1)  # the rng fit_batches uses
+    start_p, start_o = g_two.params, g_two.opt_state
+
+    # manual: 4 replicas, shard s = h*2+d takes batch rows [s*8:(s+1)*8];
+    # after batch 1: average within each host pair {0,1}, {2,3};
+    # job end after batch 2: global average
+    shard = B // 4
+    locs = []
+    for s in range(4):
+        g_r = _small_graph()
+        g_r.params, g_r.opt_state = start_p, start_o
+        r = prng.fold_in_index(rng, s)
+        p, o = g_r.params, g_r.opt_state
+        p, o, _ = g_r._jit_fit(p, o, jax.random.fold_in(r, 0),
+                               {"in": jnp.asarray(xs[0, s*shard:(s+1)*shard])},
+                               {"out": jnp.asarray(ys[0, s*shard:(s+1)*shard])})
+        locs.append((g_r, p, o, r))
+    # within-host averaging (avg point 1: 1 % 2 != 0 -> ICI tier only)
+    for pair in ((0, 1), (2, 3)):
+        avg_p = jax.tree.map(lambda *t: sum(t) / 2.0,
+                             *[locs[s][1] for s in pair])
+        avg_o = jax.tree.map(lambda *t: sum(t) / 2.0,
+                             *[locs[s][2] for s in pair])
+        for s in pair:
+            locs[s] = (locs[s][0], avg_p, avg_o, locs[s][3])
+    # batch 2 + global job-end average
+    finals = []
+    for s in range(4):
+        g_r, p, o, r = locs[s]
+        p, o, _ = g_r._jit_fit(p, o, jax.random.fold_in(r, 1),
+                               {"in": jnp.asarray(xs[1, s*shard:(s+1)*shard])},
+                               {"out": jnp.asarray(ys[1, s*shard:(s+1)*shard])})
+        finals.append(p)
+    want = jax.tree.map(lambda *t: sum(t) / 4.0, *finals)
+
+    two.fit_batches({"in": xs}, {"out": ys})
+    for layer in want:
+        for name, v in want[layer].items():
+            np.testing.assert_allclose(
+                np.asarray(v), np.asarray(g_two.params[layer][name]),
+                rtol=1e-5, atol=1e-6, err_msg=f"{layer}/{name}")
+
+
+def test_hybrid_mesh_virtual_fallback(cpu_devices):
+    """multihost.hybrid_mesh on the 8-virtual-device host: {data: 4} ICI
+    + DCN axis infers 2 slices, shape {host: 2, data: 4}, host-major
+    boundaries on the DCN axis."""
+    from gan_deeplearning4j_tpu.parallel.multihost import hybrid_mesh
+
+    mesh = hybrid_mesh({"data": 4}, "host")
+    assert dict(mesh.shape) == {"host": 2, "data": 4}
+    with pytest.raises(ValueError):
+        DataParallelGraph(_small_graph(), mesh=mesh, axis="data",
+                          dcn_axis="nope")
