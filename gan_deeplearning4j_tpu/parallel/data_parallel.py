@@ -145,9 +145,7 @@ class DataParallelGraph:
         ``leading_dims`` unsharded axes (the fit_batches [num_batches]
         axis) come first.  The ONE source of truth for how batch data
         lays out over the mesh."""
-        replica_axes = ((self.dcn_axis, self.axis) if self.dcn_axis
-                        else self.axis)
-        return P(*([None] * leading_dims), replica_axes)
+        return P(*([None] * leading_dims), self._sync_axes())
 
     def _replica_index(self):
         idx = lax.axis_index(self.axis)

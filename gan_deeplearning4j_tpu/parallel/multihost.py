@@ -77,13 +77,19 @@ def hybrid_mesh(ici_shape: Dict[str, int], dcn_axis: str,
     # real multi-slice hardware: any error (shape not matching the
     # per-slice device count etc.) is a genuine topology error and MUST
     # propagate — a host-major fallback could silently lay the "ICI"
-    # axis across DCN
+    # axis across DCN.  create_hybrid_device_mesh multiplies mesh_shape
+    # and dcn_mesh_shape ELEMENTWISE (same length, same order), so the
+    # DCN tier gets its own leading axis by padding both shapes:
+    # (1, *ici) x (num_slices, 1, ...) -> (num_slices, *ici).
     arr = mesh_utils.create_hybrid_device_mesh(
-        tuple(ici_shape.values()), (num_slices,), devices=devices,
-        process_is_granule=False)
-    # create_hybrid_device_mesh puts DCN axes LAST; ours is first
-    arr = np.moveaxis(arr, -1, 0)
-    return Mesh(arr.reshape(tuple(shape.values())), tuple(shape.keys()))
+        (1,) + tuple(ici_shape.values()),
+        (num_slices,) + (1,) * len(ici_shape),
+        devices=devices, process_is_granule=False)
+    if arr.shape != tuple(shape.values()):  # contract check, not a cast
+        raise ValueError(
+            f"hybrid mesh came back {arr.shape}, wanted "
+            f"{tuple(shape.values())}")
+    return Mesh(arr, tuple(shape.keys()))
 
 
 def global_mesh(shape: Dict[str, int],

@@ -12,6 +12,7 @@ Run: ``python -m gan_deeplearning4j_tpu.train.cv_main --iterations 10000``
 from __future__ import annotations
 
 import argparse
+import os
 from typing import Dict
 
 from gan_deeplearning4j_tpu.data import ensure_mnist_csv
@@ -96,6 +97,9 @@ def main(argv=None) -> Dict[str, float]:
     p.add_argument("--n-test", type=int, default=10000)
     p.add_argument("--profile", default=None, metavar="DIR",
                    help="capture a jax.profiler trace of the run into DIR")
+    p.add_argument("--live-ui", type=int, default=0, metavar="PORT",
+                   help="serve a live loss dashboard over the metrics "
+                        "JSONL on this port (the Spark-web-UI analog)")
     p.add_argument("--fid-samples", type=int, default=10000,
                    help="generator samples for the end-of-run FID "
                         "(0 disables)")
@@ -128,12 +132,25 @@ def main(argv=None) -> Dict[str, float]:
     )
     from gan_deeplearning4j_tpu.utils import maybe_trace
 
-    with maybe_trace(args.profile):
-        trainer, result = run_with_recovery(
-            config,
-            lambda: CVWorkload(n_train=args.n_train, n_test=args.n_test),
-            max_restarts=args.max_restarts)
-    result.update(evaluate(trainer, fid_samples=args.fid_samples))
+    stop_ui = None
+    if args.live_ui:
+        from gan_deeplearning4j_tpu.utils.live_ui import serve_metrics
+
+        stop_ui = serve_metrics(
+            os.path.join(config.res_path,
+                         f"{config.dataset_name}_metrics.jsonl"),
+            port=args.live_ui)
+        print(f"[live-ui] http://127.0.0.1:{stop_ui.port}/", flush=True)
+    try:
+        with maybe_trace(args.profile):
+            trainer, result = run_with_recovery(
+                config,
+                lambda: CVWorkload(n_train=args.n_train, n_test=args.n_test),
+                max_restarts=args.max_restarts)
+        result.update(evaluate(trainer, fid_samples=args.fid_samples))
+    finally:
+        if stop_ui is not None:
+            stop_ui()  # release the port before the JSON line
     import json
 
     # one JSON line (numpy scalars coerced) — machine-consumable, cf.

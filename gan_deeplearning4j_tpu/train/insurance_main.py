@@ -101,6 +101,9 @@ def main(argv=None) -> Dict[str, float]:
                         "up to N times (needs --checkpoint-every)")
     p.add_argument("--profile", default=None, metavar="DIR",
                    help="capture a jax.profiler trace of the run into DIR")
+    p.add_argument("--live-ui", type=int, default=0, metavar="PORT",
+                   help="serve a live loss dashboard over the metrics "
+                        "JSONL on this port (the Spark-web-UI analog)")
     from gan_deeplearning4j_tpu.runtime import backend
 
     backend.add_bf16_flag(p)
@@ -126,10 +129,23 @@ def main(argv=None) -> Dict[str, float]:
     )
     from gan_deeplearning4j_tpu.utils import maybe_trace
 
-    with maybe_trace(args.profile):
-        trainer, result = run_with_recovery(
-            config, InsuranceWorkload, max_restarts=args.max_restarts)
-    result.update(evaluate(trainer))
+    stop_ui = None
+    if args.live_ui:
+        from gan_deeplearning4j_tpu.utils.live_ui import serve_metrics
+
+        stop_ui = serve_metrics(
+            os.path.join(config.res_path,
+                         f"{config.dataset_name}_metrics.jsonl"),
+            port=args.live_ui)
+        print(f"[live-ui] http://127.0.0.1:{stop_ui.port}/", flush=True)
+    try:
+        with maybe_trace(args.profile):
+            trainer, result = run_with_recovery(
+                config, InsuranceWorkload, max_restarts=args.max_restarts)
+        result.update(evaluate(trainer))
+    finally:
+        if stop_ui is not None:
+            stop_ui()  # release the port before the JSON line
     import json
 
     # one JSON line (numpy scalars coerced) — machine-consumable, cf.

@@ -1,0 +1,179 @@
+"""Live training UI — the Spark-web-UI analog (SURVEY.md §5).
+
+The reference gets a free live dashboard from Spark's executor UI
+(dl4jGANComputerVision.java:309 ``local[4]`` master); this framework's
+structured metrics feed (utils/metrics.py JSONL) is richer but was
+post-hoc only (utils/plot_metrics.py).  This module serves it live: a
+stdlib ThreadingHTTPServer on a background daemon thread tails the
+metrics JSONL and renders an auto-refreshing loss dashboard — zero
+dependencies, zero training-thread work (the browser polls; the server
+reads the file the trainer was writing anyway).
+
+Use: ``--live-ui PORT`` on any main, or::
+
+    from gan_deeplearning4j_tpu.utils.live_ui import serve_metrics
+    stop = serve_metrics("outputs/run/mnist_metrics.jsonl", port=8080)
+    ...
+    stop()
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Callable
+
+_PAGE = """<!doctype html>
+<html><head><title>gan4j live metrics</title>
+<style>
+ body { font-family: system-ui, sans-serif; margin: 24px; }
+ #meta { color: #555; margin-bottom: 12px; }
+ canvas { border: 1px solid #ccc; width: 100%; height: 360px; }
+ .key { display: inline-block; margin-right: 16px; }
+ .swatch { display: inline-block; width: 12px; height: 12px;
+           margin-right: 4px; vertical-align: middle; }
+</style></head>
+<body>
+<h2>gan4j live metrics</h2>
+<div id="meta">waiting for data&hellip;</div>
+<div id="legend"></div>
+<canvas id="chart" width="1200" height="360"></canvas>
+<script>
+const COLORS = ["#1f77b4", "#d62728", "#2ca02c", "#9467bd", "#8c564b"];
+async function tick() {
+  try {
+    const r = await fetch("/data");
+    const recs = await r.json();
+    draw(recs);
+  } catch (e) { /* server gone: stop quietly */ }
+  setTimeout(tick, 2000);
+}
+function draw(recs) {
+  if (!recs.length) return;
+  const keys = Object.keys(recs[recs.length - 1]).filter(
+    k => typeof recs[recs.length - 1][k] === "number" &&
+         k.endsWith("loss"));
+  const last = recs[recs.length - 1];
+  document.getElementById("meta").textContent =
+    `step ${last.step}` +
+    (last.examples_per_sec ?
+      ` — ${Math.round(last.examples_per_sec)} img/s` : "") +
+    ` — ${recs.length} records`;
+  const c = document.getElementById("chart");
+  const ctx = c.getContext("2d");
+  ctx.clearRect(0, 0, c.width, c.height);
+  let lo = Infinity, hi = -Infinity;
+  for (const r of recs) for (const k of keys) {
+    if (typeof r[k] === "number") { lo = Math.min(lo, r[k]);
+                                    hi = Math.max(hi, r[k]); }
+  }
+  if (!(hi > lo)) { hi = lo + 1; }
+  const x0 = recs[0].step, x1 = last.step || 1;
+  const px = s => (s - x0) / Math.max(x1 - x0, 1) * (c.width - 40) + 30;
+  const py = v => c.height - 20 -
+                  (v - lo) / (hi - lo) * (c.height - 40);
+  let legend = "";
+  keys.forEach((k, i) => {
+    ctx.strokeStyle = COLORS[i % COLORS.length];
+    ctx.beginPath();
+    let started = false;
+    for (const r of recs) {
+      if (typeof r[k] !== "number") continue;
+      const x = px(r.step), y = py(r[k]);
+      if (started) ctx.lineTo(x, y); else { ctx.moveTo(x, y); started = true; }
+    }
+    ctx.stroke();
+    legend += `<span class="key"><span class="swatch" style=` +
+      `"background:${COLORS[i % COLORS.length]}"></span>${k}</span>`;
+  });
+  document.getElementById("legend").innerHTML = legend;
+  ctx.fillStyle = "#333";
+  ctx.fillText(hi.toFixed(3), 2, 14);
+  ctx.fillText(lo.toFixed(3), 2, c.height - 8);
+}
+tick();
+</script></body></html>
+"""
+
+MAX_POINTS = 2000  # downsample long runs so the payload stays small
+
+
+class _TailCache:
+    """Incremental JSONL tail: each poll parses only appended bytes (a
+    long run's file would otherwise be re-parsed in full every 2s per
+    open tab); a shrunken/replaced file resets the cache."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self.offset = 0
+        self.partial = ""      # torn tail line carried to the next poll
+        self.records: list = []
+
+    def read(self) -> list:
+        try:
+            size = os.stat(self.path).st_size
+        except OSError:
+            return []
+        if size < self.offset:  # truncated/replaced: start over
+            self.offset, self.partial, self.records = 0, "", []
+        if size > self.offset:
+            with open(self.path) as f:
+                f.seek(self.offset)
+                chunk = self.partial + f.read()
+                self.offset = f.tell()
+            lines = chunk.split("\n")
+            self.partial = lines.pop()  # "" on a clean newline boundary
+            for line in lines:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    self.records.append(json.loads(line))
+                except ValueError:
+                    continue  # malformed line: skip
+        records = self.records
+        if len(records) > MAX_POINTS:
+            stride = len(records) // MAX_POINTS + 1
+            # keep the exact last point; avoid double-adding it when the
+            # stride grid already lands on it
+            records = records[:-1][::stride] + records[-1:]
+        return records
+
+
+def serve_metrics(jsonl_path: str, port: int = 8080,
+                  host: str = "127.0.0.1") -> Callable[[], None]:
+    """Start the dashboard server (daemon thread); returns a stop()."""
+
+    cache = _TailCache(jsonl_path)
+    lock = threading.Lock()
+
+    class Handler(BaseHTTPRequestHandler):
+        def do_GET(self):  # noqa: N802 (stdlib API name)
+            if self.path == "/data":
+                with lock:  # ThreadingHTTPServer: one tail per poll
+                    body = json.dumps(cache.read()).encode()
+                ctype = "application/json"
+            else:
+                body = _PAGE.encode()
+                ctype = "text/html; charset=utf-8"
+            self.send_response(200)
+            self.send_header("Content-Type", ctype)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def log_message(self, *args):  # quiet: no stderr per request
+            pass
+
+    server = ThreadingHTTPServer((host, port), Handler)
+    thread = threading.Thread(target=server.serve_forever, daemon=True)
+    thread.start()
+
+    def stop() -> None:
+        server.shutdown()
+        server.server_close()
+
+    stop.port = server.server_address[1]  # resolved port (0 = ephemeral)
+    return stop

@@ -215,3 +215,31 @@ def test_scheduled_wrapper_threads_rate_through_recurrence():
         np.testing.assert_allclose(upd, mu * v - (1 + mu) * v_new, rtol=1e-6)
         v = v_new
     assert st["t"] == 3.0
+
+
+def test_live_ui_serves_dashboard_and_data(tmp_path):
+    """The Spark-web-UI analog (utils/live_ui.py): serves the page and the
+    tailed JSONL as JSON, survives a mid-write partial line, downsamples
+    long runs, and stops cleanly."""
+    import json as json_lib
+    import urllib.request
+
+    from gan_deeplearning4j_tpu.utils.live_ui import serve_metrics
+
+    path = str(tmp_path / "m.jsonl")
+    with open(path, "w") as f:
+        for s in range(1, 5001):
+            f.write(json_lib.dumps({"step": s, "d_loss": 1.0 / s,
+                                    "g_loss": 0.5}) + "\n")
+        f.write('{"step": 5001, "d_l')  # torn tail line mid-write
+    stop = serve_metrics(path, port=0)  # ephemeral port
+    try:
+        base = f"http://127.0.0.1:{stop.port}"
+        page = urllib.request.urlopen(f"{base}/").read().decode()
+        assert "gan4j live metrics" in page
+        recs = json_lib.loads(
+            urllib.request.urlopen(f"{base}/data").read().decode())
+        assert 0 < len(recs) <= 2001          # downsampled
+        assert recs[-1]["step"] == 5000       # torn line skipped
+    finally:
+        stop()
