@@ -134,13 +134,9 @@ def main(argv=None) -> Dict[str, float]:
 
     stop_ui = None
     if args.live_ui:
-        from gan_deeplearning4j_tpu.utils.live_ui import serve_metrics
+        from gan_deeplearning4j_tpu.utils.live_ui import serve_for_config
 
-        stop_ui = serve_metrics(
-            os.path.join(config.res_path,
-                         f"{config.dataset_name}_metrics.jsonl"),
-            port=args.live_ui)
-        print(f"[live-ui] http://127.0.0.1:{stop_ui.port}/", flush=True)
+        stop_ui = serve_for_config(config, args.live_ui)
     try:
         with maybe_trace(args.profile):
             trainer, result = run_with_recovery(

@@ -20,6 +20,7 @@ Use: ``--live-ui PORT`` on any main, or::
 from __future__ import annotations
 
 import json
+import math
 import os
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -130,9 +131,21 @@ class _TailCache:
                 if not line:
                     continue
                 try:
-                    self.records.append(json.loads(line))
+                    rec = json.loads(line)
                 except ValueError:
                     continue  # malformed line: skip
+                # a diverged run writes NaN/Infinity, which json.dumps
+                # would emit as INVALID JSON and permanently blank the
+                # browser's fetch().json() — null them at parse time
+                for k, val in rec.items():
+                    if isinstance(val, float) and not math.isfinite(val):
+                        rec[k] = None
+                self.records.append(rec)
+            if len(self.records) > 2 * MAX_POINTS:
+                # bound the in-process cache too (the trainer hosts this
+                # thread): halve by stride, keeping the exact last point
+                self.records = (self.records[:-1][::2]
+                                + self.records[-1:])
         records = self.records
         if len(records) > MAX_POINTS:
             stride = len(records) // MAX_POINTS + 1
@@ -176,4 +189,15 @@ def serve_metrics(jsonl_path: str, port: int = 8080,
         server.server_close()
 
     stop.port = server.server_address[1]  # resolved port (0 = ephemeral)
+    return stop
+
+
+def serve_for_config(config, port: int) -> Callable[[], None]:
+    """The mains' shared lifecycle: serve the trainer's metrics JSONL
+    (gan_trainer.py's ``{dataset_name}_metrics.jsonl`` path) and announce
+    the URL.  Returns stop() for the caller's finally block."""
+    stop = serve_metrics(
+        os.path.join(config.res_path,
+                     f"{config.dataset_name}_metrics.jsonl"), port=port)
+    print(f"[live-ui] http://127.0.0.1:{stop.port}/", flush=True)
     return stop
