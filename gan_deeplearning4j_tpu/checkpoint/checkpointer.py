@@ -34,11 +34,19 @@ class TrainCheckpointer:
                 )
             arrays = {}
             scalars = {"step": step, "graphs": sorted(graphs.keys())}
+            pytrees = []
             for k, v in (extra or {}).items():
                 if isinstance(v, (int, float, str, bool)) or v is None:
                     scalars[k] = v
+                elif isinstance(v, dict):
+                    # nested param-tree extra (e.g. a generator EMA):
+                    # flattened under its key, rebuilt on restore
+                    pytrees.append(k)
+                    arrays.update(serialization._flatten(v, f"{k}/"))
                 else:
                     arrays[k] = np.asarray(v)
+            if pytrees:
+                scalars["pytree_extras"] = sorted(pytrees)
             with open(os.path.join(tmp, "state.json"), "w") as f:
                 json.dump(scalars, f, indent=1)
             if arrays:
@@ -94,10 +102,18 @@ class TrainCheckpointer:
             loaded = serialization.read_model(os.path.join(path, f"{name}_model.zip"))
             graph.params = loaded.params
             graph.opt_state = loaded.opt_state
+        pytrees = set(scalars.pop("pytree_extras", []))
         extra = {k: v for k, v in scalars.items() if k not in ("step", "graphs")}
         npz_path = os.path.join(path, "state.npz")
         if os.path.exists(npz_path):
+            flat_trees: Dict[str, Dict] = {k: {} for k in pytrees}
             with np.load(npz_path) as z:
                 for k in z.files:
-                    extra[k] = z[k]
+                    root = k.split("/", 1)[0]
+                    if root in pytrees:
+                        flat_trees[root][k.split("/", 1)[1]] = z[k]
+                    else:
+                        extra[k] = z[k]
+            for k, flat in flat_trees.items():
+                extra[k] = serialization._unflatten(flat)
         return scalars["step"], extra

@@ -180,8 +180,10 @@ def _flatten(tree: Dict, prefix: str = "") -> Dict[str, np.ndarray]:
 
 
 def _unflatten(flat) -> Dict:
+    """Inverse of ``_flatten``; accepts an ``np.load`` handle (``.files``)
+    or a plain {key: array} mapping."""
     tree: Dict = {}
-    for key in flat.files:
+    for key in (flat.files if hasattr(flat, "files") else flat):
         parts = key.split("/")
         d = tree
         for p in parts[:-1]:

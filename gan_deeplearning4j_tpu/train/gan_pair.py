@@ -188,7 +188,8 @@ class GANPair:
     def make_multistep(self, table_x, table_cond=None, *,
                        batch_size: int, steps_per_call: int,
                        n_critic: int = 1, real_label: float = 1.0,
-                       z_size: int, seed_key=None, ema_decay: float = 0.0):
+                       z_size: int, seed_key=None, ema_decay: float = 0.0,
+                       start_step: int = 0):
         """Fused multi-iteration training: ONE jitted program advances
         ``steps_per_call`` full (n_critic D-steps + 1 G-step) iterations
         via ``lax.scan``, with the dataset device-resident and batches
@@ -312,9 +313,12 @@ class GANPair:
             return jit_multi(state, *invariants)
 
         ema0 = ema_lib.ema_init(self.gen) if ema_decay else None
+        # ``start_step`` seeds the carry's iteration counter, which drives
+        # the counter-based z/batch draws (fold_in(key0, it)) — a resumed
+        # run continues the EXACT stream a straight-through run would use
         state0 = (self.gen.params, self.gen.opt_state,
                   self.dis.params, self.dis.opt_state,
-                  jnp.asarray(0, jnp.int32), ema0)
+                  jnp.asarray(start_step, jnp.int32), ema0)
         return step_fn, state0
 
     def adopt_state(self, state) -> None:

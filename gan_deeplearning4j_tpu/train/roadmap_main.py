@@ -24,6 +24,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from gan_deeplearning4j_tpu.graph import serialization
 from gan_deeplearning4j_tpu.runtime import prng
 from gan_deeplearning4j_tpu.train.gan_pair import GANPair
 from gan_deeplearning4j_tpu.utils import (
@@ -109,6 +110,7 @@ def _data(family: str, n: int, seed: int, sample_shape=None,
 def train(family: str, iterations: int, batch_size: int, res_path: str,
           n_train: int, print_every: int, n_devices=None,
           data_dir: str = None, ema_decay: float = 0.0,
+          checkpoint_every: int = 0, resume: bool = False,
           log=print) -> Dict[str, float]:
     os.makedirs(res_path, exist_ok=True)
     mesh = None
@@ -127,7 +129,6 @@ def train(family: str, iterations: int, batch_size: int, res_path: str,
 
     root = prng.root_key(cfg.seed)
     z_key = prng.stream(root, "roadmap-z")
-    metrics = MetricsLogger(os.path.join(res_path, f"{family}_metrics.jsonl"))
     # fixed evaluation grid (8x8) like the reference's latent-grid dumps;
     # drawn from the TRAINING latent law U[-1,1] (a normal draw would put
     # ~1/3 of components outside the trained support and misrepresent
@@ -181,15 +182,59 @@ def train(family: str, iterations: int, batch_size: int, res_path: str,
             MAX_STEPS_PER_CALL,
         )
 
+        ckpt = None
+        start_it = 0
+        if checkpoint_every or resume:
+            from gan_deeplearning4j_tpu.checkpoint import TrainCheckpointer
+
+            ckpt = TrainCheckpointer(os.path.join(res_path,
+                                                  f"{family}_ckpt"))
+            if resume and ckpt.latest_step() is not None:
+                start_it, extra = ckpt.restore(
+                    {"gen": pair.gen, "dis": pair.dis})
+                if "ema" in extra:
+                    if not ema_decay:
+                        raise ValueError(
+                            "checkpoint carries a generator EMA but "
+                            "--ema-decay is 0: pass the original decay "
+                            "(resuming without it would freeze the EMA "
+                            "and mislabel the final gen_ema artifacts)")
+                    pair.gen.ema_params = extra["ema"]
+                log(f"[{family}] resumed from checkpoint at "
+                    f"iteration {start_it}")
+
+        # the resumed run APPENDS to its own metrics history rather than
+        # truncating the pre-crash records
+        metrics = MetricsLogger(
+            os.path.join(res_path, f"{family}_metrics.jsonl"),
+            append=start_it > 0)
+
         g = math.gcd(math.gcd(iterations, print_every), 100)
+        if checkpoint_every:
+            g = math.gcd(g, checkpoint_every)  # chunks end on ckpt points
+        if start_it:
+            # chunks must also tile [start_it, iterations] exactly, even
+            # when this run's flags differ from the pre-crash run's
+            g = math.gcd(g, start_it)
         K = max(d for d in range(1, min(MAX_STEPS_PER_CALL, g) + 1)
                 if g % d == 0)
+
+        def save_ckpt(it: int) -> None:
+            # EMA rides as a pytree extra (write_model only carries
+            # params+updater); the counter-based z stream makes saved-RNG
+            # state unnecessary (start_step seeds the draws)
+            extra = {}
+            ema = getattr(pair.gen, "ema_params", None)
+            if ema is not None:
+                extra["ema"] = ema
+            ckpt.save(it, {"gen": pair.gen, "dis": pair.dis}, extra=extra)
+
         step_fn, state = pair.make_multistep(
             jnp.asarray(x), None if y is None else jnp.asarray(y),
             batch_size=batch_size, steps_per_call=K, n_critic=n_critic,
             real_label=real_label, z_size=cfg.z_size,
-            seed_key=z_key, ema_decay=ema_decay)
-        it = 0
+            seed_key=z_key, ema_decay=ema_decay, start_step=start_it)
+        it = start_it
         while it < iterations:
             state, (dl, gl) = step_fn(state)
             if steady_t0 is None:
@@ -211,6 +256,11 @@ def train(family: str, iterations: int, batch_size: int, res_path: str,
             if it % print_every == 0 or it >= iterations:
                 pair.adopt_state(state)
                 dump_samples(it)
+            if ckpt is not None and checkpoint_every \
+                    and it % checkpoint_every == 0:
+                pair.adopt_state(state)
+                dumper.flush()  # pending artifacts land before the ckpt
+                save_ckpt(it)
         pair.adopt_state(state)
         iterations = it
         if getattr(pair.gen, "ema_params", None) is not None:
@@ -226,8 +276,6 @@ def train(family: str, iterations: int, batch_size: int, res_path: str,
     steps_timed = iterations - steady_start if steady_t0 is not None else 0
     wall = (time.perf_counter() - steady_t0) if steady_t0 is not None else 0.0
     metrics.flush()
-    from gan_deeplearning4j_tpu.graph import serialization
-
     for name, graph in (("gen", pair.gen), ("dis", pair.dis)):
         serialization.write_model(
             graph, os.path.join(res_path, f"{family}_{name}_model.zip"))
@@ -266,6 +314,11 @@ def main(argv=None) -> Dict[str, float]:
                    help="directory of real images (class subdirs for the "
                         "conditional family) instead of the synthetic "
                         "surrogate")
+    p.add_argument("--checkpoint-every", type=int, default=0,
+                   help="periodic atomic checkpoints every N iterations "
+                        "(aligned to scan chunks)")
+    p.add_argument("--resume", action="store_true",
+                   help="resume from the latest checkpoint in res-path")
     p.add_argument("--ema-decay", type=float, default=0.0,
                    help="generator weight EMA decay (e.g. 0.999): the "
                         "final sample grid is also rendered from the "
@@ -279,7 +332,9 @@ def main(argv=None) -> Dict[str, float]:
     res = args.res_path or os.path.join("outputs", args.family)
     result = train(args.family, args.iterations, args.batch_size, res,
                    args.n_train, args.print_every, args.n_devices,
-                   data_dir=args.data_dir, ema_decay=args.ema_decay)
+                   data_dir=args.data_dir, ema_decay=args.ema_decay,
+                   checkpoint_every=args.checkpoint_every,
+                   resume=args.resume)
     import json
 
     # one JSON line (numpy scalars coerced) — machine-consumable, cf.

@@ -20,7 +20,7 @@ from typing import Dict, List, Optional
 
 class MetricsLogger:
     def __init__(self, path: Optional[str] = None, flush_every: int = 100,
-                 ring_size: int = 10000):
+                 ring_size: int = 10000, append: bool = False):
         self.path = path
         self.flush_every = flush_every
         self._pending: List[Dict] = []
@@ -30,8 +30,10 @@ class MetricsLogger:
         self._last_step_t = self._t0
         if path:
             os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
-            # truncate: one file per run
-            open(path, "w").close()
+            if not append:
+                # truncate: one file per run (``append=True`` = a resumed
+                # run continuing its own history)
+                open(path, "w").close()
 
     def log_step(self, step: int, examples: int = 0, **metrics) -> None:
         """Record one step.  ``metrics`` values may be jax.Arrays — they are
