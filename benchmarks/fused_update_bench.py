@@ -1,0 +1,131 @@
+"""On-chip microbenchmark: Pallas one-pass RmsProp chain vs stock XLA.
+
+The RESULTS r2 §4 profile put the updater's elementwise chain
+(`multiply_subtract_fusion`) at 61ms/300 steps; ops/pallas/fused_update.py
+is the hand-fused attack.  This measures the isolated chain per leaf
+shape — the flagship models' big dense/conv weights — XLA vs Pallas, with
+the same scan-chained readback-fenced methodology as pallas_bn_bench.py
+(dispatch latency over the tunnel would otherwise swamp the kernel).
+
+The chain is HBM-bandwidth bound (read p,g,cache; write p',cache' = 5N
+floats), so the expected ceiling is bytes/bandwidth; the reported
+``bound_us`` column is that floor on v5e (819 GB/s) for calibration.
+
+Usage: python benchmarks/fused_update_bench.py [--iters 200] [--json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import statistics
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from gan_deeplearning4j_tpu.ops.pallas.fused_update import fused_rmsprop_chain
+from gan_deeplearning4j_tpu.optim.rmsprop import rmsprop_update_leaf
+
+# the flagship protocol's biggest gradient-bearing leaves
+SHAPES = [
+    (1152, 1024),    # dis dense W (28^2 chain -> 1024)
+    (3200, 6272),    # gen dense W
+    (128, 64, 5, 5),  # dis conv2 W
+    (1024, 10),      # classifier head
+]
+LR, RHO, EPS, L2, CLIP = 0.0002, 1e-8, 1e-8, 1e-4, 1.0
+HBM_BW = 819e9  # v5e
+
+
+def _xla_chain(p, g, c):
+    g = jnp.clip(g + L2 * p, -CLIP, CLIP)
+    upd, c2 = rmsprop_update_leaf(g, c, LR, RHO, EPS)
+    return p - upd, c2
+
+
+INTERPRET = False  # set by --interpret (CPU correctness drive, not perf)
+
+
+def _pallas_chain(p, g, c):
+    return fused_rmsprop_chain(p, g, c, lr=LR, rho=RHO, eps=EPS, l2=L2,
+                               clip=CLIP, interpret=INTERPRET)
+
+
+def _time_chain(fn, p, g, c, iters: int) -> float:
+    """Per-application seconds: ``iters`` chained applications inside one
+    jitted scan (p,c feed back; g fixed), fenced by a scalar readback."""
+
+    def body(carry, _):
+        p, c = carry
+        p2, c2 = fn(p, g, c)
+        return (p2, c2), ()
+
+    @jax.jit
+    def run(p, c):
+        (p2, c2), _ = lax.scan(body, (p, c), None, length=iters)
+        return p2.reshape(-1)[0] + c2.reshape(-1)[0]
+
+    float(run(p, c))  # compile
+    ts = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        float(run(p, c))  # the readback IS the fence
+        ts.append((time.perf_counter() - t0) / iters)
+    return statistics.median(ts)
+
+
+def main(argv=None) -> None:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--iters", type=int, default=200)
+    ap.add_argument("--json", action="store_true")
+    ap.add_argument("--interpret", action="store_true",
+                    help="interpret the Pallas kernel (CPU flow check; "
+                         "timings are then meaningless)")
+    ap.add_argument("--smoke", action="store_true",
+                    help="one tiny shape only (CPU flow check)")
+    args = ap.parse_args(argv)
+    global INTERPRET, SHAPES
+    INTERPRET = args.interpret
+    if args.smoke:
+        SHAPES = [(64, 130)]
+
+    rng = np.random.RandomState(0)
+    rows = []
+    for shape in SHAPES:
+        p = jnp.asarray(rng.randn(*shape).astype(np.float32))
+        g = jnp.asarray(rng.randn(*shape).astype(np.float32))
+        c = jnp.asarray(np.abs(rng.randn(*shape)).astype(np.float32))
+        n = p.size
+        xla_s = _time_chain(_xla_chain, p, g, c, args.iters)
+        pal_s = _time_chain(_pallas_chain, p, g, c, args.iters)
+        rows.append({
+            "shape": list(shape),
+            "elements": n,
+            "xla_us": round(xla_s * 1e6, 2),
+            "pallas_us": round(pal_s * 1e6, 2),
+            "bound_us": round(5 * 4 * n / HBM_BW * 1e6, 2),
+            "pallas_vs_xla": round(xla_s / pal_s, 3),
+        })
+    if args.json:
+        print(json.dumps(rows))
+    else:
+        print(f"{'shape':>18} {'xla_us':>8} {'pallas_us':>10} "
+              f"{'bound_us':>9} {'speedup':>8}")
+        for r in rows:
+            print(f"{str(tuple(r['shape'])):>18} {r['xla_us']:>8} "
+                  f"{r['pallas_us']:>10} {r['bound_us']:>9} "
+                  f"{r['pallas_vs_xla']:>8}")
+
+
+if __name__ == "__main__":
+    from gan_deeplearning4j_tpu.runtime import backend
+
+    backend.apply_env_platform()
+    main()
