@@ -31,41 +31,65 @@ OUT_DIR = os.path.join(_REPO, "outputs", "tpu_queue_r3")
 
 def run_stage(name: str, cmd: list, timeout_s: float, summary: dict) -> bool:
     """Run one stage; capture tail + last JSON line; False on failure."""
+    import signal
+
     log_path = os.path.join(OUT_DIR, f"{name}.log")
     t0 = time.perf_counter()
+    # own process group: a timeout must kill the stage's GRANDCHILDREN too
+    # (bench.py spawns the real benchmark as a subprocess) or an orphan
+    # keeps holding the chip while later stages probe against it
+    proc = subprocess.Popen([sys.executable] + cmd, cwd=_REPO,
+                            stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+                            text=True, start_new_session=True)
     try:
-        out = subprocess.run([sys.executable] + cmd, cwd=_REPO,
-                             capture_output=True, text=True,
-                             timeout=timeout_s)
+        stdout, stderr = proc.communicate(timeout=timeout_s)
+        timed_out = False
     except subprocess.TimeoutExpired:
-        summary[name] = {"ok": False, "error": f"timeout >{timeout_s:.0f}s"}
-        return False
+        os.killpg(proc.pid, signal.SIGKILL)
+        stdout, stderr = proc.communicate()
+        timed_out = True
     with open(log_path, "w") as f:
-        f.write(out.stdout + "\n--- stderr ---\n" + out.stderr)
-    rec: dict = {"ok": out.returncode == 0,
+        f.write((stdout or "") + "\n--- stderr ---\n" + (stderr or ""))
+    rec: dict = {"ok": (not timed_out) and proc.returncode == 0,
                  "wall_s": round(time.perf_counter() - t0, 1)}
-    for line in reversed(out.stdout.strip().splitlines()):
+    for line in reversed((stdout or "").strip().splitlines()):
         try:
-            rec["result"] = json.loads(line)
-            break
+            parsed = json.loads(line)
         except ValueError:
             continue
-    if out.returncode != 0:
-        rec["error"] = out.stderr.strip().splitlines()[-1:]
+        if isinstance(parsed, dict):  # the result object, not a stray scalar
+            rec["result"] = parsed
+            break
+    if timed_out:
+        rec["error"] = f"timeout >{timeout_s:.0f}s (partial log kept)"
+    elif proc.returncode != 0:
+        rec["error"] = (stderr or "").strip().splitlines()[-1:]
+    elif isinstance(rec.get("result"), dict) and rec["result"].get("skipped"):
+        # bench.py's exit-0 structured-skip contract: rc 0 but NOT a
+        # measurement — never report it as a successful stage
+        rec["ok"] = False
+        rec["error"] = "stage self-skipped (tunnel down mid-stage)"
     summary[name] = rec
     print(f"[queue] {name}: ok={rec['ok']} wall={rec['wall_s']}s",
           flush=True)
     return rec["ok"]
 
 
-def probe_ok(timeout_s: float) -> bool:
-    try:
-        platform, rt = probe_device(timeout_s, cwd=_REPO)
-        print(f"[queue] probe: {platform} {rt:.1f}ms", flush=True)
-        return platform not in ("cpu",)
-    except RuntimeError as e:
-        print(f"[queue] probe failed: {e}", flush=True)
-        return False
+def probe_ok(timeout_s: float, attempts: int = 2,
+             backoff_s: float = 30.0) -> bool:
+    """Bounded retry: one blip must not skip a stage (the wedged-tunnel
+    fast path is handled by the caller's consecutive-failure counter)."""
+    for attempt in range(1, attempts + 1):
+        try:
+            platform, rt = probe_device(timeout_s, cwd=_REPO)
+            print(f"[queue] probe: {platform} {rt:.1f}ms", flush=True)
+            return platform not in ("cpu",)
+        except RuntimeError as e:
+            print(f"[queue] probe failed ({attempt}/{attempts}): {e}",
+                  flush=True)
+            if attempt < attempts:
+                time.sleep(backoff_s)
+    return False
 
 
 def main(argv=None) -> dict:
@@ -80,10 +104,10 @@ def main(argv=None) -> dict:
         ("acceptance",
          ["benchmarks/acceptance.py", "--out-dir", "outputs/acceptance_r3"],
          7200),
-        ("bench_baseline", ["bench.py", "--skip-e2e"], 1800),
-        ("bench_s2d", ["bench.py", "--skip-e2e", "--s2d"], 1800),
+        ("bench_baseline", ["bench.py", "--skip-e2e"], 3600),
+        ("bench_s2d", ["bench.py", "--skip-e2e", "--s2d"], 3600),
         ("bench_pallas_updater",
-         ["bench.py", "--skip-e2e", "--pallas-updater"], 1800),
+         ["bench.py", "--skip-e2e", "--pallas-updater"], 3600),
         ("fused_update_bench",
          ["benchmarks/fused_update_bench.py", "--json"], 1800),
         ("pallas_bn_bench",
