@@ -87,23 +87,12 @@ def _log(msg: str) -> None:
 
 
 def probe_with_retry():
-    """Probe with bounded retry/backoff (a wedged tunnel often recovers
-    within minutes).  Returns (platform, rt_ms) or raises RuntimeError
-    carrying every attempt's reason."""
-    reasons = []
-    for attempt in range(1, PROBE_ATTEMPTS + 1):
-        try:
-            platform, rt_ms = probe_device(PROBE_TIMEOUT, cwd=REPO)
-            _log(f"probe ok (attempt {attempt}): platform={platform} "
-                 f"round-trip {rt_ms:.1f}ms")
-            return platform, rt_ms
-        except RuntimeError as e:
-            reasons.append(f"attempt {attempt}: {e}")
-            _log(reasons[-1])
-            if attempt < PROBE_ATTEMPTS:
-                _log(f"backing off {PROBE_BACKOFF:.0f}s before re-probe")
-                time.sleep(PROBE_BACKOFF)
-    raise RuntimeError("; ".join(reasons))
+    """The shared bounded retry loop (utils/probe.py) at this entry's
+    env-configured knobs."""
+    from gan_deeplearning4j_tpu.utils.probe import probe_with_retry as p
+
+    return p(PROBE_TIMEOUT, cwd=REPO, attempts=PROBE_ATTEMPTS,
+             backoff_s=PROBE_BACKOFF, log=_log)
 
 
 def _emit(payload: dict) -> int:

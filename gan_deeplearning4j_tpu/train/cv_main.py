@@ -97,6 +97,12 @@ def main(argv=None) -> Dict[str, float]:
     p.add_argument("--n-test", type=int, default=10000)
     p.add_argument("--profile", default=None, metavar="DIR",
                    help="capture a jax.profiler trace of the run into DIR")
+    from gan_deeplearning4j_tpu.runtime import prng as _prng
+
+    p.add_argument("--seed", type=int, default=_prng.NUMBER_OF_THE_BEAST,
+                   help="model-init + training-stream seed (default: the "
+                        "reference's 666; the DATASET keeps its own fixed "
+                        "seed, so variance runs share identical data)")
     p.add_argument("--live-ui", type=int, default=0, metavar="PORT",
                    help="serve a live loss dashboard over the metrics "
                         "JSONL on this port (the Spark-web-UI analog)")
@@ -129,6 +135,7 @@ def main(argv=None) -> Dict[str, float]:
         steps_per_call=args.steps_per_call,
         async_dumps=not args.sync_dumps,
         ema_decay=args.ema_decay,
+        seed=args.seed,
     )
     from gan_deeplearning4j_tpu.utils import maybe_trace
 
@@ -141,7 +148,8 @@ def main(argv=None) -> Dict[str, float]:
         with maybe_trace(args.profile):
             trainer, result = run_with_recovery(
                 config,
-                lambda: CVWorkload(n_train=args.n_train, n_test=args.n_test),
+                lambda: CVWorkload(cfg=M.CVConfig(seed=args.seed),
+                               n_train=args.n_train, n_test=args.n_test),
                 max_restarts=args.max_restarts)
         result.update(evaluate(trainer, fid_samples=args.fid_samples))
     finally:

@@ -101,6 +101,12 @@ def main(argv=None) -> Dict[str, float]:
                         "up to N times (needs --checkpoint-every)")
     p.add_argument("--profile", default=None, metavar="DIR",
                    help="capture a jax.profiler trace of the run into DIR")
+    from gan_deeplearning4j_tpu.runtime import prng as _prng
+
+    p.add_argument("--seed", type=int, default=_prng.NUMBER_OF_THE_BEAST,
+                   help="model-init + training-stream seed (default: the "
+                        "reference's 666; the DATASET keeps its own fixed "
+                        "seed, so variance runs share identical data)")
     p.add_argument("--live-ui", type=int, default=0, metavar="PORT",
                    help="serve a live loss dashboard over the metrics "
                         "JSONL on this port (the Spark-web-UI analog)")
@@ -126,6 +132,7 @@ def main(argv=None) -> Dict[str, float]:
         resume=args.resume,
         steps_per_call=args.steps_per_call,
         async_dumps=not args.sync_dumps,
+        seed=args.seed,
     )
     from gan_deeplearning4j_tpu.utils import maybe_trace
 
@@ -137,7 +144,10 @@ def main(argv=None) -> Dict[str, float]:
     try:
         with maybe_trace(args.profile):
             trainer, result = run_with_recovery(
-                config, InsuranceWorkload, max_restarts=args.max_restarts)
+                config,
+                lambda: InsuranceWorkload(
+                    cfg=M.InsuranceConfig(seed=args.seed)),
+                max_restarts=args.max_restarts)
         result.update(evaluate(trainer))
     finally:
         if stop_ui is not None:

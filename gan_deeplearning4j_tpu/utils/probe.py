@@ -12,6 +12,7 @@ from __future__ import annotations
 import json
 import subprocess
 import sys
+import time
 
 # One small dispatch + readback; prints a single JSON line with the chosen
 # platform and the measured round trip.  Honors an explicit JAX_PLATFORMS
@@ -29,6 +30,32 @@ for _ in range(5): np.asarray(f(x))
 print(json.dumps({"platform": jax.default_backend(),
                   "rt_ms": (time.perf_counter() - t0) * 200}))
 """
+
+
+def probe_with_retry(timeout_s: float, cwd: str | None = None,
+                     attempts: int = 3, backoff_s: float = 45.0,
+                     log=None):
+    """``probe_device`` with bounded retry/backoff (a wedged tunnel often
+    recovers within minutes).  Returns (platform, rt_ms) or raises
+    RuntimeError carrying every attempt's reason — the ONE retry loop
+    shared by every driver-facing entry."""
+    reasons = []
+    for attempt in range(1, attempts + 1):
+        try:
+            platform, rt_ms = probe_device(timeout_s, cwd=cwd)
+            if log:
+                log(f"probe ok (attempt {attempt}): platform={platform} "
+                    f"round-trip {rt_ms:.1f}ms")
+            return platform, rt_ms
+        except RuntimeError as e:
+            reasons.append(f"attempt {attempt}: {e}")
+            if log:
+                log(reasons[-1])
+            if attempt < attempts:
+                if log:
+                    log(f"backing off {backoff_s:.0f}s before re-probe")
+                time.sleep(backoff_s)
+    raise RuntimeError("; ".join(reasons))
 
 
 def probe_device(timeout_s: float, cwd: str | None = None):
