@@ -234,6 +234,16 @@ class GANTrainer:
             # silently inherit this host's resolution)
             config = dataclasses.replace(config, n_devices=resolved)
             self.c = config
+        elif config.n_devices > 1 and \
+                config.batch_size % config.n_devices != 0:
+            # an EXPLICIT mesh size must divide the batch too — fail here
+            # with the constraint, not deep in a device_put
+            usable = max(d for d in range(1, config.n_devices + 1)
+                         if config.batch_size % d == 0)
+            raise ValueError(
+                f"batch_size {config.batch_size} is not divisible by "
+                f"--n-devices {config.n_devices}; shards are exact "
+                f"(largest usable mesh for this batch: {usable})")
         # PRNG streams (seed 666 discipline; see runtime/prng.py).  The
         # training z-stream is COUNTER-BASED — z1 under fold_in(base, 2i),
         # z2 under fold_in(base, 2i+1) for step i — so the fused step can
