@@ -138,6 +138,11 @@ class Workload:
         return []
 
 
+def _largest_batch_divisor(batch_size: int, limit: int) -> int:
+    """Largest mesh size <= limit whose shards of ``batch_size`` are exact."""
+    return max(d for d in range(1, limit + 1) if batch_size % d == 0)
+
+
 def train_with_recovery(make_trainer: Callable[[bool], "GANTrainer"],
                         max_restarts: int = 2,
                         log: Callable[[str], None] = print) -> Dict[str, float]:
@@ -203,6 +208,16 @@ class GANTrainer:
     def __init__(self, workload: Workload, config: GANTrainerConfig):
         self.w = workload
         self.c = config
+        if config.n_devices is not None and config.n_devices > 1 \
+                and config.batch_size % config.n_devices != 0:
+            # an EXPLICIT mesh size must divide the batch — fail before
+            # ANY side effect (no res dir, no graph construction)
+            usable = _largest_batch_divisor(config.batch_size,
+                                             config.n_devices)
+            raise ValueError(
+                f"batch_size {config.batch_size} is not divisible by "
+                f"--n-devices {config.n_devices}; shards are exact "
+                f"(largest usable mesh for this batch: {usable})")
         os.makedirs(config.res_path, exist_ok=True)
 
         graphs = workload.build_graphs()
@@ -220,9 +235,7 @@ class GANTrainer:
         # pads partitions; we keep shards exact instead).
         if config.n_devices is None:
             avail = len(jax.devices())
-            resolved = max(
-                d for d in range(1, avail + 1) if config.batch_size % d == 0
-            )
+            resolved = _largest_batch_divisor(config.batch_size, avail)
             if resolved < avail:
                 import logging
 
@@ -234,16 +247,7 @@ class GANTrainer:
             # silently inherit this host's resolution)
             config = dataclasses.replace(config, n_devices=resolved)
             self.c = config
-        elif config.n_devices > 1 and \
-                config.batch_size % config.n_devices != 0:
-            # an EXPLICIT mesh size must divide the batch too — fail here
-            # with the constraint, not deep in a device_put
-            usable = max(d for d in range(1, config.n_devices + 1)
-                         if config.batch_size % d == 0)
-            raise ValueError(
-                f"batch_size {config.batch_size} is not divisible by "
-                f"--n-devices {config.n_devices}; shards are exact "
-                f"(largest usable mesh for this batch: {usable})")
+
         # PRNG streams (seed 666 discipline; see runtime/prng.py).  The
         # training z-stream is COUNTER-BASED — z1 under fold_in(base, 2i),
         # z2 under fold_in(base, 2i+1) for step i — so the fused step can

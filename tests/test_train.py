@@ -267,14 +267,16 @@ def test_chunked_metrics_match_per_step(tmp_path):
             assert a[key] == b[key], (a["step"], key)
 
 
-def test_explicit_mesh_must_divide_batch():
+def test_explicit_mesh_must_divide_batch(tmp_path):
     """An explicit --n-devices that doesn't divide the batch fails fast
-    with the constraint named, not deep in a device_put."""
+    with the constraint named, BEFORE any side effect (no results dir,
+    no graph construction)."""
     from gan_deeplearning4j_tpu.train import insurance_main
     from gan_deeplearning4j_tpu.train.gan_trainer import GANTrainer
 
+    res = str(tmp_path / "never_created")
     config = insurance_main.default_config(
-        num_iterations=2, batch_size=50, res_path="/tmp/unused",
-        n_devices=4)
+        num_iterations=2, batch_size=50, res_path=res, n_devices=4)
     with pytest.raises(ValueError, match="not divisible by --n-devices"):
         GANTrainer(insurance_main.InsuranceWorkload(), config)
+    assert not os.path.exists(res)  # genuinely fail-fast
