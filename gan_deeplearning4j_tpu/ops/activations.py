@@ -50,6 +50,60 @@ def softmax(x):
     return jax.nn.softmax(x, axis=-1)
 
 
+# -- the rest of DL4J's standard Activation enum (beyond what the
+# reference's graphs exercise), for drop-in config parity ----------------
+
+
+def hardtanh(x):
+    return jnp.clip(x, -1.0, 1.0)
+
+
+def hardsigmoid(x):
+    # DL4J/Theano convention: clip(0.2*x + 0.5, 0, 1)
+    return jnp.clip(0.2 * x + 0.5, 0.0, 1.0)
+
+
+def softplus(x):
+    return jax.nn.softplus(x)
+
+
+def softsign(x):
+    return jax.nn.soft_sign(x)
+
+
+def cube(x):
+    return x ** 3
+
+
+def rational_tanh(x):
+    """DL4J's RATIONALTANH: 1.7159 * tanh_approx(2x/3), with the rational
+    tanh approximation of Anguita et al. (libnd4j's convention)."""
+    y = 2.0 * x / 3.0
+    ay = jnp.abs(y)
+    approx = 1.0 - 1.0 / (1.0 + ay + ay ** 2 + 1.41645 * ay ** 4)
+    return 1.7159 * jnp.sign(y) * approx
+
+
+def selu(x):
+    return jax.nn.selu(x)
+
+
+def swish(x):
+    return jax.nn.silu(x)  # x*sigmoid(x) — DL4J's SWISH
+
+
+def gelu(x):
+    return jax.nn.gelu(x)
+
+
+def relu6(x):
+    return jnp.clip(x, 0.0, 6.0)
+
+
+def thresholded_relu(x, theta: float = 1.0):
+    return jnp.where(x > theta, x, 0.0)
+
+
 _REGISTRY: dict[str, Activation] = {
     "identity": identity,
     "tanh": tanh,
@@ -58,6 +112,17 @@ _REGISTRY: dict[str, Activation] = {
     "relu": relu,
     "leakyrelu": leaky_relu,
     "softmax": softmax,
+    "hardtanh": hardtanh,
+    "hardsigmoid": hardsigmoid,
+    "softplus": softplus,
+    "softsign": softsign,
+    "cube": cube,
+    "rationaltanh": rational_tanh,
+    "selu": selu,
+    "swish": swish,
+    "gelu": gelu,
+    "relu6": relu6,
+    "thresholdedrelu": thresholded_relu,
 }
 
 

@@ -82,11 +82,70 @@ def gradient_penalty(critic_fn, real: jax.Array, fake: jax.Array,
     return jnp.mean((norms - 1.0) ** 2)
 
 
+# -- the rest of DL4J's standard LossFunctions enum (beyond what the
+# reference's graphs exercise), same sum-over-units mean-over-batch
+# convention --------------------------------------------------------------
+
+
+def l1(pred: jax.Array, target: jax.Array) -> jax.Array:
+    return jnp.mean(jnp.sum(jnp.abs(pred - target), axis=-1))
+
+
+def negative_log_likelihood(probs: jax.Array, labels: jax.Array) -> jax.Array:
+    """DL4J NEGATIVELOGLIKELIHOOD == MCXENT on probability outputs."""
+    return mcxent(probs, labels)
+
+
+def hinge(pred: jax.Array, labels: jax.Array) -> jax.Array:
+    """Labels in {-1, +1} (DL4J's convention)."""
+    return jnp.mean(jnp.sum(jnp.maximum(0.0, 1.0 - labels * pred), axis=-1))
+
+
+def squared_hinge(pred: jax.Array, labels: jax.Array) -> jax.Array:
+    return jnp.mean(jnp.sum(
+        jnp.maximum(0.0, 1.0 - labels * pred) ** 2, axis=-1))
+
+
+def kl_divergence(probs: jax.Array, target: jax.Array) -> jax.Array:
+    """KL(target || probs) — DL4J's KL_DIVERGENCE (reconstruction form)."""
+    t = jnp.clip(target, _EPS, 1.0)
+    p = jnp.clip(probs, _EPS, 1.0)
+    return jnp.mean(jnp.sum(t * (jnp.log(t) - jnp.log(p)), axis=-1))
+
+
+def poisson(pred: jax.Array, target: jax.Array) -> jax.Array:
+    """DL4J POISSON: sum(pred - target*log(pred))."""
+    p = jnp.clip(pred, _EPS, None)
+    return jnp.mean(jnp.sum(p - target * jnp.log(p), axis=-1))
+
+
+def cosine_proximity(pred: jax.Array, target: jax.Array) -> jax.Array:
+    """DL4J COSINE_PROXIMITY: -cos(pred, target) per example."""
+    pn = pred / (jnp.linalg.norm(pred, axis=-1, keepdims=True) + _EPS)
+    tn = target / (jnp.linalg.norm(target, axis=-1, keepdims=True) + _EPS)
+    return jnp.mean(-jnp.sum(pn * tn, axis=-1))
+
+
+def mean_absolute_percentage_error(pred, target) -> jax.Array:
+    return jnp.mean(jnp.sum(
+        100.0 * jnp.abs((target - pred) / jnp.clip(jnp.abs(target), _EPS)),
+        axis=-1))
+
+
 _REGISTRY = {
     "xent": binary_xent,
     "mcxent": mcxent,
     "mse": mse,
     "wasserstein": wasserstein,
+    "l1": l1,
+    "l2": mse,                      # DL4J aliases L2 to squared error
+    "negativeloglikelihood": negative_log_likelihood,
+    "hinge": hinge,
+    "squared_hinge": squared_hinge,
+    "kl_divergence": kl_divergence,
+    "poisson": poisson,
+    "cosine_proximity": cosine_proximity,
+    "mape": mean_absolute_percentage_error,
 }
 
 

@@ -268,3 +268,67 @@ def test_conv_s2d_rewrite_matches_reference():
     np.testing.assert_array_equal(
         np.asarray(same),
         np.asarray(conv_ops.conv2d(x1, w1, None, stride=(1, 1))))
+
+
+def test_extended_activation_set_values():
+    """The full DL4J Activation enum surface, hand-derived values."""
+    from gan_deeplearning4j_tpu.ops import activations as A
+
+    x = jnp.asarray([-2.0, -0.5, 0.0, 0.5, 2.0])
+    np.testing.assert_allclose(A.get("hardtanh")(x), [-1, -0.5, 0, 0.5, 1])
+    np.testing.assert_allclose(A.get("hardsigmoid")(x),
+                               [0.1, 0.4, 0.5, 0.6, 0.9], rtol=1e-6)
+    np.testing.assert_allclose(A.get("softplus")(jnp.asarray([0.0])),
+                               [np.log(2.0)], rtol=1e-6)
+    np.testing.assert_allclose(A.get("softsign")(x), np.asarray(x)
+                               / (1 + np.abs(np.asarray(x))), rtol=1e-6)
+    np.testing.assert_allclose(A.get("cube")(x), np.asarray(x) ** 3)
+    np.testing.assert_allclose(A.get("relu6")(jnp.asarray([7.0, 3.0, -1.0])),
+                               [6.0, 3.0, 0.0])
+    np.testing.assert_allclose(
+        A.get("thresholdedrelu")(jnp.asarray([0.5, 1.5])), [0.0, 1.5])
+    # rationaltanh approximates 1.7159*tanh(2x/3) (loose tolerance: it IS
+    # an approximation — libnd4j's own formula)
+    np.testing.assert_allclose(
+        A.get("rationaltanh")(x), 1.7159 * np.tanh(2 * np.asarray(x) / 3),
+        atol=0.12)
+    for name in ("selu", "swish", "gelu"):
+        v = A.get(name)(x)
+        assert np.isfinite(np.asarray(v)).all(), name
+
+
+def test_extended_loss_set_values():
+    """The full DL4J LossFunctions enum surface, hand-derived values
+    (sum over units, mean over batch — DL4J's scoring convention)."""
+    from gan_deeplearning4j_tpu.ops import losses as L
+
+    p = jnp.asarray([[0.8, 0.2], [0.4, 0.6]])
+    t = jnp.asarray([[1.0, 0.0], [0.0, 1.0]])
+    np.testing.assert_allclose(
+        L.get("l1")(p, t), np.mean([0.2 + 0.2, 0.4 + 0.4]), rtol=1e-6)
+    np.testing.assert_allclose(L.get("l2")(p, t),
+                               np.mean([0.04 + 0.04, 0.16 + 0.16]), rtol=1e-6)
+    np.testing.assert_allclose(
+        L.get("negativeloglikelihood")(p, t),
+        -np.mean([np.log(0.8), np.log(0.6)]), rtol=1e-5)
+    y = jnp.asarray([[1.0], [-1.0]])
+    s = jnp.asarray([[0.5], [0.5]])
+    np.testing.assert_allclose(L.get("hinge")(s, y),
+                               np.mean([0.5, 1.5]), rtol=1e-6)
+    np.testing.assert_allclose(L.get("squared_hinge")(s, y),
+                               np.mean([0.25, 2.25]), rtol=1e-6)
+    # KL(t||p) = 0 when t == p
+    np.testing.assert_allclose(L.get("kl_divergence")(p, p), 0.0, atol=1e-6)
+    assert float(L.get("kl_divergence")(p, t)) > 0.0
+    np.testing.assert_allclose(
+        L.get("poisson")(p, t),
+        np.mean([(0.8 - np.log(0.8)) + 0.2, 0.4 + (0.6 - np.log(0.6))]),
+        rtol=1e-5)
+    # cosine proximity: identical directions -> -1 per example
+    np.testing.assert_allclose(L.get("cosine_proximity")(t, t), -1.0,
+                               rtol=1e-5)
+    # every registered loss is differentiable (autodiff composes)
+    for name in ("l1", "hinge", "kl_divergence", "poisson",
+                 "cosine_proximity", "mape"):
+        g = jax.grad(lambda a: L.get(name)(a, t))(p)
+        assert np.isfinite(np.asarray(g)).all(), name
