@@ -111,7 +111,7 @@ def train(family: str, iterations: int, batch_size: int, res_path: str,
           n_train: int, print_every: int, n_devices=None,
           data_dir: str = None, ema_decay: float = 0.0,
           checkpoint_every: int = 0, resume: bool = False,
-          log=print) -> Dict[str, float]:
+          steps_per_call: int = None, log=print) -> Dict[str, float]:
     os.makedirs(res_path, exist_ok=True)
     mesh = None
     if n_devices and n_devices > 1:
@@ -216,8 +216,8 @@ def train(family: str, iterations: int, batch_size: int, res_path: str,
             # chunks must also tile [start_it, iterations] exactly, even
             # when this run's flags differ from the pre-crash run's
             g = math.gcd(g, start_it)
-        K = max(d for d in range(1, min(MAX_STEPS_PER_CALL, g) + 1)
-                if g % d == 0)
+        cap = min(MAX_STEPS_PER_CALL, steps_per_call or MAX_STEPS_PER_CALL)
+        K = max(d for d in range(1, min(cap, g) + 1) if g % d == 0)
 
         def save_ckpt(it: int) -> None:
             # EMA rides as a pytree extra (write_model only carries
@@ -314,6 +314,10 @@ def main(argv=None) -> Dict[str, float]:
                    help="directory of real images (class subdirs for the "
                         "conditional family) instead of the synthetic "
                         "surrogate")
+    p.add_argument("--steps-per-call", type=int, default=None,
+                   help="cap on lax.scan iterations per XLA dispatch "
+                        "(None = auto, up to 100; use a small value on "
+                        "CPU hosts where big scanned chunks stall)")
     p.add_argument("--checkpoint-every", type=int, default=0,
                    help="periodic atomic checkpoints every N iterations "
                         "(aligned to scan chunks)")
@@ -334,7 +338,7 @@ def main(argv=None) -> Dict[str, float]:
                    args.n_train, args.print_every, args.n_devices,
                    data_dir=args.data_dir, ema_decay=args.ema_decay,
                    checkpoint_every=args.checkpoint_every,
-                   resume=args.resume)
+                   resume=args.resume, steps_per_call=args.steps_per_call)
     import json
 
     # one JSON line (numpy scalars coerced) — machine-consumable, cf.
