@@ -15,7 +15,8 @@ Regenerate (only if the recipe version bumps):
     python -m gan_deeplearning4j_tpu.eval.fid_extractor
 which retrains deterministically and overwrites the asset; the recipe
 version is embedded in the filename so a stale asset cannot be loaded
-silently.
+silently.  (Verified: a from-scratch retrain reproduces the committed
+v1 asset bit-for-bit on the CPU backend, 2026-07-31.)
 
 The feature layer is the 256-wide penultimate dense ("feat"), the
 classifier-feature FID convention (same role as the reference evaluation
