@@ -15,6 +15,6 @@ Layer map (reference SURVEY.md §1 -> this package):
   L7 the two mains       -> train/ (cv_main, insurance_main)
 """
 
-__version__ = "0.1.0"
+__version__ = "0.3.0"
 
 from gan_deeplearning4j_tpu.runtime import backend  # noqa: F401
