@@ -17,9 +17,11 @@ from gan_deeplearning4j_tpu.parallel.mesh import (
     shard_batch,
 )
 from gan_deeplearning4j_tpu.parallel.data_parallel import DataParallelGraph
+from gan_deeplearning4j_tpu.parallel.inference import ParallelInference
 
 __all__ = [
     "DataParallelGraph",
+    "ParallelInference",
     "batch_sharding",
     "data_mesh",
     "make_mesh",

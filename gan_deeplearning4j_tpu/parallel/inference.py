@@ -1,0 +1,106 @@
+"""Parallel inference — the ``ParallelInference`` / ``ParallelWrapper``
+inference path of DL4J's parallel-wrapper module (`dl4jGAN.iml:366`, on the
+reference classpath, dormant in the mains).
+
+DL4J's design is worker-centric: N mutable model replicas pinned to N
+devices, a request queue, and a batching thread that fuses queued inputs
+into per-replica batches.  All of that machinery exists because its
+replicas are stateful objects.  The TPU-native version is ONE jitted SPMD
+program: parameters live replicated on the mesh, the batch dimension is
+sharded over the ``data`` axis, and XLA fans the same forward pass out
+across every chip in lockstep — no queue, no replica copies, no
+per-worker state to keep coherent.
+
+Exactness: inference mode uses BN running stats and disables dropout, so
+there is no cross-batch reduction anywhere in the forward pass — each row's
+output is computed by exactly the same op sequence as on one device, and
+sharded output == single-device output (proven in
+``tests/test_parallel_inference.py``).
+
+Uneven batches are zero-padded up to a multiple of the mesh axis (DL4J's
+batching thread pads queued requests the same way) and sliced back before
+returning.  ``max_batch`` bounds the per-dispatch global batch — the
+analog of ParallelInference's ``batchLimit`` — by splitting oversized
+inputs into sequential dispatches.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import List, Optional
+
+import jax
+import jax.numpy as jnp
+
+from gan_deeplearning4j_tpu.parallel.mesh import (
+    batch_sharding,
+    data_mesh,
+    replicated,
+)
+
+
+class ParallelInference:
+    """Batch-sharded SPMD inference over a mesh for a ``ComputationGraph``.
+
+    Parameters are placed replicated once at construction; call
+    ``refresh_params()`` after further training to re-snapshot them.
+    """
+
+    def __init__(self, graph, mesh=None, axis: str = "data",
+                 max_batch: Optional[int] = None):
+        self.graph = graph
+        self.mesh = mesh if mesh is not None else data_mesh()
+        self.axis = axis
+        if axis not in self.mesh.axis_names:
+            raise ValueError(f"mesh has no axis {axis!r}: {self.mesh.axis_names}")
+        if max_batch is not None and max_batch < self.mesh.shape[axis]:
+            raise ValueError(
+                f"max_batch={max_batch} below the mesh axis size "
+                f"{self.mesh.shape[axis]} — every dispatch needs one row per shard")
+        self.max_batch = max_batch
+        self._n = self.mesh.shape[axis]
+        self._rep = replicated(self.mesh)
+        self._batch_sh = batch_sharding(self.mesh, axis)
+        self._jit = jax.jit(functools.partial(graph._forward_outputs, train=False))
+        self._params = None
+        self.refresh_params()
+
+    def refresh_params(self) -> None:
+        """Snapshot the graph's current params onto the mesh (replicated)."""
+        self._params = jax.device_put(self.graph.params, self._rep)
+
+    # -- the SPMD dispatch ---------------------------------------------------
+
+    def _dispatch(self, xs, pad_to: Optional[int] = None) -> List[jax.Array]:
+        """One SPMD forward.  ``pad_to`` fixes the dispatch shape (the
+        chunked path pads every chunk to ``max_batch`` so the program
+        compiles once); otherwise pad to the next mesh-axis multiple."""
+        b = xs[0].shape[0]
+        pad = (pad_to - b) if pad_to is not None else (-b) % self._n
+        placed = {}
+        for name, x in zip(self.graph.input_names, xs):
+            x = jnp.asarray(x)
+            if pad:
+                # pad on device — no host round trip for committed arrays
+                x = jnp.pad(x, [(0, pad)] + [(0, 0)] * (x.ndim - 1))
+            placed[name] = jax.device_put(x, self._batch_sh)
+        outs = self._jit(self._params, placed)
+        return [o[:b] for o in outs] if pad else list(outs)
+
+    def output(self, *xs: jax.Array) -> List[jax.Array]:
+        """Inference forward, batch fanned out over the mesh — the drop-in
+        parallel counterpart of ``ComputationGraph.output`` (same return
+        shape: one array per output layer)."""
+        if not xs:
+            raise ValueError("output() needs at least one input array")
+        b = xs[0].shape[0]
+        if self.max_batch is None or b <= self.max_batch:
+            return self._dispatch(xs)
+        chunks = []
+        for lo in range(0, b, self.max_batch):
+            chunks.append(self._dispatch(
+                [x[lo:lo + self.max_batch] for x in xs],
+                pad_to=self.max_batch))
+        return [jnp.concatenate(parts) for parts in zip(*chunks)]
+
+    __call__ = output

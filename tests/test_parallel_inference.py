@@ -1,0 +1,79 @@
+"""ParallelInference: SPMD batch-sharded inference == single-device output.
+
+The DL4J parallel-wrapper inference path (`dl4jGAN.iml:366`) re-expressed
+as one sharded XLA program (parallel/inference.py).  Inference mode has no
+cross-batch reductions (running-stat BN, no dropout), so the sharded
+forward must match the plain ``graph.output`` to a few ulps: mathematically
+identical per row, but XLA codegens the partitioned program separately and
+may tile the in-row conv/GEMM reductions differently (measured max diff
+6e-8 on the f32 discriminator).  Covered: batches that don't divide the
+mesh (padding), batches smaller than the mesh axis, chunked dispatch.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from gan_deeplearning4j_tpu.models import dcgan_mnist as M
+from gan_deeplearning4j_tpu.parallel import data_mesh
+from gan_deeplearning4j_tpu.parallel.inference import ParallelInference
+
+
+def _assert_ulp_close(a, b):
+    np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                               rtol=2e-6, atol=2e-7)
+
+
+@pytest.fixture(scope="module")
+def dis():
+    return M.build_discriminator()
+
+
+def _x(n, seed=0):
+    return jnp.asarray(
+        np.random.RandomState(seed).rand(n, 784).astype(np.float32))
+
+
+def test_matches_single_device(cpu_devices, dis):
+    x = _x(16)
+    ref = dis.output(x)[0]
+    par = ParallelInference(dis, mesh=data_mesh(8)).output(x)[0]
+    _assert_ulp_close(ref, par)
+
+
+def test_uneven_and_tiny_batches(cpu_devices, dis):
+    pi = ParallelInference(dis, mesh=data_mesh(8))
+    for n in (10, 3, 1, 8):  # non-divisible, below-mesh, single row, exact
+        x = _x(n, seed=n)
+        _assert_ulp_close(dis.output(x)[0], pi.output(x)[0])
+
+
+def test_max_batch_chunking(cpu_devices, dis):
+    x = _x(40)
+    whole = ParallelInference(dis, mesh=data_mesh(8)).output(x)[0]
+    chunked = ParallelInference(dis, mesh=data_mesh(8), max_batch=16).output(x)[0]
+    _assert_ulp_close(whole, chunked)
+    with pytest.raises(ValueError):
+        ParallelInference(dis, mesh=data_mesh(8), max_batch=4)
+
+
+def test_generator_4d_output(cpu_devices):
+    gen = M.build_generator()
+    z = jnp.asarray(
+        np.random.RandomState(7).rand(12, 2).astype(np.float32) * 2 - 1)
+    ref = gen.output(z)[0]
+    par = ParallelInference(gen, mesh=data_mesh(8)).output(z)[0]
+    assert par.shape == ref.shape
+    _assert_ulp_close(ref, par)
+
+
+def test_refresh_params_tracks_training(cpu_devices, dis):
+    pi = ParallelInference(dis, mesh=data_mesh(8))
+    x = _x(8, seed=3)
+    before = np.asarray(pi.output(x)[0])
+    y = jnp.asarray((np.random.RandomState(4).rand(8, 1) > 0.5).astype(np.float32))
+    dis.fit(x, y)
+    # stale snapshot until refreshed — then matches the trained graph
+    np.testing.assert_array_equal(before, np.asarray(pi.output(x)[0]))  # same snapshot, same program: bitwise
+    pi.refresh_params()
+    _assert_ulp_close(dis.output(x)[0], pi.output(x)[0])
