@@ -278,10 +278,16 @@ def main(argv=None) -> None:
     from gan_deeplearning4j_tpu.runtime import backend
 
     backend.add_bf16_flag(p)
-    p.add_argument("--s2d", action="store_true",
-                   help="space-to-depth rewrite of the C_in=1 first conv "
-                        "(exact reindexing; ops/conv.py) — the RESULTS r2 "
-                        "§4 MFU-sink attack, measured A/B with this flag")
+    s2d = p.add_mutually_exclusive_group()
+    s2d.add_argument("--s2d", dest="s2d", action="store_true", default=None,
+                     help="force ON the space-to-depth rewrite of the "
+                          "C_in=1 first conv (exact reindexing; "
+                          "ops/conv.py).  Default: auto — on for TPU "
+                          "(measured +5%% multistep, RESULTS r3), off on "
+                          "CPU")
+    s2d.add_argument("--no-s2d", dest="s2d", action="store_false",
+                     help="force OFF the space-to-depth rewrite (the A/B "
+                          "baseline on TPU)")
     p.add_argument("--pallas-updater", action="store_true",
                    help="Pallas one-pass RmsProp update chain for big "
                         "leaves (ops/pallas/fused_update.py)")
@@ -355,6 +361,7 @@ def main(argv=None) -> None:
         # keyed on what RAN, not on the flag: --bf16 on a CPU-only host
         # still reports the f32 baseline
         "dtype": "bf16" if measured_bf16 else "f32",
+        "conv_s2d": backend.conv_s2d_enabled(),
     }
     if baseline:
         out["vs_baseline"] = round(value / baseline, 3)

@@ -90,7 +90,7 @@ def conv2d(
     accumulates partial products in f32 internally."""
     from gan_deeplearning4j_tpu.runtime import backend
 
-    if backend.config().conv_s2d and _s2d_eligible(x, w, stride, padding):
+    if backend.conv_s2d_enabled() and _s2d_eligible(x, w, stride, padding):
         x, w = _space_to_depth_rewrite(x, w)
         stride, padding = (1, 1), (0, 0)
     orig_dtype = x.dtype

@@ -68,9 +68,11 @@ class RuntimeConfig:
     # space-to-depth rewrite of C_in=1 stride-2 convs (ops/conv.py): an
     # exact reindexing that densifies the MXU contraction of the first
     # conv (the profiled 1/8-utilized contraction, RESULTS r2 §4).
-    # Opt-in: summation order changes, so numerics differ by float
-    # rounding from the reference path.
-    conv_s2d: bool = False
+    # None = auto: ON where there is an MXU (TPU — measured +5% multistep
+    # throughput, RESULTS r3), OFF on CPU so reference-numerics tests see
+    # the reference summation order.  True/False force it either way; only
+    # float summation order changes in any case.
+    conv_s2d: Optional[bool] = None
     # seed 666 everywhere ("numberOfTheBeast", dl4jGANComputerVision.java:68).
     seed: int = 666
 
@@ -102,6 +104,26 @@ def configure(**kwargs) -> RuntimeConfig:
 
 def config() -> RuntimeConfig:
     return _config
+
+
+def conv_s2d_enabled() -> bool:
+    """Resolve the tri-state ``conv_s2d`` flag (see RuntimeConfig): an
+    explicit setting wins; auto (None) enables the rewrite exactly where
+    the MXU makes it pay — i.e. not on the CPU backend.
+
+    Auto keys on the device the op will actually run on BY DEFAULT, not
+    just the process-wide backend: a ``with jax.default_device(cpu)``
+    scope on a TPU host (bench.py's CPU-baseline measurement) must see
+    the reference summation order, so an active default_device wins over
+    ``jax.default_backend()``."""
+    if _config.conv_s2d is not None:
+        return _config.conv_s2d
+    dev = getattr(jax.config, "jax_default_device", None)
+    if dev is not None:
+        platform = dev if isinstance(dev, str) else getattr(dev, "platform", None)
+        if platform:
+            return platform != "cpu"
+    return jax.default_backend() != "cpu"
 
 
 def default_dtype() -> np.dtype:

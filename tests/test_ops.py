@@ -260,7 +260,7 @@ def test_conv_s2d_rewrite_matches_reference():
         w1 = jnp.asarray(rng.randn(4, 3, 3, 3).astype(np.float32))
         same = conv_ops.conv2d(x1, w1, None, stride=(1, 1))
     finally:
-        backend.configure(conv_s2d=False)
+        backend.configure(conv_s2d=None)  # back to auto (off on CPU)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                rtol=1e-5, atol=1e-5)
     np.testing.assert_allclose(np.asarray(out_g), np.asarray(ref_g),
@@ -268,6 +268,34 @@ def test_conv_s2d_rewrite_matches_reference():
     np.testing.assert_array_equal(
         np.asarray(same),
         np.asarray(conv_ops.conv2d(x1, w1, None, stride=(1, 1))))
+
+
+def test_conv_s2d_auto_resolution():
+    """Tri-state default: auto (None) disables the rewrite on the CPU
+    backend (reference summation order for every numerics test) and an
+    explicit setting wins either way."""
+    from gan_deeplearning4j_tpu.runtime import backend
+
+    import jax
+
+    assert backend.config().conv_s2d is None  # the shipped default
+    assert backend.conv_s2d_enabled() is False  # tests run on CPU
+    try:
+        backend.configure(conv_s2d=True)
+        assert backend.conv_s2d_enabled() is True
+        # an active default_device scope must win over the process
+        # backend under auto (bench.py's CPU-baseline pattern) ...
+        backend.configure(conv_s2d=None)
+        with jax.default_device(jax.devices("cpu")[0]):
+            assert backend.conv_s2d_enabled() is False
+        # ... but never over an explicit setting
+        backend.configure(conv_s2d=True)
+        with jax.default_device(jax.devices("cpu")[0]):
+            assert backend.conv_s2d_enabled() is True
+        backend.configure(conv_s2d=False)
+        assert backend.conv_s2d_enabled() is False
+    finally:
+        backend.configure(conv_s2d=None)
 
 
 def test_extended_activation_set_values():
