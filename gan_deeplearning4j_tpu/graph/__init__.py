@@ -19,6 +19,7 @@ from gan_deeplearning4j_tpu.graph.preprocessors import (  # noqa: F401
     CnnToFeedForward,
     FeedForwardToCnn,
 )
+from gan_deeplearning4j_tpu.graph.keras_import import import_keras  # noqa: F401
 from gan_deeplearning4j_tpu.graph.serialization import read_model, write_model  # noqa: F401
 from gan_deeplearning4j_tpu.graph.transfer import (  # noqa: F401
     FineTuneConfiguration,
