@@ -239,6 +239,7 @@ class ComputationGraph:
         self.score: float = float("nan")
         self._step_rng = prng.stream(prng.root_key(seed), "graph-step")
         self._step_count = 0
+        self.listeners: List = []  # DL4J TrainingListener surface
         self._jit_infer = jax.jit(functools.partial(self._forward_outputs, train=False))
         self._jit_fit = jax.jit(self._train_step)
         self._jit_score = jax.jit(self._score)
@@ -378,7 +379,21 @@ class ComputationGraph:
             self.params, self.opt_state, rng, inputs, label_map
         )
         self.score = loss
+        for listener in self.listeners:
+            listener.iteration_done(self, self._step_count, loss)
         return loss
+
+    def set_listeners(self, *listeners) -> "ComputationGraph":
+        """DL4J ``setListeners`` (replaces): listeners get
+        ``iteration_done(model, iteration, score)`` after each eager
+        ``fit``; score arrives as a device scalar (see utils/listeners.py
+        for the readback-cost contract)."""
+        self.listeners = list(listeners)
+        return self
+
+    def add_listeners(self, *listeners) -> "ComputationGraph":
+        self.listeners.extend(listeners)
+        return self
 
     # -- param access (the GAN protocol's weight-sync surface) ---------------
 

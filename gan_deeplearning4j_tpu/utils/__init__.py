@@ -5,8 +5,16 @@ from gan_deeplearning4j_tpu.utils.device import (
     overlap_device_get,
     start_host_copy,
 )
+from gan_deeplearning4j_tpu.utils.listeners import (
+    CollectScoresListener,
+    PerformanceListener,
+    ScoreIterationListener,
+    TrainingListener,
+)
 from gan_deeplearning4j_tpu.utils.metrics import MetricsLogger
 from gan_deeplearning4j_tpu.utils.profiling import maybe_trace, summarize_trace
 
 __all__ = ["MetricsLogger", "maybe_trace", "summarize_trace",
-           "device_fence", "overlap_device_get", "start_host_copy"]
+           "device_fence", "overlap_device_get", "start_host_copy",
+           "TrainingListener", "ScoreIterationListener",
+           "PerformanceListener", "CollectScoresListener"]

@@ -1,0 +1,89 @@
+"""Training listeners — DL4J's ``TrainingListener`` attachment surface.
+
+DL4J models expose ``setListeners(new ScoreIterationListener(100), ...)``
+and call ``iterationDone(model, iteration, epoch)`` after every fit.  The
+reference attaches none (SURVEY.md §5: "no DL4J listeners ... attached"),
+so this is migration surface, not protocol parity.
+
+DELIBERATE signature difference: the third ``iteration_done`` argument
+is the step's SCORE, not DL4J's epoch — the GAN protocol is a single
+pass over iterations (epoch would always be 0), and the score is what
+every shipped DL4J listener immediately re-reads from the model anyway.
+A ported listener that used the epoch argument must be adapted.
+
+TPU-aware contract: ``iteration_done`` receives the SCORE AS A DEVICE
+SCALAR.  Converting it (``float(score)``) forces a host readback and
+serializes the dispatch pipeline, so the shipped listeners only
+materialize the score at their reporting boundary (every
+``print_every``/``frequency`` iterations) — attach-and-forget stays
+cheap.  Listeners fire on the eager ``ComputationGraph.fit`` path; the
+scan-fused multistep trainers report through `utils.metrics` chunk
+records instead (one stacked array per dispatch), which is the same
+information without a per-step host sync.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, List, Optional, Tuple
+
+
+class TrainingListener:
+    """Base: override ``iteration_done``.  ``model`` is the graph that
+    just stepped, ``score`` its loss as a device scalar."""
+
+    def iteration_done(self, model, iteration: int, score) -> None:
+        raise NotImplementedError
+
+
+class ScoreIterationListener(TrainingListener):
+    """DL4J ScoreIterationListener: log the score every N iterations."""
+
+    def __init__(self, print_every: int = 10,
+                 log: Callable[[str], None] = print):
+        self.print_every = max(1, print_every)
+        self.log = log
+
+    def iteration_done(self, model, iteration: int, score) -> None:
+        if iteration % self.print_every == 0:
+            self.log(f"Score at iteration {iteration} is {float(score)}")
+
+
+class PerformanceListener(TrainingListener):
+    """DL4J PerformanceListener: iterations/sec (and examples/sec when
+    the listener can see a batch size) every N iterations."""
+
+    def __init__(self, frequency: int = 10, batch_size: Optional[int] = None,
+                 log: Callable[[str], None] = print):
+        self.frequency = max(1, frequency)
+        self.batch_size = batch_size
+        self.log = log
+        # baseline at attach time so the FIRST eligible iteration already
+        # reports (its window includes compile time, as DL4J's does)
+        self._last: Tuple[int, float] = (0, time.perf_counter())
+
+    def iteration_done(self, model, iteration: int, score) -> None:
+        if iteration % self.frequency:
+            return
+        now = time.perf_counter()
+        it0, t0 = self._last
+        dt = max(now - t0, 1e-9)
+        rate = (iteration - it0) / dt
+        msg = f"iteration {iteration}: {rate:.1f} it/s"
+        if self.batch_size:
+            msg += f", {rate * self.batch_size:.1f} examples/s"
+        self.log(msg)
+        self._last = (iteration, now)
+
+
+class CollectScoresListener(TrainingListener):
+    """DL4J CollectScoresIterationListener: record (iteration, score)
+    pairs every N iterations (each record is a host readback)."""
+
+    def __init__(self, frequency: int = 1):
+        self.frequency = max(1, frequency)
+        self.scores: List[Tuple[int, float]] = []
+
+    def iteration_done(self, model, iteration: int, score) -> None:
+        if iteration % self.frequency == 0:
+            self.scores.append((iteration, float(score)))

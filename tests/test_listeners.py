@@ -1,0 +1,72 @@
+"""TrainingListener surface: DL4J's setListeners/iterationDone contract.
+
+The reference attaches no listeners (SURVEY.md §5), so these tests pin
+the migration surface itself: firing cadence, score values matching the
+returned losses, and the replace-vs-append semantics.
+"""
+
+import numpy as np
+import pytest
+
+from gan_deeplearning4j_tpu.graph import (
+    Dense,
+    GraphBuilder,
+    InputSpec,
+    Output,
+)
+from gan_deeplearning4j_tpu.optim.rmsprop import RmsProp
+from gan_deeplearning4j_tpu.utils import (
+    CollectScoresListener,
+    PerformanceListener,
+    ScoreIterationListener,
+)
+
+
+def _graph():
+    lr = RmsProp(0.01, 1e-8, 1e-8)
+    b = GraphBuilder(seed=666, activation="tanh")
+    b.add_inputs("in")
+    b.set_input_types(InputSpec.feed_forward(4))
+    b.add_layer("h", Dense(n_out=8, updater=lr), "in")
+    b.add_layer("out", Output(n_out=1, loss="xent", activation="sigmoid",
+                              updater=lr), "h")
+    b.set_outputs("out")
+    return b.build().init()
+
+
+def _batch(seed=0):
+    rng = np.random.RandomState(seed)
+    return (rng.rand(16, 4).astype(np.float32),
+            (rng.rand(16, 1) > 0.5).astype(np.float32))
+
+
+def test_collect_scores_matches_fit_returns():
+    g = _graph()
+    collect = CollectScoresListener(frequency=1)
+    g.set_listeners(collect)
+    x, y = _batch()
+    losses = [float(g.fit(x, y)) for _ in range(5)]
+    assert [s for _, s in collect.scores] == pytest.approx(losses)
+    assert [i for i, _ in collect.scores] == [1, 2, 3, 4, 5]
+
+
+def test_score_listener_cadence_and_replace_semantics():
+    g = _graph()
+    lines = []
+    g.set_listeners(ScoreIterationListener(print_every=2, log=lines.append))
+    x, y = _batch(1)
+    for _ in range(4):
+        g.fit(x, y)
+    assert len(lines) == 2 and "iteration 2" in lines[0]
+
+    # set_listeners REPLACES (DL4J semantic); add_listeners appends
+    collect = CollectScoresListener(frequency=2)
+    g.set_listeners(collect)
+    perf_lines = []
+    g.add_listeners(PerformanceListener(frequency=1, batch_size=16,
+                                        log=perf_lines.append))
+    g.fit(x, y)
+    g.fit(x, y)
+    assert len(collect.scores) == 1  # iterations 5,6 -> one at 6
+    # perf reports from its FIRST eligible iteration (baseline = attach time)
+    assert len(perf_lines) == 2 and "examples/s" in perf_lines[0]
