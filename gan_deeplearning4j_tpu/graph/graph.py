@@ -383,6 +383,38 @@ class ComputationGraph:
             listener.iteration_done(self, self._step_count, loss)
         return loss
 
+    def evaluate(self, iterator, num_classes: Optional[int] = None):
+        """DL4J ``ComputationGraph.evaluate(DataSetIterator)``: sweep the
+        iterator in inference mode and accumulate a confusion-matrix
+        ``Evaluation`` (eval/evaluation.py).  The iterator is reset
+        before and after, like DL4J.  ``num_classes`` defaults to the
+        label width (binary for a single sigmoid column)."""
+        from gan_deeplearning4j_tpu.eval.evaluation import Evaluation
+
+        iterator.reset()
+        evaluation = None
+        for ds in iterator:
+            preds = self.output(ds.features)[0]
+            if evaluation is None:
+                # class count: explicit > one-hot label width > model
+                # output width (covers class-id label columns for
+                # multi-class models) > binary sigmoid column
+                y = ds.labels
+                if num_classes:
+                    n = num_classes
+                elif y.ndim == 2 and y.shape[1] > 1:
+                    n = y.shape[1]
+                elif preds.ndim == 2 and preds.shape[1] > 1:
+                    n = preds.shape[1]
+                else:
+                    n = 2
+                evaluation = Evaluation(n)
+            evaluation.eval(ds.labels, preds)
+        iterator.reset()
+        if evaluation is None:
+            raise ValueError("iterator produced no batches")
+        return evaluation
+
     def set_listeners(self, *listeners) -> "ComputationGraph":
         """DL4J ``setListeners`` (replaces): listeners get
         ``iteration_done(model, iteration, score)`` after each eager

@@ -58,15 +58,21 @@ class PerformanceListener(TrainingListener):
         self.frequency = max(1, frequency)
         self.batch_size = batch_size
         self.log = log
-        # baseline at attach time so the FIRST eligible iteration already
-        # reports (its window includes compile time, as DL4J's does)
-        self._last: Tuple[int, float] = (0, time.perf_counter())
+        self._last: Optional[Tuple[int, float]] = None
 
     def iteration_done(self, model, iteration: int, score) -> None:
+        now = time.perf_counter()
+        if self._last is None:
+            # baseline at the first OBSERVED step (not iteration 0):
+            # attaching to an already-trained graph must not fold the
+            # unobserved history into the first window's rate
+            self._last = (iteration, now)
+            return
         if iteration % self.frequency:
             return
-        now = time.perf_counter()
         it0, t0 = self._last
+        if iteration == it0:
+            return
         dt = max(now - t0, 1e-9)
         rate = (iteration - it0) / dt
         msg = f"iteration {iteration}: {rate:.1f} it/s"

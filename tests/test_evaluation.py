@@ -243,3 +243,91 @@ def test_live_ui_serves_dashboard_and_data(tmp_path):
         assert recs[-1]["step"] == 5000       # torn line skipped
     finally:
         stop()
+
+
+def test_graph_evaluate_iterator():
+    """DL4J ``ComputationGraph.evaluate(DataSetIterator)``: the sweep
+    must equal a manual whole-set argmax accuracy, reset the iterator
+    both sides, and handle the binary sigmoid-column case."""
+    import numpy as np
+
+    from gan_deeplearning4j_tpu.data.csv import RecordReaderDataSetIterator
+    from gan_deeplearning4j_tpu.graph import (
+        Dense, GraphBuilder, InputSpec, Output)
+    from gan_deeplearning4j_tpu.optim.rmsprop import RmsProp
+
+    rng = np.random.RandomState(0)
+    table = np.concatenate(
+        [rng.rand(30, 4).astype(np.float32),
+         rng.randint(0, 3, size=(30, 1)).astype(np.float32)], axis=1)
+
+    lr = RmsProp(0.01, 1e-8, 1e-8)
+    b = GraphBuilder(seed=666, activation="tanh")
+    b.add_inputs("in")
+    b.set_input_types(InputSpec.feed_forward(4))
+    b.add_layer("out", Output(n_out=3, loss="mcxent", activation="softmax",
+                              updater=lr), "in")
+    b.set_outputs("out")
+    g = b.build().init()
+
+    it = RecordReaderDataSetIterator(table, batch_size=8, label_index=4,
+                                     num_classes=3)
+    it.next()  # a dirty cursor must not shorten the sweep (DL4J resets)
+    ev = g.evaluate(it)
+    want = np.mean(
+        np.argmax(np.asarray(g.output(table[:, :4])[0]), axis=1)
+        == table[:, 4].astype(np.int64))
+    assert ev.accuracy() == want
+    assert it.has_next()  # reset after the sweep
+
+    # binary sigmoid column (insurance path): num_classes defaults to 2
+    tbl2 = np.concatenate(
+        [rng.rand(20, 4).astype(np.float32),
+         (rng.rand(20, 1) > 0.5).astype(np.float32)], axis=1)
+    b2 = GraphBuilder(seed=666, activation="tanh")
+    b2.add_inputs("in")
+    b2.set_input_types(InputSpec.feed_forward(4))
+    b2.add_layer("out", Output(n_out=1, loss="xent", activation="sigmoid",
+                               updater=lr), "in")
+    b2.set_outputs("out")
+    g2 = b2.build().init()
+    it2 = RecordReaderDataSetIterator(tbl2, batch_size=8, label_index=4,
+                                      num_classes=1)
+    ev2 = g2.evaluate(it2)
+    want2 = np.mean(
+        (np.asarray(g2.output(tbl2[:, :4])[0])[:, 0] > 0.5)
+        == tbl2[:, 4].astype(bool))
+    assert ev2.accuracy() == want2
+
+
+def test_graph_evaluate_class_id_labels():
+    """A ported DL4J iterator may yield class IDS (not one-hot) for a
+    multi-class model; evaluate() must size the confusion matrix from
+    the model's output width, not assume binary."""
+    import numpy as np
+
+    from gan_deeplearning4j_tpu.data.csv import RecordReaderDataSetIterator
+    from gan_deeplearning4j_tpu.graph import (
+        GraphBuilder, InputSpec, Output)
+    from gan_deeplearning4j_tpu.optim.rmsprop import RmsProp
+
+    rng = np.random.RandomState(7)
+    table = np.concatenate(
+        [rng.rand(24, 4).astype(np.float32),
+         rng.randint(0, 3, size=(24, 1)).astype(np.float32)], axis=1)
+    b = GraphBuilder(seed=666, activation="tanh")
+    b.add_inputs("in")
+    b.set_input_types(InputSpec.feed_forward(4))
+    b.add_layer("out", Output(n_out=3, loss="mcxent", activation="softmax",
+                              updater=RmsProp(0.01, 1e-8, 1e-8)), "in")
+    b.set_outputs("out")
+    g = b.build().init()
+    # num_classes=1 => the iterator yields the RAW id column [N,1]
+    it = RecordReaderDataSetIterator(table, batch_size=8, label_index=4,
+                                     num_classes=1)
+    ev = g.evaluate(it)
+    assert ev.num_classes == 3
+    want = np.mean(
+        np.argmax(np.asarray(g.output(table[:, :4])[0]), axis=1)
+        == table[:, 4].astype(np.int64))
+    assert ev.accuracy() == want

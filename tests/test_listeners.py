@@ -68,5 +68,9 @@ def test_score_listener_cadence_and_replace_semantics():
     g.fit(x, y)
     g.fit(x, y)
     assert len(collect.scores) == 1  # iterations 5,6 -> one at 6
-    # perf reports from its FIRST eligible iteration (baseline = attach time)
-    assert len(perf_lines) == 2 and "examples/s" in perf_lines[0]
+    # perf baselines on its first OBSERVED step (5) — attaching to an
+    # already-trained graph must not fold steps 1-4 into the window —
+    # then reports each eligible step after (6)
+    assert len(perf_lines) == 1 and "examples/s" in perf_lines[0]
+    rate = float(perf_lines[0].split(":")[1].split("it/s")[0])
+    assert 0 < rate < 1e5  # one observed step over real elapsed time
