@@ -50,10 +50,35 @@ class CGANConfig:
     real_label: float = 0.9
     l2: float = 0.0
     clip: float = 1.0
+    # hold-then-decay LR horizon for BOTH networks; None = constant.
+    # Measured at 5k (RESULTS §6): constant LR collapses conditionally
+    # between 2k and 5k; linear decay from step 0 is WORSE (starves the
+    # generator before structure forms — the first ~1-2k of the run is
+    # still noise); this hold-then-sigmoid-decay shape (DL4J's
+    # SigmoidSchedule, negative gamma) lands in between — it does NOT
+    # recover the 2k run's class diversity, because the collapse sets in
+    # before any safe decay horizon.  The 2k checkpoint remains this
+    # family's demonstrated operating point.
+    decay_steps: int = None
+
+
+def _lr(rate: float, cfg: CGANConfig):
+    from gan_deeplearning4j_tpu.optim.schedules import (
+        Scheduled,
+        SigmoidSchedule,
+    )
+
+    adam = Adam(rate, 0.5, 0.999)
+    if cfg.decay_steps:
+        # ≈ rate until 0.4·H, rate/2 at 0.7·H, ≈ 0 at H (H = decay_steps)
+        return Scheduled(adam, SigmoidSchedule(
+            rate, gamma=-1.0 / (0.06 * cfg.decay_steps),
+            step=0.7 * cfg.decay_steps))
+    return adam
 
 
 def build_generator(cfg: CGANConfig = CGANConfig()):
-    lr = Adam(cfg.learning_rate, 0.5, 0.999)
+    lr = _lr(cfg.learning_rate, cfg)
     f = cfg.base_filters
     b = GraphBuilder(seed=cfg.seed, l2=cfg.l2, activation="relu",
                      weight_init="xavier", clip_threshold=cfg.clip)
@@ -86,7 +111,7 @@ def build_generator(cfg: CGANConfig = CGANConfig()):
 
 
 def build_discriminator(cfg: CGANConfig = CGANConfig()):
-    lr = Adam(cfg.d_learning_rate, 0.5, 0.999)
+    lr = _lr(cfg.d_learning_rate, cfg)
     f = cfg.base_filters
     b = GraphBuilder(seed=cfg.seed, l2=cfg.l2, activation="leakyrelu",
                      weight_init="xavier", clip_threshold=cfg.clip)

@@ -44,7 +44,14 @@ SAMPLE_SHAPES = {
 }
 
 
-def _build(family: str, mesh, num_classes: int = None):
+def _build(family: str, mesh, num_classes: int = None,
+           lr_decay_steps: int = None):
+    if lr_decay_steps is not None and lr_decay_steps <= 0:
+        raise ValueError(f"--lr-decay-steps must be positive, "
+                         f"got {lr_decay_steps}")
+    if lr_decay_steps and family != "cgan-cifar10":
+        raise ValueError("--lr-decay-steps is currently wired for "
+                         "cgan-cifar10 only")
     if family == "cgan-cifar10":
         import dataclasses
 
@@ -55,6 +62,8 @@ def _build(family: str, mesh, num_classes: int = None):
             # the label input's width must match the dataset's class count
             # (a real --data-dir tree can have any number of class dirs)
             cfg = dataclasses.replace(cfg, num_classes=num_classes)
+        if lr_decay_steps:
+            cfg = dataclasses.replace(cfg, decay_steps=lr_decay_steps)
         pair = GANPair(M.build_generator(cfg), M.build_discriminator(cfg),
                        mesh=mesh)
         return pair, cfg, (cfg.channels, cfg.height, cfg.width)
@@ -111,7 +120,8 @@ def train(family: str, iterations: int, batch_size: int, res_path: str,
           n_train: int, print_every: int, n_devices=None,
           data_dir: str = None, ema_decay: float = 0.0,
           checkpoint_every: int = 0, resume: bool = False,
-          steps_per_call: int = None, log=print) -> Dict[str, float]:
+          steps_per_call: int = None, lr_decay_steps: int = None,
+          log=print) -> Dict[str, float]:
     os.makedirs(res_path, exist_ok=True)
     mesh = None
     if n_devices and n_devices > 1:
@@ -124,7 +134,8 @@ def train(family: str, iterations: int, batch_size: int, res_path: str,
                  SAMPLE_SHAPES[family], data_dir)
     n_train = x.shape[0]
     pair, cfg, sample_shape = _build(
-        family, mesh, num_classes=None if y is None else y.shape[1])
+        family, mesh, num_classes=None if y is None else y.shape[1],
+        lr_decay_steps=lr_decay_steps)
     n_critic = getattr(cfg, "n_critic", 1)
 
     root = prng.root_key(cfg.seed)
@@ -323,6 +334,10 @@ def main(argv=None) -> Dict[str, float]:
                         "(aligned to scan chunks)")
     p.add_argument("--resume", action="store_true",
                    help="resume from the latest checkpoint in res-path")
+    p.add_argument("--lr-decay-steps", type=int, default=None,
+                   help="hold-then-decay LR horizon for both networks "
+                        "(cgan-cifar10; mitigates but does not fix the "
+                        "measured 5k conditional collapse — RESULTS §6)")
     p.add_argument("--ema-decay", type=float, default=0.0,
                    help="generator weight EMA decay (e.g. 0.999): the "
                         "final sample grid is also rendered from the "
@@ -338,7 +353,8 @@ def main(argv=None) -> Dict[str, float]:
                    args.n_train, args.print_every, args.n_devices,
                    data_dir=args.data_dir, ema_decay=args.ema_decay,
                    checkpoint_every=args.checkpoint_every,
-                   resume=args.resume, steps_per_call=args.steps_per_call)
+                   resume=args.resume, steps_per_call=args.steps_per_call,
+                   lr_decay_steps=args.lr_decay_steps)
     import json
 
     # one JSON line (numpy scalars coerced) — machine-consumable, cf.

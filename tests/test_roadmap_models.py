@@ -315,3 +315,31 @@ def test_roadmap_checkpoint_resume_matches_straight_run(tmp_path):
         steps = [json_lib.loads(line)["step"]
                  for line in open(f"{d}/wgan-gp_metrics.jsonl")]
         assert steps == [1, 2, 3, 4], (d, steps)
+
+
+def test_cgan_decay_steps_wires_scheduled_updaters():
+    """--lr-decay-steps must wrap BOTH networks' Adam in a hold-then-
+    decay sigmoid schedule (the round-3 fix for the measured 5k
+    conditional collapse): ~full rate through the organizing phase,
+    ~zero at the horizon."""
+    import dataclasses
+
+    from gan_deeplearning4j_tpu.models import cgan_cifar10 as M
+    from gan_deeplearning4j_tpu.optim.schedules import (
+        Scheduled, SigmoidSchedule)
+
+    cfg = dataclasses.replace(M.CGANConfig(), decay_steps=5000)
+    gen, dis = M.build_generator(cfg), M.build_discriminator(cfg)
+    for g, layer in ((gen, "gen_dense"), (dis, "dis_conv1")):
+        up = g.nodes[layer].layer.updater
+        assert isinstance(up, Scheduled)
+        assert isinstance(up.schedule, SigmoidSchedule)
+        rate = up.schedule.initial_lr
+        assert float(up.schedule(0.0)) > 0.99 * rate       # hold phase
+        assert float(up.schedule(2000.0)) > 0.95 * rate    # still organizing
+        assert float(up.schedule(5000.0)) < 0.01 * rate    # horizon ≈ 0
+        # schedule state rides the per-leaf protocol: a counter per leaf
+        assert "t" in g.opt_state[layer]["W"]
+    # default stays the constant-LR Adam
+    up = M.build_generator(M.CGANConfig()).nodes["gen_dense"].layer.updater
+    assert not isinstance(up, Scheduled)
