@@ -98,10 +98,34 @@ class TrainCheckpointer:
             raise ValueError(
                 f"checkpoint graphs {sorted(saved)} != supplied {sorted(supplied)}"
             )
+        # Load everything first and validate tree structure against the
+        # live graphs before assigning (same no-half-restore discipline):
+        # a run resumed with different updater flags (e.g. a schedule
+        # wrapper added via --lr-decay-steps) has a structurally
+        # different opt_state, and assigning it would surface later as
+        # an opaque pytree error inside the jitted step.
+        import jax
+
+        loaded_all = {}
         for name, graph in graphs.items():
             loaded = serialization.read_model(os.path.join(path, f"{name}_model.zip"))
-            graph.params = loaded.params
-            graph.opt_state = loaded.opt_state
+            for field, mismatch_hint in (
+                    ("params", "different architecture"),
+                    ("opt_state", "different updater configuration "
+                                  "(e.g. a schedule flag the original "
+                                  "run did not use)")):
+                saved_td = jax.tree_util.tree_structure(getattr(loaded, field))
+                live_td = jax.tree_util.tree_structure(getattr(graph, field))
+                if saved_td != live_td:
+                    raise ValueError(
+                        f"checkpoint {field} structure for graph "
+                        f"{name!r} does not match this run's — "
+                        f"{mismatch_hint}; resume with the original "
+                        f"run's flags")
+            loaded_all[name] = loaded
+        for name, graph in graphs.items():
+            graph.params = loaded_all[name].params
+            graph.opt_state = loaded_all[name].opt_state
         pytrees = set(scalars.pop("pytree_extras", []))
         extra = {k: v for k, v in scalars.items() if k not in ("step", "graphs")}
         npz_path = os.path.join(path, "state.npz")

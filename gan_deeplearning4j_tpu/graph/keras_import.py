@@ -81,6 +81,17 @@ def _pair(v):
     return tuple(v) if isinstance(v, (tuple, list)) else (int(v), int(v))
 
 
+def _kernel_bias(kl, cfg):
+    """(kernel, bias) with a zeros bias when ``use_bias=False``.  The
+    bias length is the kernel's last axis for every supported layer
+    (Dense ``(in, out)``, Conv2D hwio ``(h, w, in, out)``)."""
+    weights = kl.get_weights()
+    kernel = np.asarray(weights[0])
+    if cfg.get("use_bias", True):
+        return kernel, np.asarray(weights[1])
+    return kernel, np.zeros(kernel.shape[-1], np.float32)
+
+
 def _same_padding(kernel, stride, what):
     """Keras 'same' -> symmetric explicit padding; only the symmetric
     cases (odd kernel, stride 1) translate exactly."""
@@ -169,7 +180,6 @@ def import_keras(path_or_model, *, updater=None, seed: int = 666,
     for kl in layers:
         kind = kl.__class__.__name__
         cfg = kl.get_config()
-        kshape = tuple(kl.output.shape)[1:]  # keras (h, w, c) or (n,)
 
         if kind == "Flatten":
             flatten_from = tuple(kl.input.shape)[1:]
@@ -190,10 +200,7 @@ def import_keras(path_or_model, *, updater=None, seed: int = 666,
 
         name = fresh(kl.name)
         if kind == "Dense":
-            kernel = np.asarray(kl.get_weights()[0])
-            bias = (np.asarray(kl.get_weights()[1])
-                    if cfg.get("use_bias", True)
-                    else np.zeros(kernel.shape[1], np.float32))
+            kernel, bias = _kernel_bias(kl, cfg)
             if flatten_from is not None and len(flatten_from) == 3:
                 fh, fw, fc = flatten_from
                 # Keras flattened (h, w, c); this framework flattens (c, h, w)
@@ -216,10 +223,8 @@ def import_keras(path_or_model, *, updater=None, seed: int = 666,
             stride = _pair(cfg["strides"])
             pad = ((0, 0) if cfg["padding"] == "valid"
                    else _same_padding(kernel, stride, kl.name))
-            weights = kl.get_weights()
-            w = np.asarray(weights[0]).transpose(3, 2, 0, 1)  # hwio -> oihw
-            b = (np.asarray(weights[1]) if cfg.get("use_bias", True)
-                 else np.zeros(w.shape[0], np.float32))
+            w, b = _kernel_bias(kl, cfg)
+            w = w.transpose(3, 2, 0, 1)  # hwio -> oihw
             layer = Conv2D(kernel=kernel, stride=stride, padding=pad,
                            n_out=cfg["filters"],
                            activation=_act_name(cfg["activation"]),
@@ -228,7 +233,7 @@ def import_keras(path_or_model, *, updater=None, seed: int = 666,
         elif kind == "BatchNormalization":
             axis = cfg.get("axis", -1)
             axis = axis[0] if isinstance(axis, (list, tuple)) else axis
-            if len(kshape) == 3 and axis not in (-1, 3):
+            if len(kl.output.shape) == 4 and axis not in (-1, 3):
                 raise NotImplementedError("BatchNorm over a non-channel axis")
             g, b, m, v = (np.asarray(a) for a in kl.get_weights())
             layer = BatchNorm(decay=cfg["momentum"], eps=cfg["epsilon"],

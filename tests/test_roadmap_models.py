@@ -343,3 +343,33 @@ def test_cgan_decay_steps_wires_scheduled_updaters():
     # default stays the constant-LR Adam
     up = M.build_generator(M.CGANConfig()).nodes["gen_dense"].layer.updater
     assert not isinstance(up, Scheduled)
+
+
+def test_resume_with_different_updater_flags_fails_loudly(tmp_path):
+    """Restoring a checkpoint into a graph whose updater structure
+    differs (e.g. resumed with --lr-decay-steps when the original run
+    was constant-LR) must raise a clear error BEFORE any graph is
+    mutated, not an opaque pytree mismatch inside the jitted step."""
+    import dataclasses
+
+    import numpy as np
+    import pytest
+
+    from gan_deeplearning4j_tpu.checkpoint import TrainCheckpointer
+    from gan_deeplearning4j_tpu.models import cgan_cifar10 as M
+
+    plain = M.build_discriminator(M.CGANConfig())
+    ckpt = TrainCheckpointer(str(tmp_path / "ckpt"))
+    ckpt.save(100, {"dis": plain})
+
+    sched_cfg = dataclasses.replace(M.CGANConfig(), decay_steps=5000)
+    scheduled = M.build_discriminator(sched_cfg)
+    before = np.asarray(scheduled.params["dis_conv1"]["W"]).copy()
+    with pytest.raises(ValueError, match="updater configuration"):
+        ckpt.restore({"dis": scheduled})
+    # the failed restore must not have half-mutated the graph
+    np.testing.assert_array_equal(
+        before, np.asarray(scheduled.params["dis_conv1"]["W"]))
+
+    # matching structure still restores fine
+    ckpt.restore({"dis": M.build_discriminator(M.CGANConfig())})
