@@ -383,6 +383,22 @@ class ComputationGraph:
             listener.iteration_done(self, self._step_count, loss)
         return loss
 
+    def fit_iterator(self, iterator, epochs: int = 1) -> float:
+        """DL4J ``ComputationGraph.fit(DataSetIterator, numEpochs)``:
+        sweep the iterator ``epochs`` times (reset between epochs, like
+        DL4J), one optimization step per batch.  Returns the final
+        batch's loss; listeners fire per step as with ``fit``."""
+        if epochs < 1:
+            raise ValueError(f"epochs must be >= 1, got {epochs}")
+        loss = None
+        for _ in range(epochs):
+            iterator.reset()
+            for ds in iterator:
+                loss = self.fit(ds.features, ds.labels)
+        if loss is None:
+            raise ValueError("iterator produced no batches")
+        return loss
+
     def evaluate(self, iterator, num_classes: Optional[int] = None):
         """DL4J ``ComputationGraph.evaluate(DataSetIterator)``: sweep the
         iterator in inference mode and accumulate a confusion-matrix

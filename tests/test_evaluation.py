@@ -249,7 +249,6 @@ def test_graph_evaluate_iterator():
     """DL4J ``ComputationGraph.evaluate(DataSetIterator)``: the sweep
     must equal a manual whole-set argmax accuracy, reset the iterator
     both sides, and handle the binary sigmoid-column case."""
-    import numpy as np
 
     from gan_deeplearning4j_tpu.data.csv import RecordReaderDataSetIterator
     from gan_deeplearning4j_tpu.graph import (
@@ -304,7 +303,6 @@ def test_graph_evaluate_class_id_labels():
     """A ported DL4J iterator may yield class IDS (not one-hot) for a
     multi-class model; evaluate() must size the confusion matrix from
     the model's output width, not assume binary."""
-    import numpy as np
 
     from gan_deeplearning4j_tpu.data.csv import RecordReaderDataSetIterator
     from gan_deeplearning4j_tpu.graph import (
@@ -331,3 +329,45 @@ def test_graph_evaluate_class_id_labels():
         np.argmax(np.asarray(g.output(table[:, :4])[0]), axis=1)
         == table[:, 4].astype(np.int64))
     assert ev.accuracy() == want
+
+
+def test_graph_fit_iterator_epochs():
+    """fit_iterator == the same sequence of per-batch fit calls, with
+    iterator resets between epochs (DL4J fit(iterator, numEpochs))."""
+
+    from gan_deeplearning4j_tpu.data.csv import RecordReaderDataSetIterator
+    from gan_deeplearning4j_tpu.graph import (
+        Dense, GraphBuilder, InputSpec, Output)
+    from gan_deeplearning4j_tpu.optim.rmsprop import RmsProp
+
+    rng = np.random.RandomState(11)
+    table = np.concatenate(
+        [rng.rand(24, 4).astype(np.float32),
+         (rng.rand(24, 1) > 0.5).astype(np.float32)], axis=1)
+
+    def build():
+        b = GraphBuilder(seed=666, activation="tanh")
+        b.add_inputs("in")
+        b.set_input_types(InputSpec.feed_forward(4))
+        b.add_layer("out", Output(n_out=1, loss="xent",
+                                  activation="sigmoid",
+                                  updater=RmsProp(0.01, 1e-8, 1e-8)), "in")
+        b.set_outputs("out")
+        return b.build().init()
+
+    it = RecordReaderDataSetIterator(table, batch_size=8, label_index=4,
+                                     num_classes=1)
+    g1 = build()
+    last = g1.fit_iterator(it, epochs=2)
+
+    g2 = build()
+    manual = None
+    for _ in range(2):
+        for lo in range(0, 24, 8):
+            manual = g2.fit(table[lo:lo + 8, :4],
+                            table[lo:lo + 8, 4:5])
+    np.testing.assert_allclose(float(last), float(manual), rtol=0, atol=0)
+    for layer in g1.params:
+        for name, v in g1.params[layer].items():
+            np.testing.assert_array_equal(
+                np.asarray(v), np.asarray(g2.params[layer][name]))
