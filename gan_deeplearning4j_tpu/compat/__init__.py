@@ -1,0 +1,5 @@
+"""Migration-compat shims for reference-stack idioms (DL4J/ND4J)."""
+
+from gan_deeplearning4j_tpu.compat.nd4j import INDArray, Nd4j
+
+__all__ = ["INDArray", "Nd4j"]

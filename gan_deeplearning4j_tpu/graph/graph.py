@@ -391,8 +391,12 @@ class ComputationGraph:
         if epochs < 1:
             raise ValueError(f"epochs must be >= 1, got {epochs}")
         loss = None
-        for _ in range(epochs):
-            iterator.reset()
+        for epoch in range(epochs):
+            # DL4J tolerates non-resettable streaming iterators for a
+            # single epoch (resetSupported() == false); only a re-sweep
+            # REQUIRES reset
+            if epoch > 0 or hasattr(iterator, "reset"):
+                iterator.reset()
             for ds in iterator:
                 loss = self.fit(ds.features, ds.labels)
         if loss is None:

@@ -1,0 +1,81 @@
+"""Nd4j/INDArray migration shim: the reference mains' exact idioms.
+
+Each test reproduces a real line from the reference (cited) and checks
+ND4J semantics: -i methods mutate in place and return self, non-i copy,
+linspace is a row vector, and the wrappers feed straight into the
+graph API.
+"""
+
+import numpy as np
+
+from gan_deeplearning4j_tpu.compat import INDArray, Nd4j
+
+
+def test_latent_draw_idiom():
+    """z = Nd4j.rand(b, z).muli(2).subi(1) — U[-1,1]
+    (dl4jGANComputerVision.java:397)."""
+    Nd4j.getRandom().setSeed(666)
+    z = Nd4j.rand(200, 2).muli(2).subi(1)
+    a = z.data()
+    assert a.shape == (200, 2) and a.dtype == np.float32
+    assert -1 <= a.min() and a.max() <= 1 and a.min() < -0.9
+
+
+def test_inplace_vs_copy_semantics():
+    x = Nd4j.ones(2, 3)
+    y = x.add(1.0)          # copy
+    assert float(x.getDouble(0, 0)) == 1.0
+    assert float(y.getDouble(0, 0)) == 2.0
+    same = x.addi(1.0)      # in-place, returns self
+    assert same is x and float(x.getDouble(1, 2)) == 2.0
+
+
+def test_label_softening_idiom():
+    """labels.add(Nd4j.randn(...).muli(0.05)) — the softened real labels
+    (dl4jGANComputerVision.java:384-385)."""
+    Nd4j.getRandom().setSeed(666)
+    ones = Nd4j.ones(50, 1)
+    soft = ones.add(Nd4j.randn(50, 1).muli(0.05))
+    assert abs(float(np.asarray(soft).mean()) - 1.0) < 0.05
+    assert float(np.asarray(ones).mean()) == 1.0  # add() copied
+
+
+def test_linspace_grid_and_vstack():
+    """The 10x10 evaluation z-grid built from linspace + vstack
+    (dl4jGANComputerVision.java:363-370)."""
+    row = Nd4j.linspace(-1, 1, 10)
+    assert row.shape() == (1, 10)
+    stack = Nd4j.vstack([row, row, row])
+    assert stack.shape() == (3, 10)
+    assert stack.getDouble(2, 0) == -1.0 and stack.getDouble(0, 9) == 1.0
+
+
+def test_wrapper_feeds_graph_api():
+    """INDArray passes into graph.fit/output via __array__ — the
+    migration point where host prep meets the TPU path."""
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    from gan_deeplearning4j_tpu.models import mlpgan_insurance as I
+
+    Nd4j.getRandom().setSeed(666)
+    dis = I.build_discriminator()
+    x = Nd4j.rand(8, 12)
+    y = Nd4j.ones(8, 1)
+    loss = float(dis.fit(np.asarray(x), np.asarray(y)))
+    out = dis.output(np.asarray(x))[0]
+    assert np.isfinite(loss) and out.shape == (8, 1)
+
+
+def test_runtime_config_surface():
+    assert Nd4j.getBackend().startswith("jax-")
+    Nd4j.getMemoryManager().setAutoGcWindow(5000)  # no-op, must not raise
+    import numpy as _np
+
+    from gan_deeplearning4j_tpu.runtime import backend
+
+    Nd4j.setDataType("float")
+    assert backend.default_dtype() == _np.float32
+    created = Nd4j.create([[1, 2], [3, 4]])
+    assert created.data().dtype == _np.float32
+    assert created.reshape(4, 1).shape() == (4, 1)
