@@ -10,9 +10,11 @@ serialization, ParallelInference, the trainers) sees no difference from
 a natively-built graph.
 
 Scope mirrors the framework's layer set: Sequential (or linear
-functional) models of Dense / Conv2D / BatchNormalization / Dropout /
-MaxPooling2D / UpSampling2D / Flatten / Activation / InputLayer, with
-channels_last Keras convs converted to this framework's NCHW layout:
+functional) models of Dense / Conv2D / Conv2DTranspose /
+BatchNormalization / Dropout / MaxPooling2D / UpSampling2D / Flatten /
+Reshape (the Dense→(h,w,c) generator seam) / Activation / InputLayer,
+with channels_last Keras convs converted to this framework's NCHW
+layout:
 
   - Conv kernels ``[kh, kw, in, out]`` -> ``[out, in, kh, kw]``.
   - The Dense layer that follows a Flatten has its kernel's input axis
@@ -39,9 +41,11 @@ from typing import Optional
 import numpy as np
 
 from gan_deeplearning4j_tpu.graph.graph import GraphBuilder, InputSpec
+from gan_deeplearning4j_tpu.graph.preprocessors import FeedForwardToCnn
 from gan_deeplearning4j_tpu.graph.layers import (
     BatchNorm,
     Conv2D,
+    ConvTranspose2D,
     Dense,
     Dropout,
     MaxPool2D,
@@ -81,15 +85,16 @@ def _pair(v):
     return tuple(v) if isinstance(v, (tuple, list)) else (int(v), int(v))
 
 
-def _kernel_bias(kl, cfg):
-    """(kernel, bias) with a zeros bias when ``use_bias=False``.  The
-    bias length is the kernel's last axis for every supported layer
-    (Dense ``(in, out)``, Conv2D hwio ``(h, w, in, out)``)."""
+def _kernel_bias(kl, cfg, bias_axis: int = -1):
+    """(kernel, bias) with a zeros bias when ``use_bias=False``.
+    ``bias_axis`` names the kernel axis holding the output count: the
+    last for Dense ``(in, out)`` and Conv2D hwio ``(h, w, in, out)``,
+    axis 2 for Conv2DTranspose's reversed ``(h, w, out, in)``."""
     weights = kl.get_weights()
     kernel = np.asarray(weights[0])
     if cfg.get("use_bias", True):
         return kernel, np.asarray(weights[1])
-    return kernel, np.zeros(kernel.shape[-1], np.float32)
+    return kernel, np.zeros(kernel.shape[bias_axis], np.float32)
 
 
 def _same_padding(kernel, stride, what):
@@ -143,6 +148,7 @@ def import_keras(path_or_model, *, updater=None, seed: int = 666,
     prev = "in"
     weight_ops = []  # (node_name, {param: ndarray}) applied after init
     flatten_from = None  # (h, w, c) of a pending Keras Flatten
+    pending_preproc = None  # FeedForwardToCnn from a pending Keras Reshape
     nodes = {}  # node name -> our layer object (for Activation folding)
 
     def fresh(name):
@@ -183,6 +189,35 @@ def import_keras(path_or_model, *, updater=None, seed: int = 666,
 
         if kind == "Flatten":
             flatten_from = tuple(kl.input.shape)[1:]
+            continue
+        if kind == "Reshape":
+            # the DCGAN-generator seam: Dense -> Reshape((h, w, c)) ->
+            # conv stack.  This framework's FeedForwardToCnn interprets
+            # the flat vector in (c, h, w) order, so permute the
+            # PRECEDING Dense's output columns (and bias) from Keras's
+            # (h, w, c) order — the Flatten fixup in reverse.
+            tgt = tuple(cfg["target_shape"])
+            if len(tgt) != 3:
+                raise NotImplementedError(
+                    f"{kl.name}: Reshape to non-(h, w, c) {tgt}")
+            h, w, c = tgt
+            last = weight_ops[-1] if weight_ops else None
+            if (pending_preproc is not None  # a SECOND consecutive
+                    # Reshape would re-permute the already-fixed Dense
+                    or not (isinstance(nodes.get(prev), Dense)
+                            and last is not None and last[0] == prev)):
+                raise NotImplementedError(
+                    f"{kl.name}: Reshape must directly follow a Dense "
+                    "layer (the supported generator seam)")
+            kern, bias = last[1]["W"], last[1]["b"]
+            if kern.shape[1] != h * w * c:
+                raise ValueError(
+                    f"{kl.name}: Reshape target {tgt} does not match the "
+                    f"preceding Dense width {kern.shape[1]}")
+            last[1]["W"] = (kern.reshape(-1, h, w, c).transpose(0, 3, 1, 2)
+                            .reshape(kern.shape[0], h * w * c))
+            last[1]["b"] = bias.reshape(h, w, c).transpose(2, 0, 1).ravel()
+            pending_preproc = FeedForwardToCnn(h, w, c)
             continue
         if kind == "Activation":
             act = _act_name(cfg["activation"])
@@ -230,6 +265,45 @@ def import_keras(path_or_model, *, updater=None, seed: int = 666,
                            activation=_act_name(cfg["activation"]),
                            updater=updater)
             weight_ops.append((name, {"W": w, "b": b}))
+        elif kind == "Conv2DTranspose":
+            # the DCGAN-generator layer.  Kernel layout is [kh, kw, OUT,
+            # IN] (note: reversed vs Conv2D's [kh, kw, in, out]); Keras
+            # 'same' upsamples to exactly h*s, which equals this
+            # framework's (h-1)s - 2p + k at p = (k-s)/2 — exact only
+            # when k-s is even (parity-tested vs Keras at ulp level).
+            if cfg.get("data_format") not in (None, "channels_last"):
+                raise NotImplementedError("channels_first Keras convs")
+            if _pair(cfg.get("dilation_rate", 1)) != (1, 1):
+                raise NotImplementedError("dilated transposed convs")
+            if cfg.get("output_padding") not in (None, 0, (0, 0), [0, 0]):
+                raise NotImplementedError("explicit output_padding")
+            kernel = _pair(cfg["kernel_size"])
+            stride = _pair(cfg["strides"])
+            kh, kw_ = kernel
+            sh, sw = stride
+            if kh < sh or kw_ < sw:
+                # k < s breaks both translations: 'same' would need
+                # negative padding, 'valid' Keras output is in*s +
+                # max(k-s, 0) vs this framework's (in-1)s + k
+                raise NotImplementedError(
+                    f"{kl.name}: Conv2DTranspose kernel {kernel} smaller "
+                    f"than stride {stride}")
+            if cfg["padding"] == "valid":
+                pad = (0, 0)
+            else:
+                if (kh - sh) % 2 or (kw_ - sw) % 2:
+                    raise NotImplementedError(
+                        f"{kl.name}: Conv2DTranspose padding='same' with "
+                        f"odd kernel-stride difference {kernel}/{stride} "
+                        "pads asymmetrically in Keras")
+                pad = ((kh - sh) // 2, (kw_ - sw) // 2)
+            w, b = _kernel_bias(kl, cfg, bias_axis=2)  # [kh, kw, OUT, in]
+            w = w.transpose(2, 3, 0, 1)  # hw-out-in -> [O, I, kh, kw]
+            layer = ConvTranspose2D(kernel=kernel, stride=stride,
+                                    padding=pad, n_out=cfg["filters"],
+                                    activation=_act_name(cfg["activation"]),
+                                    updater=updater)
+            weight_ops.append((name, {"W": w, "b": b}))
         elif kind == "BatchNormalization":
             axis = cfg.get("axis", -1)
             axis = axis[0] if isinstance(axis, (list, tuple)) else axis
@@ -257,9 +331,14 @@ def import_keras(path_or_model, *, updater=None, seed: int = 666,
                 f"unsupported Keras layer type: {kind} ({kl.name})")
 
         builder.add_layer(name, layer, prev)
+        if pending_preproc is not None:
+            builder.input_preprocessor(name, pending_preproc)
+            pending_preproc = None
         nodes[name] = layer
         prev = name
 
+    if pending_preproc is not None:
+        raise NotImplementedError("model ends on a Reshape with no consumer")
     builder.set_outputs(prev)
     graph = builder.build().init()
     for name, values in weight_ops:

@@ -138,3 +138,63 @@ def test_branched_functional_model_rejected():
     np.testing.assert_allclose(
         np.asarray(import_keras(m).output(x)[0]),
         np.asarray(m(x, training=False)), rtol=2e-4, atol=2e-5)
+
+
+def test_keras_dcgan_generator_parity():
+    """The flagship import case: a real Keras DCGAN generator — Dense ->
+    Reshape((h,w,c)) -> BN -> Conv2DTranspose stack — must import with
+    ulp-level parity (covers the reversed [kh,kw,out,in] transposed
+    kernel layout, the Reshape output-order fixup, and 'same'
+    upsampling padding)."""
+    m = keras.Sequential([
+        keras.layers.Input(shape=(16,)),
+        keras.layers.Dense(4 * 4 * 32, activation="relu"),
+        keras.layers.Reshape((4, 4, 32)),
+        keras.layers.BatchNormalization(),
+        keras.layers.Conv2DTranspose(16, 4, strides=2, padding="same",
+                                     activation="relu"),
+        keras.layers.Conv2DTranspose(8, 4, strides=2, padding="same",
+                                     use_bias=False),
+        keras.layers.Conv2DTranspose(1, 3, strides=1, padding="same",
+                                     activation="tanh"),
+    ])
+    bn = m.layers[2]
+    g, b, mean, var = bn.get_weights()
+    rng = np.random.RandomState(9)
+    bn.set_weights([1 + 0.1 * rng.randn(*g.shape).astype(np.float32),
+                    0.1 * rng.randn(*b.shape).astype(np.float32),
+                    0.2 * rng.randn(*mean.shape).astype(np.float32),
+                    (1 + 0.3 * rng.rand(*var.shape)).astype(np.float32)])
+    z = rng.randn(4, 16).astype(np.float32)
+    want = np.asarray(m(z, training=False))          # [B, 16, 16, 1]
+    got = np.asarray(import_keras(m).output(z)[0])   # [B, 1, 16, 16]
+    np.testing.assert_allclose(np.transpose(got, (0, 2, 3, 1)), want,
+                               rtol=2e-4, atol=2e-5)
+
+
+def test_reshape_seam_guards():
+    rejects = [
+        # Reshape not directly after Dense
+        keras.Sequential([keras.layers.Input(shape=(8, 8, 2)),
+                          keras.layers.Flatten(),
+                          keras.layers.Reshape((4, 4, 8))]),
+        # a SECOND consecutive Reshape would re-permute the fixed Dense
+        keras.Sequential([keras.layers.Input(shape=(4,)),
+                          keras.layers.Dense(128),
+                          keras.layers.Reshape((4, 4, 8)),
+                          keras.layers.Reshape((8, 8, 2))]),
+        # kernel < stride: both padding translations break
+        keras.Sequential([keras.layers.Input(shape=(4, 4, 2)),
+                          keras.layers.Conv2DTranspose(
+                              3, 2, strides=4, padding="same")]),
+        keras.Sequential([keras.layers.Input(shape=(4, 4, 2)),
+                          keras.layers.Conv2DTranspose(
+                              3, 2, strides=4, padding="valid")]),
+        # transposed conv with asymmetric 'same' padding (odd k-s)
+        keras.Sequential([keras.layers.Input(shape=(4, 4, 2)),
+                          keras.layers.Conv2DTranspose(
+                              2, 3, strides=2, padding="same")]),
+    ]
+    for m in rejects:
+        with pytest.raises(NotImplementedError):
+            import_keras(m)
