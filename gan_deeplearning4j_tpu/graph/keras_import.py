@@ -309,7 +309,18 @@ def import_keras(path_or_model, *, updater=None, seed: int = 666,
             axis = axis[0] if isinstance(axis, (list, tuple)) else axis
             if len(kl.output.shape) == 4 and axis not in (-1, 3):
                 raise NotImplementedError("BatchNorm over a non-channel axis")
-            g, b, m, v = (np.asarray(a) for a in kl.get_weights())
+            weights = [np.asarray(a) for a in kl.get_weights()]
+            # center/scale=False drop beta/gamma from get_weights();
+            # synthesize the identity values (zeros beta, ones gamma) so
+            # inference stays exact instead of mis-unpacking.
+            it = iter(weights)
+            g = next(it) if cfg.get("scale", True) else None
+            b = next(it) if cfg.get("center", True) else None
+            m, v = next(it), next(it)
+            if g is None:
+                g = np.ones_like(m)
+            if b is None:
+                b = np.zeros_like(m)
             layer = BatchNorm(decay=cfg["momentum"], eps=cfg["epsilon"],
                               updater=updater)
             weight_ops.append(
@@ -325,6 +336,14 @@ def import_keras(path_or_model, *, updater=None, seed: int = 666,
             size = _pair(cfg["size"])
             if size[0] != size[1]:
                 raise NotImplementedError("non-square UpSampling2D")
+            if cfg.get("interpolation", "nearest") != "nearest":
+                # this framework's Upsampling2D is nearest-neighbor only;
+                # importing a bilinear config would silently change
+                # inference outputs (maxdiff ~0.37 measured), breaking
+                # the module's inference-exactness contract.
+                raise NotImplementedError(
+                    f"{kl.name}: UpSampling2D interpolation="
+                    f"{cfg['interpolation']!r}; only 'nearest' is exact")
             layer = Upsampling2D(size=size[0])
         else:
             raise NotImplementedError(

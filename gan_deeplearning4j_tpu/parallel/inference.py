@@ -57,6 +57,13 @@ class ParallelInference:
             raise ValueError(
                 f"max_batch={max_batch} below the mesh axis size "
                 f"{self.mesh.shape[axis]} — every dispatch needs one row per shard")
+        if max_batch is not None and max_batch % self.mesh.shape[axis]:
+            # the chunked path pads every chunk to exactly max_batch, so a
+            # non-multiple would pass construction and then fail each
+            # dispatch with a device_put divisibility error.
+            raise ValueError(
+                f"max_batch={max_batch} must be a multiple of the mesh "
+                f"axis size {self.mesh.shape[axis]}")
         self.max_batch = max_batch
         self._n = self.mesh.shape[axis]
         self._rep = replicated(self.mesh)

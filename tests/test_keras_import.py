@@ -122,6 +122,31 @@ def test_unsupported_configs_raise_not_silently_approximate():
             keras.layers.Conv2D(4, 3, activation="linear"),
             keras.layers.MaxPooling2D(2),
             keras.layers.Activation("relu"))
+    # bilinear upsampling would silently run nearest (maxdiff ~0.37)
+    rejects(keras.layers.Input(shape=(8, 8, 2)),
+            keras.layers.UpSampling2D(2, interpolation="bilinear"))
+
+
+def test_batchnorm_without_center_or_scale():
+    """center/scale=False drop beta/gamma from get_weights(); the import
+    must synthesize identity values, not mis-unpack."""
+    for center, scale in [(False, True), (True, False), (False, False)]:
+        m = keras.Sequential([
+            keras.layers.Input(shape=(6,)),
+            keras.layers.BatchNormalization(center=center, scale=scale),
+            keras.layers.Dense(3, activation="tanh"),
+        ])
+        bn = m.layers[0]
+        weights = bn.get_weights()
+        rng = np.random.RandomState(11)
+        # perturb the running stats so identity-synthesis bugs show
+        weights[-2] = 0.3 * rng.randn(6).astype(np.float32)
+        weights[-1] = (1 + 0.2 * rng.rand(6)).astype(np.float32)
+        bn.set_weights(weights)
+        x = rng.randn(5, 6).astype(np.float32)
+        np.testing.assert_allclose(
+            np.asarray(import_keras(m).output(x)[0]),
+            np.asarray(m(x, training=False)), rtol=2e-4, atol=2e-5)
 
 
 def test_branched_functional_model_rejected():

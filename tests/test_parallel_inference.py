@@ -55,6 +55,10 @@ def test_max_batch_chunking(cpu_devices, dis):
     _assert_ulp_close(whole, chunked)
     with pytest.raises(ValueError):
         ParallelInference(dis, mesh=data_mesh(8), max_batch=4)
+    with pytest.raises(ValueError):
+        # non-multiple of the mesh axis would fail every dispatch with a
+        # device_put divisibility error — reject at construction
+        ParallelInference(dis, mesh=data_mesh(8), max_batch=10)
 
 
 def test_generator_4d_output(cpu_devices):
