@@ -91,6 +91,13 @@ class GANTrainerConfig:
     # artifact cadences, so chunks never cross a dump/checkpoint
     # boundary); 1 = one dispatch per step.
     steps_per_call: Optional[int] = None
+    # Streaming (non-resident) data path: assemble this many bytes of
+    # batches per host->device transfer and advance them with ONE
+    # multi-step dispatch (data/prefetch.py ChunkPrefetchIterator).  One
+    # chunk transfer pays one tunnel round trip instead of K; chunk k+1
+    # transfers while chunk k trains (double-buffered).  0 disables
+    # chunking (per-batch transfer + per-step dispatch, the r3 behavior).
+    stream_chunk_bytes: int = 256 << 20
     # -- new capabilities over the reference --
     checkpoint_every: int = 0         # 0 = end-of-run models only
     checkpoint_keep: int = 3
@@ -479,19 +486,24 @@ class GANTrainer:
             if self._fused_step is None:
                 kw = dict(
                     z_size=c.z_size, num_features=c.num_features,
-                    mesh=self._mesh, data_on_device=resident,
-                    ema_decay=c.ema_decay,
+                    mesh=self._mesh, ema_decay=c.ema_decay,
                 )
                 graphs = (self.dis, self.gen, self.gan, self.classifier)
                 maps = (self.w.dis_to_gan, self.w.gan_to_gen,
                         self.w.dis_to_classifier)
                 self._fused_step = self._fused_lib.make_protocol_step(
-                    *graphs, *maps, **kw)
-                self._steps_per_call = (
-                    self._resolve_steps_per_call() if resident else 1)
+                    *graphs, *maps, data_on_device=resident, **kw)
+                self._steps_per_call = self._resolve_steps_per_call(
+                    byte_cap=None if resident else c.stream_chunk_bytes)
                 if self._steps_per_call > 1:
+                    # the multi-step program always slices on-device: on
+                    # the resident path from the whole table, on the
+                    # streaming path from the current K-batch chunk (the
+                    # slicing arithmetic is identical — ``it % K`` walks
+                    # a chunk exactly when steps are chunk-aligned, which
+                    # _resolve_steps_per_call guarantees)
                     self._fused_multi = self._fused_lib.make_protocol_step(
-                        *graphs, *maps,
+                        *graphs, *maps, data_on_device=True,
                         steps_per_call=self._steps_per_call, **kw)
             # loop-invariant step arguments, device-resident once
             self._fused_invariants = (
@@ -514,8 +526,7 @@ class GANTrainer:
                 # uncommitted single-device array would be re-broadcast by
                 # jit every step).
                 if self._mesh is not None:
-                    rep = jax.sharding.NamedSharding(
-                        self._mesh, jax.sharding.PartitionSpec())
+                    rep = mesh_lib.replicated(self._mesh)
                     dev_features = jax.device_put(iter_train.features, rep)
                     dev_labels = jax.device_put(iter_train.labels, rep)
                 else:
@@ -523,6 +534,37 @@ class GANTrainer:
                     dev_labels = jnp.asarray(iter_train.labels)
                 self._resident_loop(dev_features, dev_labels, iter_test,
                                     fused_state, log)
+            elif self._fused_multi is not None:
+                # Chunked streaming: the worker thread assembles K full
+                # batches into ONE array pair and starts a single
+                # host->device transfer; the device advances all K steps
+                # in one multi-step dispatch, slicing its own batches from
+                # the chunk.  Chunk k+1's transfer overlaps chunk k's
+                # compute (double-buffered) — the per-step tunnel round
+                # trip that bounded the r3 streaming path at ~1/latency
+                # is paid once per chunk instead of once per step.
+                from gan_deeplearning4j_tpu.data.prefetch import (
+                    ChunkPrefetchIterator,
+                )
+
+                if self._mesh is not None:
+                    # the data_on_device program reads the chunk
+                    # replicated (each replica slices its own shard)
+                    chunk_sh = mesh_lib.replicated(self._mesh)
+                else:
+                    chunk_sh = jax.sharding.SingleDeviceSharding(
+                        jax.devices()[0])
+                # depth 1 = three chunks in flight (training, queued,
+                # staging) — full transfer/compute overlap at the least
+                # HBM footprint
+                chunks = ChunkPrefetchIterator(
+                    iter_train, self._steps_per_call, c.batch_size,
+                    prefetch_depth=1, sharding=chunk_sh)
+                try:
+                    self._chunked_stream_loop(chunks, iter_test,
+                                              fused_state, log)
+                finally:
+                    chunks.close()
             else:
                 # Background prefetch (SURVEY.md §3.2 hot-loop note: the
                 # reference decodes CSV on the training thread every
@@ -607,7 +649,7 @@ class GANTrainer:
         return jax.random.uniform(
             key, (self.c.batch_size, self.c.z_size), minval=-1.0, maxval=1.0)
 
-    def _resolve_steps_per_call(self) -> int:
+    def _resolve_steps_per_call(self, byte_cap: Optional[int] = None) -> int:
         """Steps-per-dispatch: the largest K <= cap dividing every
         artifact cadence AND the iteration count, so chunks never cross a
         dump/checkpoint boundary and the run length is an exact number of
@@ -616,7 +658,13 @@ class GANTrainer:
         land inside the steady-throughput window).  An explicit config
         value acts as the cap and is reduced (with a warning) if it does
         not divide the cadences — a non-dividing K would silently send
-        every partial chunk down the latency-bound single-step path."""
+        every partial chunk down the latency-bound single-step path.
+
+        ``byte_cap``: on the streaming path, additionally bound K so one
+        chunk's feature+label bytes fit the transfer-buffer budget (two
+        chunks are in flight — the one training and the one staging).
+        0/None-cap semantics: ``byte_cap=0`` disables chunking entirely
+        (K=1); ``None`` applies no byte bound (the resident path)."""
         import math
 
         from gan_deeplearning4j_tpu.train.fused_step import MAX_STEPS_PER_CALL
@@ -624,10 +672,25 @@ class GANTrainer:
         c = self.c
         cap = (MAX_STEPS_PER_CALL if c.steps_per_call is None
                else max(1, c.steps_per_call))
+        byte_capped = False
+        if byte_cap is not None:
+            step_bytes = 4 * c.batch_size * (c.num_features + c.num_classes)
+            byte_steps = max(1, byte_cap // step_bytes)
+            byte_capped = byte_steps < cap
+            cap = min(cap, byte_steps)
         g = c.num_iterations
         for cad in (c.print_every, c.save_every, c.checkpoint_every):
             if cad:
                 g = math.gcd(g, cad)
+        if byte_cap is not None and self.batch_counter:
+            # STREAMING chunks slice batch ``it % K``, so a resumed run's
+            # start step must be a multiple of K or slicing
+            # desynchronizes from the step counter — and the checkpoint
+            # may come from a run with DIFFERENT cadences, so alignment
+            # with this config's cadences alone is not enough.  (The
+            # resident program slices ``it % table_batches`` — correct at
+            # any start step, no constraint there.)
+            g = math.gcd(g, self.batch_counter)
         if g <= 0:
             return 1
         k = max(d for d in range(1, min(cap, g) + 1) if g % d == 0)
@@ -635,8 +698,11 @@ class GANTrainer:
             import logging
 
             logging.getLogger(__name__).warning(
-                "steps_per_call=%d does not divide the artifact cadences; "
-                "using %d so chunks stay aligned", c.steps_per_call, k)
+                "steps_per_call=%d reduced to %d (%s)", c.steps_per_call, k,
+                "chunk transfer-byte budget stream_chunk_bytes"
+                if byte_capped and k == cap else
+                "must divide the artifact cadences and the resume step "
+                "so chunks stay aligned")
         return k
 
     def _resident_data_ok(self, iter_train) -> bool:
@@ -707,6 +773,36 @@ class GANTrainer:
                     self._final_losses = (d_loss, g_loss, c_loss)
                     self._step_bookkeeping(iter_test, d_loss, g_loss,
                                            c_loss, log)
+
+    def _chunked_stream_loop(self, chunks, iter_test, fused_state,
+                             log) -> None:
+        """Streaming counterpart of _resident_loop: ONE host->device
+        transfer and ONE multi-step dispatch per K-step chunk.  The
+        worker thread stages chunk k+1 while the device trains chunk k,
+        so steady-state throughput approaches the resident path's for any
+        dataset size — the 2 GiB residency budget no longer gates it."""
+        K = self._steps_per_call
+        self._final_state, self._final_losses = fused_state, None
+        while self.batch_counter < self.c.num_iterations:
+            run = self._next_chunk()
+            if run != K:
+                # _resolve_steps_per_call aligns K with every cadence,
+                # the run length AND the resume step, so a partial chunk
+                # cannot occur; a silent mismatch would desynchronize the
+                # step counter from the chunk slicing
+                raise RuntimeError(
+                    f"chunk misalignment: next boundary in {run} steps "
+                    f"but chunk size is {K}")
+            try:
+                features, labels = next(chunks)
+            except StopIteration:  # dataset empty even after reset
+                break
+            fused_state, (d, g, cl) = self._fused_multi(
+                fused_state, features, labels, *self._fused_invariants)
+            self._final_state = fused_state
+            self._final_losses = (d[-1], g[-1], cl[-1])
+            self._mark_steady(self._final_losses, steps=run)
+            self._chunk_bookkeeping(iter_test, d, g, cl, run, log)
 
     def _mark_steady(self, loss, steps: int = 1) -> None:
         """After the FIRST step/chunk of a run (the one that pays the XLA

@@ -193,6 +193,72 @@ def test_prefetch_all_partial_dataset_terminates():
             next(pf)
 
 
+def test_chunk_prefetch_assembles_ordered_chunks():
+    """ChunkPrefetchIterator: K full batches -> one (K*B, F) pair, in
+    consumption order, skipping partial tails and wrapping epochs — the
+    exact sequence the per-batch streaming loop sees, just chunked."""
+    from gan_deeplearning4j_tpu.data.prefetch import ChunkPrefetchIterator
+
+    table = np.arange(22 * 3, dtype=np.float32).reshape(22, 3)
+    it = RecordReaderDataSetIterator(
+        table, batch_size=8, label_index=2, num_classes=1)
+    with ChunkPrefetchIterator(it, chunk_batches=2, batch_size=8) as pf:
+        chunks = [next(pf) for _ in range(3)]
+    for f, l in chunks:
+        assert f.shape == (16, 2) and l.shape == (16, 1)
+    # epoch = batches [0:8], [8:16]; 6-row tail skipped; then wraps
+    np.testing.assert_array_equal(np.asarray(chunks[0][0]),
+                                  table[0:16, :2])
+    np.testing.assert_array_equal(np.asarray(chunks[1][0]),
+                                  table[0:16, :2])
+
+
+def test_chunk_prefetch_all_partial_dataset_terminates():
+    from gan_deeplearning4j_tpu.data.prefetch import ChunkPrefetchIterator
+
+    table = np.zeros((5, 3), dtype=np.float32)
+    it = RecordReaderDataSetIterator(
+        table, batch_size=8, label_index=2, num_classes=1)
+    with ChunkPrefetchIterator(it, chunk_batches=2, batch_size=8) as pf:
+        with pytest.raises(StopIteration):
+            next(pf)
+
+
+def test_chunk_prefetch_source_truncated_between_epochs_terminates():
+    """A source whose post-reset pass yields no full batch must end in
+    the StopIteration sentinel, not busy-spin the wrap loop forever
+    (the base PrefetchIterator's per-pass progress guard, same
+    semantics)."""
+    from gan_deeplearning4j_tpu.data.prefetch import ChunkPrefetchIterator
+
+    full = np.arange(16 * 3, dtype=np.float32).reshape(16, 3)
+    partial = np.zeros((5, 3), dtype=np.float32)
+
+    class TruncatingSource:
+        """Full first epoch; only a partial batch after every reset."""
+
+        def __init__(self):
+            self.inner = RecordReaderDataSetIterator(
+                full, batch_size=8, label_index=2, num_classes=1)
+
+        def has_next(self):
+            return self.inner.has_next()
+
+        def next(self):
+            return self.inner.next()
+
+        def reset(self):
+            self.inner = RecordReaderDataSetIterator(
+                partial, batch_size=8, label_index=2, num_classes=1)
+
+    with ChunkPrefetchIterator(TruncatingSource(), chunk_batches=2,
+                               batch_size=8) as pf:
+        first = next(pf)  # the full epoch's two batches
+        assert first[0].shape == (16, 2)
+        with pytest.raises(StopIteration):
+            next(pf)
+
+
 def test_native_csv_writer_matches_numpy(tmp_path):
     """The C++ formatter's output parses back to the same values numpy
     writes, for both %g artifacts and the %.2f+int dataset contract."""
