@@ -1,0 +1,93 @@
+"""Streaming-path scale proof: a >2 GiB dataset past the residency budget.
+
+VERDICT r3 weak-#1 asked for evidence that the 2 GiB ``data_on_device``
+budget no longer gates throughput: any table larger than HBM's budget
+falls onto the streaming path, which in r3 ran two orders of magnitude
+below resident.  This benchmark builds a synthetic >2 GiB dataset in the
+2-decimal fixed-point contract (MNIST-shaped — the flagship protocol's
+shapes, so the step program is the benchmarked one), hands it to the REAL
+trainer (in-memory table, same iterator/trainer code path as a decoded
+CSV), and measures steady-state streaming throughput: the auto residency
+gate must refuse the table and the chunked uint8 transport path must
+carry it at near-resident rate.
+
+Prints one JSON line:
+  {"rows": N, "table_gib": G, "resident": false, "codec": "u8x100",
+   "stream_img_per_sec": N, ...}
+
+Run (TPU): python benchmarks/stream_large.py [--rows N] [--iterations N]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+import time
+
+import numpy as np
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:  # runnable as `python benchmarks/stream_large.py`
+    sys.path.insert(0, _REPO)
+
+
+def main(argv=None) -> None:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--rows", type=int, default=720_000,
+                   help="dataset rows; 720k x 784 f32 = 2.26 GiB > the "
+                        "2 GiB residency budget")
+    p.add_argument("--iterations", type=int, default=300)
+    p.add_argument("--batch", type=int, default=200)
+    args = p.parse_args(argv)
+
+    from gan_deeplearning4j_tpu.train import cv_main
+    from gan_deeplearning4j_tpu.train.gan_trainer import GANTrainer
+
+    # synthetic pixels already in the %.2f contract: n/100, n in [0, 255]
+    rng = np.random.RandomState(666)
+    codes = rng.randint(0, 256, (args.rows, 784), dtype=np.uint8)
+    features = (codes.astype(np.float64) / 100.0).astype(np.float32)
+    del codes
+    labels = rng.randint(0, 10, (args.rows, 1)).astype(np.float32)
+    table = np.concatenate([features, labels], axis=1)
+    del features, labels
+    table_gib = table.nbytes / (1 << 30)
+
+    class LargeSyntheticWorkload(cv_main.CVWorkload):
+        """CV workload over the in-memory table (the iterator accepts
+        arrays and paths alike — same trainer code path either way)."""
+
+        def ensure_data(self, res_path):
+            test = table[: args.batch]
+            return table, test
+
+    with tempfile.TemporaryDirectory() as tmp:
+        config = cv_main.default_config(
+            num_iterations=args.iterations, batch_size=args.batch,
+            res_path=tmp, print_every=10 ** 9, save_every=10 ** 9,
+            metrics=False)
+        trainer = GANTrainer(LargeSyntheticWorkload(), config)
+        t0 = time.perf_counter()
+        result = trainer.train(log=lambda s: None)
+        wall = time.perf_counter() - t0
+
+    print(json.dumps({
+        "metric": "stream_large_img_per_sec",
+        "rows": args.rows,
+        "table_gib": round(table_gib, 3),
+        # codec engages ONLY on the streaming path, so it doubles as the
+        # residency-gate witness; the byte check is the gate's own input
+        "over_residency_budget": bool(
+            table.nbytes > config.data_on_device_max_bytes),
+        "codec": trainer._stream_codec,
+        "steps_per_call": trainer._steps_per_call,
+        "stream_img_per_sec": round(result["examples_per_sec"], 1),
+        "wall_s": round(wall, 1),
+    }))
+
+
+if __name__ == "__main__":
+    main()

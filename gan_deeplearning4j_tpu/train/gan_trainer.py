@@ -342,6 +342,8 @@ class GANTrainer:
         self._test_batches = None
         self._steps_per_call = 1
         self._fused_multi = None
+        self._stream_codec = None
+        self._codec_lib = None
         # inline writer until train() swaps in the background one, so the
         # dump methods also work when called directly (tests, notebooks)
         self._dumper = AsyncArtifactWriter(synchronous=True)
@@ -493,8 +495,30 @@ class GANTrainer:
                         self.w.dis_to_classifier)
                 self._fused_step = self._fused_lib.make_protocol_step(
                     *graphs, *maps, data_on_device=resident, **kw)
-                self._steps_per_call = self._resolve_steps_per_call(
-                    byte_cap=None if resident else c.stream_chunk_bytes)
+                # streaming transport codec: when the training features
+                # are exactly the 2-decimal fixed-point dataset contract,
+                # ship uint8 codes (4x fewer bytes over a bandwidth-bound
+                # link) and dequantize bitwise on device (data/codec.py).
+                # Gated on: streaming path, chunking actually live (the
+                # codec-aware K — the codec can only raise the
+                # byte-capped K), and NO preprocessor (the gate validates
+                # the RAW table, but the worker encodes post-preprocessor
+                # batches — a normalizer would silently wrap mod 256).
+                self._stream_codec = None
+                byte_cap = None if resident else c.stream_chunk_bytes
+                k_codec = self._resolve_steps_per_call(
+                    byte_cap=byte_cap, codec="u8x100")
+                if (not resident and k_codec > 1
+                        and getattr(iter_train, "preprocessor", None) is None):
+                    from gan_deeplearning4j_tpu.data import codec as codec_lib
+
+                    self._codec_lib = codec_lib
+                    if codec_lib.u8x100_lossless(iter_train.features):
+                        self._stream_codec = "u8x100"
+                self._steps_per_call = (
+                    k_codec if self._stream_codec else
+                    self._resolve_steps_per_call(
+                        byte_cap=byte_cap, codec=None))
                 if self._steps_per_call > 1:
                     # the multi-step program always slices on-device: on
                     # the resident path from the whole table, on the
@@ -504,7 +528,9 @@ class GANTrainer:
                     # _resolve_steps_per_call guarantees)
                     self._fused_multi = self._fused_lib.make_protocol_step(
                         *graphs, *maps, data_on_device=True,
-                        steps_per_call=self._steps_per_call, **kw)
+                        steps_per_call=self._steps_per_call,
+                        data_codec=None if resident else self._stream_codec,
+                        **kw)
             # loop-invariant step arguments, device-resident once
             self._fused_invariants = (
                 self._z_base, self._fused_rng,
@@ -512,6 +538,16 @@ class GANTrainer:
             fused_state = self._fused_lib.state_from_graphs(
                 self.dis, self.gen, self.gan, self.classifier,
                 start_step=self.batch_counter, ema=c.ema_decay > 0)
+            # Commit the state to a concrete sharding up front.  The
+            # program's outputs are committed arrays, so an uncommitted
+            # initial state would give call 1 a different argument-
+            # sharding signature than every later call — jit then
+            # RECOMPILES the whole program on step/chunk 2 (measured:
+            # ~16s, landing inside the steady-throughput window).
+            fused_state = jax.device_put(
+                fused_state,
+                mesh_lib.replicated(self._mesh) if self._mesh is not None
+                else jax.sharding.SingleDeviceSharding(jax.devices()[0]))
 
         # artifact materialization runs on a background worker for the
         # whole loop; the with-block guarantees every dump is on disk (or
@@ -557,9 +593,12 @@ class GANTrainer:
                 # depth 1 = three chunks in flight (training, queued,
                 # staging) — full transfer/compute overlap at the least
                 # HBM footprint
+                encode = (self._codec_lib.u8x100_encode
+                          if self._stream_codec == "u8x100" else None)
                 chunks = ChunkPrefetchIterator(
                     iter_train, self._steps_per_call, c.batch_size,
-                    prefetch_depth=1, sharding=chunk_sh)
+                    prefetch_depth=1, sharding=chunk_sh,
+                    encode_features=encode)
                 try:
                     self._chunked_stream_loop(chunks, iter_test,
                                               fused_state, log)
@@ -649,7 +688,8 @@ class GANTrainer:
         return jax.random.uniform(
             key, (self.c.batch_size, self.c.z_size), minval=-1.0, maxval=1.0)
 
-    def _resolve_steps_per_call(self, byte_cap: Optional[int] = None) -> int:
+    def _resolve_steps_per_call(self, byte_cap: Optional[int] = None,
+                                codec: Optional[str] = None) -> int:
         """Steps-per-dispatch: the largest K <= cap dividing every
         artifact cadence AND the iteration count, so chunks never cross a
         dump/checkpoint boundary and the run length is an exact number of
@@ -674,7 +714,9 @@ class GANTrainer:
                else max(1, c.steps_per_call))
         byte_capped = False
         if byte_cap is not None:
-            step_bytes = 4 * c.batch_size * (c.num_features + c.num_classes)
+            feat_bytes = 1 if codec == "u8x100" else 4
+            step_bytes = c.batch_size * (
+                feat_bytes * c.num_features + 4 * c.num_classes)
             byte_steps = max(1, byte_cap // step_bytes)
             byte_capped = byte_steps < cap
             cap = min(cap, byte_steps)

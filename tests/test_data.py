@@ -259,6 +259,32 @@ def test_chunk_prefetch_source_truncated_between_epochs_terminates():
             next(pf)
 
 
+def test_u8x100_codec_exact_roundtrip():
+    """The transport codec is BITWISE lossless on the 2-decimal dataset
+    contract (every n/100 value), matches CSV-parse semantics, and
+    refuses anything else."""
+    from gan_deeplearning4j_tpu.data import codec
+
+    # every representable code, via the same text->f32 path the CSV
+    # reader uses
+    text_vals = np.array([np.float32(f"{n / 100:.2f}") for n in range(256)])
+    assert codec.u8x100_lossless(text_vals)
+    enc = codec.u8x100_encode(text_vals)
+    assert enc.dtype == np.uint8
+    np.testing.assert_array_equal(enc, np.arange(256, dtype=np.uint8))
+    np.testing.assert_array_equal(codec.u8x100_decode_np(enc), text_vals)
+
+    # not fixed-point / out of range / wrong dtype -> refused
+    assert not codec.u8x100_lossless(np.float32([0.123]))
+    assert not codec.u8x100_lossless(np.float32([2.56]))
+    assert not codec.u8x100_lossless(np.float32([-0.01]))
+    assert not codec.u8x100_lossless(np.float64([0.25]))
+    # non-finite values must be REFUSED, not crash the table gather
+    assert not codec.u8x100_lossless(np.float32([0.25, np.nan]))
+    assert not codec.u8x100_lossless(np.float32([np.inf]))
+    assert not codec.u8x100_lossless(np.float32([-np.inf]))
+
+
 def test_native_csv_writer_matches_numpy(tmp_path):
     """The C++ formatter's output parses back to the same values numpy
     writes, for both %g artifacts and the %.2f+int dataset contract."""

@@ -318,6 +318,41 @@ def test_stream_chunked_matches_resident_and_per_step(tmp_path):
 
 
 @pytest.mark.slow
+def test_stream_chunked_u8_codec_matches_resident(tmp_path):
+    """On the CV workload the dataset CSV is the 2-decimal fixed-point
+    contract, so the streaming path engages the uint8 transport codec —
+    and must still train BITWISE like the resident f32 path (the device
+    dequant table reproduces host-parsed floats exactly)."""
+    import json
+
+    from gan_deeplearning4j_tpu.train import cv_main
+    from gan_deeplearning4j_tpu.train.gan_trainer import GANTrainer
+
+    recs, trainers = {}, {}
+    for mode, on_dev in [("resident", True), ("stream", False)]:
+        d = str(tmp_path / mode)
+        config = cv_main.default_config(
+            num_iterations=4, batch_size=16, res_path=d, print_every=2,
+            save_every=4, data_on_device=on_dev)
+        t = GANTrainer(cv_main.CVWorkload(n_train=64, n_test=16), config)
+        t.train(log=lambda s: None)
+        trainers[mode] = t
+        with open(os.path.join(d, "mnist_metrics.jsonl")) as f:
+            recs[mode] = [json.loads(line) for line in f]
+    assert trainers["stream"]._stream_codec == "u8x100"  # codec engaged
+    assert trainers["stream"]._steps_per_call == 2
+    assert trainers["resident"]._stream_codec is None
+    for a, b in zip(recs["stream"], recs["resident"]):
+        assert a["step"] == b["step"]
+        for key in ("d_loss", "g_loss", "classifier_loss"):
+            assert a[key] == b[key], (a["step"], key)  # bitwise
+    for f in ["mnist_out_2.csv", "mnist_out_4.csv"]:
+        want = open(os.path.join(str(tmp_path / "resident"), f), "rb").read()
+        got = open(os.path.join(str(tmp_path / "stream"), f), "rb").read()
+        assert got == want, f
+
+
+@pytest.mark.slow
 def test_stream_chunked_resume_with_changed_cadence(tmp_path):
     """Resuming on the streaming path from a checkpoint step that the new
     config's chunk size would not divide must keep chunks aligned (K is
