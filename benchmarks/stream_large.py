@@ -46,10 +46,12 @@ def main(argv=None) -> None:
     from gan_deeplearning4j_tpu.train import cv_main
     from gan_deeplearning4j_tpu.train.gan_trainer import GANTrainer
 
+    from gan_deeplearning4j_tpu.data.codec import u8x100_decode_np
+
     # synthetic pixels already in the %.2f contract: n/100, n in [0, 255]
     rng = np.random.RandomState(666)
     codes = rng.randint(0, 256, (args.rows, 784), dtype=np.uint8)
-    features = (codes.astype(np.float64) / 100.0).astype(np.float32)
+    features = u8x100_decode_np(codes)
     del codes
     labels = rng.randint(0, 10, (args.rows, 1)).astype(np.float32)
     table = np.concatenate([features, labels], axis=1)

@@ -33,9 +33,17 @@ U8X100_TABLE = (np.arange(256, dtype=np.float64) / 100.0).astype(np.float32)
 def u8x100_encode(features) -> np.ndarray:
     """f32 (n/100)-valued array -> uint8 codes.  Caller must have
     verified ``u8x100_lossless`` first; rounding here matches its
-    quantizer exactly."""
+    quantizer exactly.  Block-scanned like the gate, so the f64
+    temporaries stay ~tens of MB for arbitrarily large chunks."""
     f = np.asarray(features)
-    return np.rint(f.astype(np.float64) * 100.0).astype(np.uint8)
+    out = np.empty(f.shape, np.uint8)
+    flat_in, flat_out = f.reshape(-1), out.reshape(-1)
+    block = 8 << 20
+    for lo in range(0, flat_in.size, block):
+        part = flat_in[lo:lo + block]
+        flat_out[lo:lo + block] = np.rint(
+            part.astype(np.float64) * 100.0).astype(np.uint8)
+    return out
 
 
 def u8x100_lossless(features) -> bool:
@@ -63,5 +71,14 @@ def u8x100_lossless(features) -> bool:
 def u8x100_decode_np(codes) -> np.ndarray:
     """Host-side decode (tests / host consumers); the device-side decode
     is the same table gather inside the fused program
-    (train/fused_step.py)."""
-    return U8X100_TABLE[np.asarray(codes, dtype=np.intp)]
+    (train/fused_step.py).  Block-scanned: the intp index temporary is
+    8 bytes/element, so an unblocked gather over a multi-GiB table would
+    transiently double-plus its footprint."""
+    c = np.asarray(codes)
+    out = np.empty(c.shape, np.float32)
+    flat_in, flat_out = c.reshape(-1), out.reshape(-1)
+    block = 8 << 20
+    for lo in range(0, flat_in.size, block):
+        flat_out[lo:lo + block] = U8X100_TABLE[
+            flat_in[lo:lo + block].astype(np.intp)]
+    return out
