@@ -20,6 +20,10 @@ from gan_deeplearning4j_tpu.graph.preprocessors import (  # noqa: F401
     FeedForwardToCnn,
 )
 from gan_deeplearning4j_tpu.graph.keras_import import import_keras  # noqa: F401
+from gan_deeplearning4j_tpu.graph.dl4j_import import (  # noqa: F401
+    export_dl4j,
+    import_dl4j,
+)
 from gan_deeplearning4j_tpu.graph.serialization import read_model, write_model  # noqa: F401
 from gan_deeplearning4j_tpu.graph.transfer import (  # noqa: F401
     FineTuneConfiguration,
