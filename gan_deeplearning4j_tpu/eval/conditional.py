@@ -1,0 +1,120 @@
+"""Conditional fidelity — does a conditional generator OBEY its label?
+
+VERDICT r3 weak-#3 asked for a falsifiable conditioning metric for the
+cGAN family: a probe classifier is trained on the REAL labeled table,
+then the generator synthesizes n samples per class and the metric is the
+agreement rate between the probe's prediction and the conditioned label
+(the class-prediction analog of the frozen-extractor FID protocol in
+eval/fid_extractor.py).  A class-collapsed generator scores ~1/K no
+matter how sharp its two surviving glyphs look; a faithful conditional
+generator scores near the probe's own training accuracy.
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from gan_deeplearning4j_tpu.graph import (
+    Conv2D,
+    Dense,
+    GraphBuilder,
+    InputSpec,
+    Output,
+)
+from gan_deeplearning4j_tpu.optim.adam import Adam
+from gan_deeplearning4j_tpu.runtime import prng
+
+
+def build_probe(channels: int, height: int, width: int, num_classes: int,
+                seed: int = prng.NUMBER_OF_THE_BEAST):
+    """Small conv classifier: enough capacity to separate the surrogate's
+    classes, cheap enough to train inside an evaluation."""
+    lr = Adam(1e-3, 0.9, 0.999)
+    b = GraphBuilder(seed=seed, activation="relu", weight_init="xavier")
+    b.add_inputs("in")
+    b.set_input_types(InputSpec.convolutional(channels, height, width))
+    b.add_layer("p_conv1", Conv2D(kernel=(3, 3), stride=(2, 2),
+                                  padding=(1, 1), n_in=channels, n_out=32,
+                                  updater=lr), "in")
+    b.add_layer("p_conv2", Conv2D(kernel=(3, 3), stride=(2, 2),
+                                  padding=(1, 1), n_in=32, n_out=64,
+                                  updater=lr), "p_conv1")
+    b.add_layer("p_dense", Dense(n_out=128, updater=lr), "p_conv2")
+    b.add_layer("p_out", Output(n_out=num_classes, n_in=128, loss="mcxent",
+                                activation="softmax", updater=lr), "p_dense")
+    b.set_outputs("p_out")
+    return b.build().init()
+
+
+def conditional_fidelity(
+    gen,
+    x: np.ndarray,
+    y_onehot: np.ndarray,
+    *,
+    sample_shape,
+    z_size: int,
+    n_per_class: int = 64,
+    probe_steps: int = 400,
+    probe_batch: int = 128,
+    seed: int = prng.NUMBER_OF_THE_BEAST,
+    use_ema: bool = False,
+    probe=None,
+) -> Dict[str, object]:
+    """Train the probe on (x, y), then score label agreement of the
+    generator's conditioned samples.
+
+    ``x``: real features, flat [n, C*H*W] (tanh range — whatever the
+    generator emits); ``y_onehot``: [n, K].  ``use_ema``: evaluate the
+    EMA weights (gen.ema_params) instead of the live ones.  ``probe``:
+    a previously-returned trained probe — the probe depends only on
+    (x, y, seed), so scoring several parameter sets (live + EMA) should
+    train it once and pass it back in.
+    Returns {fidelity, per_class, probe_train_acc, n_per_class, probe}.
+    """
+    c, h, w = sample_shape
+    k = y_onehot.shape[1]
+    x4 = np.asarray(x, np.float32).reshape(-1, c, h, w)
+    y = np.asarray(y_onehot, np.float32)
+
+    if probe is None:
+        probe = build_probe(c, h, w, k, seed=seed)
+        rng = np.random.RandomState(seed)
+        for _ in range(probe_steps):
+            idx = rng.randint(0, x4.shape[0], probe_batch)
+            probe.fit(jnp.asarray(x4[idx]), jnp.asarray(y[idx]))
+
+    # probe sanity: training-set accuracy (evaluated on a capped slice)
+    n_eval = min(2000, x4.shape[0])
+    pred_real = np.argmax(
+        np.asarray(probe.output(jnp.asarray(x4[:n_eval]))[0]), axis=1)
+    probe_acc = float(np.mean(pred_real == np.argmax(y[:n_eval], axis=1)))
+
+    params = gen.params
+    if use_ema:
+        ema = getattr(gen, "ema_params", None)
+        if ema is None:
+            raise ValueError("use_ema=True but the generator carries no "
+                             "ema_params")
+        params = ema
+    z_key = prng.stream(prng.root_key(seed), "fidelity-z")
+    labels = np.repeat(np.arange(k), n_per_class)
+    cond = jnp.asarray(np.eye(k, dtype=np.float32)[labels])
+    z = jax.random.uniform(z_key, (labels.size, z_size),
+                           minval=-1.0, maxval=1.0)
+    vals, _ = gen._forward(params, {gen.input_names[0]: z,
+                                    gen.input_names[1]: cond}, False, None)
+    samples = vals[gen.output_names[0]].reshape(-1, c, h, w)
+    pred = np.argmax(np.asarray(probe.output(samples)[0]), axis=1)
+    agree = pred == labels
+    per_class = [float(np.mean(agree[labels == i])) for i in range(k)]
+    return {
+        "fidelity": float(np.mean(agree)),
+        "per_class": per_class,
+        "probe_train_acc": probe_acc,
+        "n_per_class": n_per_class,
+        "probe": probe,
+    }

@@ -5,6 +5,7 @@ from gan_deeplearning4j_tpu.graph.graph import (  # noqa: F401
 )
 from gan_deeplearning4j_tpu.graph.layers import (  # noqa: F401
     BatchNorm,
+    ConditionalBatchNorm,
     Conv2D,
     ConvTranspose2D,
     Dense,
@@ -12,7 +13,9 @@ from gan_deeplearning4j_tpu.graph.layers import (  # noqa: F401
     ElementWise,
     MaxPool2D,
     Merge,
+    MinibatchStdDev,
     Output,
+    ProjectionOutput,
     Upsampling2D,
 )
 from gan_deeplearning4j_tpu.graph.preprocessors import (  # noqa: F401

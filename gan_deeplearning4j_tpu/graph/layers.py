@@ -416,10 +416,163 @@ class ElementWise(Layer):
         return self._act(out), None
 
 
+@dataclasses.dataclass
+class ConditionalBatchNorm(Layer):
+    """Conditional BatchNorm (Dumoulin et al. 2017; Miyato et al. 2018's
+    cGAN generator norm): batch-stat normalization with PER-CLASS
+    gamma/beta selected by a one-hot condition — the standard structural
+    fix for conditional-GAN class collapse (the shared affine of plain
+    BN lets the generator ignore the label; per-class affines make the
+    conditioning load-bearing).  Multi-input vertex: (x, onehot_label).
+    Statistics are class-agnostic (one running mean/var, like plain BN);
+    at init every class row is gamma=1/beta=0, i.e. exactly plain BN."""
+
+    num_classes: int = 0
+    n: Optional[int] = None
+    decay: float = 0.9
+    eps: float = 1e-5
+
+    @property
+    def multi_input(self):
+        return True
+
+    def out_shape(self, in_shape):
+        return tuple(in_shape[0])
+
+    def _n(self, x_shape):
+        if self.n is not None:
+            return self.n
+        return x_shape[0] if len(x_shape) == 3 else _flat_size(x_shape)
+
+    def init(self, key, in_shape):
+        n = self._n(in_shape[0])
+        k = self.num_classes
+        if k <= 0:
+            raise ValueError("ConditionalBatchNorm needs num_classes > 0")
+        return {
+            "gamma": initializers.ones((k, n)),
+            "beta": initializers.zeros((k, n)),
+            "mean": initializers.zeros((n,)),
+            "var": initializers.ones((n,)),
+        }
+
+    def apply(self, params, xs, train, rng, axis_name=None):
+        from gan_deeplearning4j_tpu.ops.batchnorm import (
+            batch_norm_inference_cond,
+            batch_norm_train_cond,
+        )
+
+        x, y = xs
+        gamma_b = y @ params["gamma"]  # [B, C]: one-hot row select
+        beta_b = y @ params["beta"]
+        if train:
+            out, new_mean, new_var = batch_norm_train_cond(
+                x, gamma_b, beta_b, params["mean"], params["var"],
+                self.decay, self.eps, axis_name=axis_name)
+            return self._act(out), {"mean": new_mean, "var": new_var}
+        return self._act(batch_norm_inference_cond(
+            x, gamma_b, beta_b, params["mean"], params["var"],
+            self.eps)), None
+
+
+@dataclasses.dataclass
+class MinibatchStdDev(Layer):
+    """Minibatch standard deviation (Karras et al. 2018): append one
+    channel/feature holding the mean of per-position stddevs over small
+    CONTIGUOUS groups of samples (StyleGAN's group_size=4), giving the
+    discriminator a direct view of sample diversity — the classic
+    anti-mode-collapse feature.  Parameter-free.
+
+    Group-wise, not batch-wide, on purpose: the GANPair D-step runs ONE
+    forward over the concatenated [real; fake] batch, so a batch-wide
+    scalar would be identical for every real AND fake row and carry no
+    within-batch signal.  With contiguous groups the halves never share
+    a group, so a collapsed fake half shows up as low-std fake groups in
+    the same forward.  Under a mesh each shard's contiguous slice
+    preserves group boundaries (shard sizes are multiples of the group),
+    so the statistic is shard-local AND bitwise the single-device one —
+    no cross-replica reduction needed or wanted."""
+
+    group_size: int = 4
+    eps: float = 1e-8
+
+    @property
+    def has_params(self):
+        return False
+
+    def out_shape(self, in_shape):
+        if len(in_shape) == 3:
+            c, h, w = in_shape
+            return (c + 1, h, w)
+        return (_flat_size(in_shape) + 1,)
+
+    def apply(self, params, x, train, rng, axis_name=None):
+        B = x.shape[0]
+        g = self.group_size
+        if B % g:  # static shapes: largest divisor of B within group_size
+            g = max(d for d in range(1, min(g, B) + 1) if B % d == 0)
+        grouped = x.reshape((B // g, g) + x.shape[1:])
+        mean = jnp.mean(grouped, axis=1, keepdims=True)
+        var = jnp.mean(jnp.square(grouped - mean), axis=1)
+        std = jnp.sqrt(var + self.eps)
+        # one scalar per group, broadcast to that group's rows
+        stat = jnp.mean(std.reshape(B // g, -1), axis=1)
+        stat = jnp.repeat(stat, g)
+        if x.ndim == 4:
+            feat = jnp.broadcast_to(
+                stat.reshape(B, 1, 1, 1), (B, 1) + x.shape[2:]).astype(x.dtype)
+        else:
+            feat = stat.reshape(B, 1).astype(x.dtype)
+        return jnp.concatenate([x, feat], axis=1), None
+
+
+@dataclasses.dataclass
+class ProjectionOutput(Layer):
+    """Projection discriminator head (Miyato & Koyama 2018):
+    ``logit = phi @ W + b + sum(phi * (y @ V), -1)`` — the conditional
+    term is an inner product between the feature vector and a learned
+    class embedding, which shapes D's decision boundary per class far
+    more strongly than concatenating the one-hot onto the features.
+    Multi-input vertex: (features, onehot_label).  Carries a ``loss``
+    like Output, so it can terminate a discriminator graph."""
+
+    n_in: Optional[int] = None
+    num_classes: int = 0
+    loss: str = "xent"
+
+    @property
+    def multi_input(self):
+        return True
+
+    def out_shape(self, in_shape):
+        return (1,)
+
+    def init(self, key, in_shape):
+        n_in = self.n_in if self.n_in is not None else _flat_size(in_shape[0])
+        k = self.num_classes
+        if k <= 0:
+            raise ValueError("ProjectionOutput needs num_classes > 0")
+        k_w, k_v = jax.random.split(key)
+        return {
+            "W": initializers.xavier(k_w, (n_in, 1), n_in, 1),
+            "b": initializers.zeros((1,)),
+            "V": initializers.xavier(k_v, (k, n_in), k, n_in),
+        }
+
+    def apply(self, params, xs, train, rng, axis_name=None):
+        phi, y = xs
+        phi = _as_ff(phi)
+        logit = phi @ params["W"] + params["b"]
+        embed = y @ params["V"]  # [B, n_in]
+        logit = logit + jnp.sum(phi * embed, axis=-1, keepdims=True)
+        return self._act(logit), None
+
+
 LAYER_TYPES = {
     cls.__name__: cls
     for cls in [
         Dense, Output, Conv2D, ConvTranspose2D, MaxPool2D, Upsampling2D,
-        BatchNorm, Dropout, Merge, ElementWise,
+        BatchNorm, Dropout, Merge, ElementWise, ConditionalBatchNorm,
+        MinibatchStdDev, ProjectionOutput,
     ]
 }

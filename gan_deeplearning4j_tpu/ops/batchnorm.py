@@ -85,3 +85,52 @@ def batch_norm_inference(
 ) -> jax.Array:
     out = (x - _shaped(running_mean, x)) * jax.lax.rsqrt(_shaped(running_var, x) + eps)
     return out * _shaped(gamma, x) + _shaped(beta, x)
+
+
+def _shaped_per_sample(p: jax.Array, x: jax.Array) -> jax.Array:
+    """Per-SAMPLE scale/shift [B, C] broadcast against x."""
+    if x.ndim == 2:
+        return p
+    return p.reshape(p.shape[0], p.shape[1], 1, 1)
+
+
+def batch_norm_train_cond(
+    x: jax.Array,
+    gamma_b: jax.Array,
+    beta_b: jax.Array,
+    running_mean: jax.Array,
+    running_var: jax.Array,
+    decay: float = DEFAULT_DECAY,
+    eps: float = DEFAULT_EPS,
+    axis_name: str | None = None,
+):
+    """Conditional BN (Dumoulin et al. 2017): batch-stat normalization
+    with per-SAMPLE gamma/beta [B, C] (selected upstream by the
+    condition, e.g. one-hot label @ per-class table).  Statistics are
+    class-agnostic — one running mean/var like plain BN; only the affine
+    transform is conditioned.  Returns (out, new_mean, new_var)."""
+    axes = _reduce_axes(x)
+    mean = jnp.mean(x, axis=axes)
+    m2 = jnp.mean(jnp.square(x), axis=axes)
+    if axis_name is not None:
+        mean = jax.lax.pmean(mean, axis_name)
+        m2 = jax.lax.pmean(m2, axis_name)
+    var = m2 - jnp.square(mean)
+    out = (x - _shaped(mean, x)) * jax.lax.rsqrt(_shaped(var, x) + eps)
+    out = out * _shaped_per_sample(gamma_b, x) + _shaped_per_sample(beta_b, x)
+    new_mean = decay * running_mean + (1.0 - decay) * mean
+    new_var = decay * running_var + (1.0 - decay) * var
+    return out, new_mean, new_var
+
+
+def batch_norm_inference_cond(
+    x: jax.Array,
+    gamma_b: jax.Array,
+    beta_b: jax.Array,
+    running_mean: jax.Array,
+    running_var: jax.Array,
+    eps: float = DEFAULT_EPS,
+) -> jax.Array:
+    out = (x - _shaped(running_mean, x)) * jax.lax.rsqrt(
+        _shaped(running_var, x) + eps)
+    return out * _shaped_per_sample(gamma_b, x) + _shaped_per_sample(beta_b, x)

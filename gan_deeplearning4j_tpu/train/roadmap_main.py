@@ -121,7 +121,7 @@ def train(family: str, iterations: int, batch_size: int, res_path: str,
           data_dir: str = None, ema_decay: float = 0.0,
           checkpoint_every: int = 0, resume: bool = False,
           steps_per_call: int = None, lr_decay_steps: int = None,
-          log=print) -> Dict[str, float]:
+          fidelity_steps: int = 400, log=print) -> Dict[str, float]:
     os.makedirs(res_path, exist_ok=True)
     mesh = None
     if n_devices and n_devices > 1:
@@ -301,7 +301,7 @@ def train(family: str, iterations: int, batch_size: int, res_path: str,
                 save_updater=False)
         finally:
             pair.gen.params = orig
-    return {
+    result = {
         "family": family,
         "steps": iterations,
         "d_loss": float(d_loss),
@@ -310,6 +310,32 @@ def train(family: str, iterations: int, batch_size: int, res_path: str,
             steps_timed * batch_size * (n_critic + 1) / wall
             if steps_timed > 0 else 0.0),
     }
+    if y is not None and fidelity_steps > 0:
+        # conditional fidelity (VERDICT r3 weak-#3's falsifiable gate):
+        # probe-classifier label agreement of conditioned samples — a
+        # class-collapsed generator scores ~1/K regardless of how sharp
+        # its surviving modes look
+        from gan_deeplearning4j_tpu.eval.conditional import (
+            conditional_fidelity,
+        )
+
+        fid = conditional_fidelity(
+            pair.gen, x, y, sample_shape=sample_shape, z_size=cfg.z_size,
+            probe_steps=fidelity_steps)
+        result["conditional_fidelity"] = fid["fidelity"]
+        result["fidelity_per_class"] = fid["per_class"]
+        result["probe_train_acc"] = fid["probe_train_acc"]
+        log(f"[{family}] conditional fidelity {fid['fidelity']:.3f} "
+            f"(probe train acc {fid['probe_train_acc']:.3f}); per-class "
+            + " ".join(f"{v:.2f}" for v in fid["per_class"]))
+        if getattr(pair.gen, "ema_params", None) is not None:
+            # same (x, y, seed) -> reuse the trained probe, don't retrain
+            fid_ema = conditional_fidelity(
+                pair.gen, x, y, sample_shape=sample_shape,
+                z_size=cfg.z_size, probe_steps=fidelity_steps,
+                use_ema=True, probe=fid["probe"])
+            result["conditional_fidelity_ema"] = fid_ema["fidelity"]
+    return result
 
 
 def main(argv=None) -> Dict[str, float]:
@@ -338,6 +364,10 @@ def main(argv=None) -> Dict[str, float]:
                    help="hold-then-decay LR horizon for both networks "
                         "(cgan-cifar10; mitigates but does not fix the "
                         "measured 5k conditional collapse — RESULTS §6)")
+    p.add_argument("--fidelity-steps", type=int, default=400,
+                   help="probe-classifier training steps for the "
+                        "conditional-fidelity metric (conditional "
+                        "families; 0 disables)")
     p.add_argument("--ema-decay", type=float, default=0.0,
                    help="generator weight EMA decay (e.g. 0.999): the "
                         "final sample grid is also rendered from the "
@@ -354,7 +384,8 @@ def main(argv=None) -> Dict[str, float]:
                    data_dir=args.data_dir, ema_decay=args.ema_decay,
                    checkpoint_every=args.checkpoint_every,
                    resume=args.resume, steps_per_call=args.steps_per_call,
-                   lr_decay_steps=args.lr_decay_steps)
+                   lr_decay_steps=args.lr_decay_steps,
+                   fidelity_steps=args.fidelity_steps)
     import json
 
     # one JSON line (numpy scalars coerced) — machine-consumable, cf.

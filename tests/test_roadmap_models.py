@@ -35,6 +35,143 @@ def test_cgan_shapes_and_step():
     assert np.isfinite(float(d0)) and np.isfinite(float(g0))
 
 
+def test_conditional_bn_layer():
+    """CBN at init == plain BN (per-class rows start at gamma=1/beta=0);
+    after divergence the affine is class-selected."""
+    from gan_deeplearning4j_tpu.graph.layers import (
+        BatchNorm,
+        ConditionalBatchNorm,
+    )
+
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.randn(6, 5).astype(np.float32))
+    y = jnp.asarray(np.eye(3, dtype=np.float32)[rng.randint(0, 3, 6)])
+    cbn = ConditionalBatchNorm(num_classes=3, n=5, activation="identity")
+    bn = BatchNorm(activation="identity")
+    key = jax.random.key(0)
+    p_c = cbn.init(key, [(5,), (3,)])
+    p_b = bn.init(key, (5,))
+    out_c, upd_c = cbn.apply(p_c, [x, y], True, None)
+    out_b, upd_b = bn.apply(p_b, x, True, None)
+    np.testing.assert_allclose(np.asarray(out_c), np.asarray(out_b),
+                               rtol=1e-6, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(upd_c["mean"]),
+                               np.asarray(upd_b["mean"]), rtol=1e-6)
+    # class-selected affine: perturb class 1's gamma — only class-1 rows move
+    p_c["gamma"] = p_c["gamma"].at[1].set(2.0)
+    out_c2, _ = cbn.apply(p_c, [x, y], True, None)
+    moved = np.any(np.asarray(out_c2) != np.asarray(out_c), axis=1)
+    np.testing.assert_array_equal(moved, np.asarray(y[:, 1] == 1.0))
+
+
+def test_minibatch_stddev_layer():
+    from gan_deeplearning4j_tpu.graph.layers import MinibatchStdDev
+
+    rng = np.random.RandomState(1)
+    x = jnp.asarray(rng.randn(4, 2, 3, 3).astype(np.float32))
+    layer = MinibatchStdDev()
+    out, _ = layer.apply({}, x, True, None)
+    assert out.shape == (4, 3, 3, 3)
+    assert layer.out_shape((2, 3, 3)) == (3, 3, 3)
+    # one group of 4 -> one scalar across it...
+    stat = np.asarray(out[:, 2])
+    assert np.allclose(stat, stat.ravel()[0])
+    # ...that SHRINKS when the batch collapses to a single mode
+    collapsed = jnp.broadcast_to(x[:1], x.shape)
+    out_c, _ = layer.apply({}, collapsed, True, None)
+    assert float(out_c[0, 2, 0, 0]) < float(out[0, 2, 0, 0])
+
+    # the stat is GROUP-wise: in a [diverse-real; collapsed-fake] batch
+    # (the GANPair D-step's concatenated layout) the fake half's groups
+    # carry a visibly lower stat in the SAME forward — the within-batch
+    # signal a batch-wide scalar cannot provide
+    real = jnp.asarray(rng.randn(4, 2, 3, 3).astype(np.float32))
+    fake = jnp.broadcast_to(
+        jnp.asarray(rng.randn(1, 2, 3, 3).astype(np.float32)), (4, 2, 3, 3))
+    out_rf, _ = layer.apply({}, jnp.concatenate([real, fake]), True, None)
+    real_stat = float(out_rf[0, 2, 0, 0])
+    fake_stat = float(out_rf[4, 2, 0, 0])
+    assert fake_stat < real_stat * 0.1
+    # 2-D path and non-divisible batch fall back to a legal group size
+    out2, _ = layer.apply({}, jnp.asarray(rng.randn(6, 5)), True, None)
+    assert out2.shape == (6, 6)
+
+
+def test_projection_output_layer():
+    """logit = phi@W + b + phi.(y@V), and the label term is load-bearing."""
+    from gan_deeplearning4j_tpu.graph.layers import ProjectionOutput
+
+    rng = np.random.RandomState(2)
+    phi = jnp.asarray(rng.randn(5, 7).astype(np.float32))
+    y = jnp.asarray(np.eye(4, dtype=np.float32)[rng.randint(0, 4, 5)])
+    layer = ProjectionOutput(n_in=7, num_classes=4, activation="identity")
+    p = layer.init(jax.random.key(3), [(7,), (4,)])
+    out, _ = layer.apply(p, [phi, y], True, None)
+    want = (np.asarray(phi) @ np.asarray(p["W"]) + np.asarray(p["b"])
+            + np.sum(np.asarray(phi) * (np.asarray(y) @ np.asarray(p["V"])),
+                     axis=-1, keepdims=True))
+    np.testing.assert_allclose(np.asarray(out), want, rtol=1e-5, atol=1e-6)
+    # different labels change the logit (conditioning not dead)
+    y2 = jnp.asarray(np.eye(4, dtype=np.float32)[(rng.randint(0, 4, 5) + 1) % 4])
+    out2, _ = layer.apply(p, [phi, y2], True, None)
+    assert not np.allclose(np.asarray(out), np.asarray(out2))
+
+
+def test_cgan_conditional_layers_serialize(tmp_path):
+    """The r4 conditional layers are full citizens of the native zip
+    format (round-trip with identical inference outputs)."""
+    from gan_deeplearning4j_tpu.graph import serialization
+
+    cfg = cgan_cifar10.CGANConfig(base_filters=4, z_size=8)
+    gen = cgan_cifar10.build_generator(cfg)
+    path = str(tmp_path / "cgen.zip")
+    serialization.write_model(gen, path)
+    g2 = serialization.read_model(path)
+    rng = np.random.RandomState(4)
+    z = jnp.asarray(rng.rand(3, 8).astype(np.float32) * 2 - 1)
+    y = jnp.asarray(np.eye(10, dtype=np.float32)[rng.randint(0, 10, 3)])
+    np.testing.assert_array_equal(np.asarray(gen.output(z, y)[0]),
+                                  np.asarray(g2.output(z, y)[0]))
+
+
+def test_conditional_fidelity_metric():
+    """The metric separates a label-faithful 'generator' from a
+    collapsed one on a trivially separable dataset."""
+    from gan_deeplearning4j_tpu.eval.conditional import conditional_fidelity
+
+    k, n = 4, 400
+    rng = np.random.RandomState(5)
+    labels = rng.randint(0, k, n)
+    # class i = constant image of value i/k (trivially separable)
+    x = np.repeat((labels / k).astype(np.float32)[:, None], 3 * 8 * 8, axis=1)
+    y = np.eye(k, dtype=np.float32)[labels]
+
+    class FakeGen:
+        input_names = ("z", "label")
+        output_names = ("out",)
+        params = {}
+
+        def __init__(self, faithful):
+            self.faithful = faithful
+
+        def _forward(self, params, inputs, train, rng):
+            lab = np.asarray(inputs["label"])
+            cls = np.argmax(lab, axis=1)
+            if not self.faithful:
+                cls = np.zeros_like(cls)  # collapsed: always class 0
+            vals = np.repeat((cls / k).astype(np.float32)[:, None],
+                             3 * 8 * 8, axis=1)
+            return {"out": jnp.asarray(vals)}, None
+
+    kw = dict(sample_shape=(3, 8, 8), z_size=2, n_per_class=8,
+              probe_steps=300, probe_batch=64)
+    good = conditional_fidelity(FakeGen(True), x, y, **kw)
+    bad = conditional_fidelity(FakeGen(False), x, y, **kw)
+    assert good["probe_train_acc"] > 0.9
+    assert good["fidelity"] > 0.9
+    assert bad["fidelity"] <= 1.0 / k + 0.1
+
+
 def test_gradient_penalty_second_order():
     """The SameDiff-can't-do-this proof: d/dtheta of (d/dx critic) through
     the conv stack is finite and nonzero."""
