@@ -121,10 +121,12 @@ def _skip(reason: str) -> int:
 
 
 def _record_lastgood(payload: dict, platform: str, rt_ms: float) -> None:
-    # only a default-shaped run (reference batch 200, e2e included) may
-    # replace the cached headline — a debug invocation (--batch 8,
-    # --skip-e2e) must not become what a later wedged round cites
-    if payload.get("batch") != 200 or "e2e_img_per_sec" not in payload:
+    # only a default-shaped run (reference batch 200, e2e included,
+    # reference-numerics main measurement) may replace the cached
+    # headline — a debug invocation (--batch 8, --skip-e2e) or an --mp
+    # run must not become what a later wedged round cites
+    if (payload.get("batch") != 200 or "e2e_img_per_sec" not in payload
+            or payload.get("compute_bf16")):
         _log("non-default run; BENCH_LASTGOOD.json left untouched")
         return
     try:

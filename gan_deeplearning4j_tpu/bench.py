@@ -54,7 +54,16 @@ WARMUP = 3
 STEPS_LO = 30
 STEPS_HI = 180
 REPEATS = 3
-E2E_STEPS = 60
+# 300 steps = 3 chunks of the trainer's auto steps_per_call (100): the
+# steady window then spans whole chunks and the per-chunk dispatch gap
+# (a tunnel round trip here) amortizes as it does in real multi-thousand
+# -iteration runs; at 60 steps the window was 2 chunks of 20 and the
+# gap dominated the measurement.
+E2E_STEPS = 300
+# the documented TPU fast mode measured alongside the reference-numerics
+# default: s2d/d2s conv rewrites + bf16 MXU operands + full mixed
+# precision (f32 master params/BN/loss) — runtime/backend.py
+FAST_BATCH = 1600
 # Bump when the measured step's methodology changes; a cached baseline
 # from another version is discarded and re-measured (apples to apples).
 # v5: readback-fenced slope timing — jax.block_until_ready is a NO-OP on
@@ -62,7 +71,13 @@ E2E_STEPS = 60
 # of queued work), so each timed window ends with a scalar loss readback
 # (the only reliable device fence) and the step time is the SLOPE between
 # a short and a long window, cancelling the ~70ms tunnel round trip.
-METHODOLOGY_VERSION = 5
+# v6: ``value`` is the MULTISTEP (steps_per_call) throughput — the
+# trainer's actual default execution path, and the reproducible number:
+# the single-dispatch rate rides the shared tunnel's load (observed
+# 34k-99k img/s across days ON THE SAME CODE) and is reported separately
+# as single_dispatch_img_per_sec.  The CPU baseline is unchanged in kind
+# (per-step time on CPU, where dispatch overhead is negligible).
+METHODOLOGY_VERSION = 6
 
 # Dense bf16 peak FLOP/s by TPU generation (the conventional MFU
 # denominator).  This benchmark computes in float32, which the MXU
@@ -184,7 +199,9 @@ def protocol_step_time(device, want_flops: bool = False,
 
 
 def protocol_multistep_time(device, k: Optional[int] = None,
-                            repeats: int = REPEATS):
+                            repeats: int = REPEATS,
+                            want_flops: bool = False,
+                            batch: Optional[int] = None):
     """Seconds per protocol step when ONE dispatch advances ``k`` steps
     (lax.scan inside the program, device-resident data — the trainer's
     steps_per_call fast path).  Removes the per-dispatch latency bound
@@ -199,13 +216,14 @@ def protocol_multistep_time(device, k: Optional[int] = None,
 
     if k is None:
         k = fused.MAX_STEPS_PER_CALL  # the trainer's own chunk size
+    b = batch if batch is not None else BATCH
 
     with jax.default_device(device):
         dis, gen, gan = (
             M.build_discriminator(), M.build_generator(), M.build_gan())
         classifier = M.build_classifier(dis)
         rng = np.random.RandomState(0)
-        ones = jnp.ones((BATCH, 1), dtype=jnp.float32)
+        ones = jnp.ones((b, 1), dtype=jnp.float32)
         key = jax.random.key(0)
         step = fused.make_protocol_step(
             dis, gen, gan, classifier,
@@ -216,18 +234,30 @@ def protocol_multistep_time(device, k: Optional[int] = None,
         state = jax.device_put(  # committed: keep one signature across calls
             fused.state_from_graphs(dis, gen, gan, classifier), device)
         table = jax.device_put(
-            rng.rand(4 * BATCH, 784).astype(np.float32), device)
+            rng.rand(4 * b, 784).astype(np.float32), device)
         labels = jax.device_put(
-            np.eye(10, dtype=np.float32)[rng.randint(0, 10, 4 * BATCH)],
+            np.eye(10, dtype=np.float32)[rng.randint(0, 10, 4 * b)],
             device)
         inv = (
             key, jax.random.fold_in(key, 1),
-            ones + 0.05 * jnp.asarray(rng.randn(BATCH, 1), jnp.float32),
-            0.05 * jnp.asarray(rng.randn(BATCH, 1), jnp.float32),
+            ones + 0.05 * jnp.asarray(rng.randn(b, 1), jnp.float32),
+            0.05 * jnp.asarray(rng.randn(b, 1), jnp.float32),
             ones,
         )
 
         import statistics
+
+        flops = None
+        if want_flops:
+            try:
+                cost = step.lower(
+                    state, table, labels, *inv).compile().cost_analysis()
+                # XLA's cost model counts a while/scan BODY once (verified:
+                # the k-step program reports ~the single-step figure), so
+                # the number IS per-step — no division by k
+                flops = float(cost.get("flops", 0.0)) or None
+            except Exception:
+                flops = None
 
         state, losses = step(state, table, labels, *inv)  # compile
         _fence(losses)
@@ -247,7 +277,8 @@ def protocol_multistep_time(device, k: Optional[int] = None,
             t_lo = window(lo)
             t_hi = window(hi)
             slopes.append((t_hi - t_lo) / ((hi - lo) * k))
-        return statistics.median(slopes)
+        t = statistics.median(slopes)
+        return (t, flops) if want_flops else t
 
 
 def e2e_img_per_sec(res_path: str, data_on_device=None) -> float:
@@ -296,11 +327,19 @@ def main(argv=None) -> None:
     p.add_argument("--pallas-updater", action="store_true",
                    help="Pallas one-pass RmsProp update chain for big "
                         "leaves (ops/pallas/fused_update.py)")
+    p.add_argument("--mp", action="store_true",
+                   help="full mixed precision for the MAIN measurement "
+                        "(bf16 params/activations, f32 master/BN/loss — "
+                        "backend.compute_bf16).  The fast-mode block "
+                        "always measures with it on")
+    p.add_argument("--skip-fast", action="store_true",
+                   help="skip the fast-mode (s2d+bf16+mp, batch 1600) "
+                        "multistep measurement block")
     args = p.parse_args(argv)
 
     # idempotent (not latch-on): repeated in-process main() calls — the
     # A/B measurement pattern — must reset state for the baseline run
-    backend.configure(conv_s2d=args.s2d)
+    backend.configure(conv_s2d=args.s2d, compute_bf16=args.mp)
     from gan_deeplearning4j_tpu.ops import pallas as pallas_mod
 
     pallas_mod.enable(args.pallas_updater)
@@ -357,31 +396,67 @@ def main(argv=None) -> None:
             value = BATCH / step_s
             multi_s = protocol_multistep_time(default)
 
+    # v6: the headline is the multistep (trainer-default) path; the
+    # single-dispatch rate is tunnel-load-dependent and secondary
+    headline = BATCH / multi_s if multi_s else value
     out = {
         "metric": "dcgan_mnist_img_per_sec",
-        "value": round(value, 2),
+        "value": round(headline, 2),
         "unit": "img/sec/chip",
         "batch": BATCH,
-        "step_ms": round(step_s * 1e3, 3),
+        "step_ms": round((multi_s if multi_s else step_s) * 1e3, 3),
         # keyed on what RAN, not on the flag: --bf16 on a CPU-only host
         # still reports the f32 baseline
         "dtype": "bf16" if measured_bf16 else "f32",
+        # full mixed precision active for the MAIN measurement (--mp)
+        "compute_bf16": bool(backend.config().compute_bf16
+                             and default.platform != "cpu"),
         "conv_s2d": backend.conv_s2d_enabled(),
     }
     if baseline:
-        out["vs_baseline"] = round(value / baseline, 3)
+        out["vs_baseline"] = round(headline / baseline, 3)
+    out["single_dispatch_img_per_sec"] = round(value, 2)
+    out["single_dispatch_step_ms"] = round(step_s * 1e3, 3)
     if multi_s:
-        # steps_per_call=MAX_STEPS_PER_CALL fast path: one dispatch per
-        # chunk — the gap vs step_ms is pure dispatch latency (large on a tunnel)
+        # kept under their historical keys for cross-round comparability
         out["multistep_img_per_sec"] = round(BATCH / multi_s, 2)
         out["multistep_step_ms"] = round(multi_s * 1e3, 3)
     peak = _peak_flops(default)
     if flops:
         out["flops_per_step"] = flops
         if peak:
-            out["mfu"] = round(flops / step_s / peak, 4)
+            # v6: headline mfu follows the headline (multistep) time
+            out["mfu"] = round(flops / (multi_s or step_s) / peak, 4)
         if peak and multi_s:
             out["multistep_mfu"] = round(flops / multi_s / peak, 4)
+
+    if default.platform != "cpu" and not args.skip_fast:
+        # the documented TPU fast mode, measured every run alongside the
+        # reference-numerics default: conv rewrites (s2d + the r4
+        # output-side d2s) + bf16 MXU operands + full mixed precision.
+        # Its MFU uses the cost model of ITS OWN compiled program (the
+        # rewrites change logical flops slightly).
+        prev = backend.config()
+        backend.configure(conv_s2d=True, matmul_bf16=True,
+                          compute_bf16=True)
+        try:
+            fast_s, fast_flops = protocol_multistep_time(
+                default, repeats=REPEATS, want_flops=True,
+                batch=FAST_BATCH)
+            fast = {
+                "batch": FAST_BATCH,
+                "multistep_img_per_sec": round(FAST_BATCH / fast_s, 2),
+                "multistep_step_ms": round(fast_s * 1e3, 3),
+            }
+            if fast_flops and peak:
+                fast["flops_per_step"] = fast_flops
+                fast["multistep_mfu"] = round(
+                    fast_flops / fast_s / peak, 4)
+            out["fast_mode"] = fast
+        finally:
+            backend.configure(
+                conv_s2d=prev.conv_s2d, matmul_bf16=prev.matmul_bf16,
+                compute_bf16=prev.compute_bf16)
     if not args.skip_e2e:
         with tempfile.TemporaryDirectory() as tmp:
             out["e2e_img_per_sec"] = round(e2e_img_per_sec(tmp), 2)

@@ -93,21 +93,20 @@ def conditional_fidelity(
         np.asarray(probe.output(jnp.asarray(x4[:n_eval]))[0]), axis=1)
     probe_acc = float(np.mean(pred_real == np.argmax(y[:n_eval], axis=1)))
 
-    params = gen.params
+    params = None
     if use_ema:
-        ema = getattr(gen, "ema_params", None)
-        if ema is None:
+        params = getattr(gen, "ema_params", None)
+        if params is None:
             raise ValueError("use_ema=True but the generator carries no "
                              "ema_params")
-        params = ema
     z_key = prng.stream(prng.root_key(seed), "fidelity-z")
     labels = np.repeat(np.arange(k), n_per_class)
     cond = jnp.asarray(np.eye(k, dtype=np.float32)[labels])
     z = jax.random.uniform(z_key, (labels.size, z_size),
                            minval=-1.0, maxval=1.0)
-    vals, _ = gen._forward(params, {gen.input_names[0]: z,
-                                    gen.input_names[1]: cond}, False, None)
-    samples = vals[gen.output_names[0]].reshape(-1, c, h, w)
+    # the public jitted inference path (one dispatch), parameterized so
+    # EMA weights evaluate without mutating the graph
+    samples = gen.output(z, cond, params=params)[0].reshape(-1, c, h, w)
     pred = np.argmax(np.asarray(probe.output(samples)[0]), axis=1)
     agree = pred == labels
     per_class = [float(np.mean(agree[labels == i])) for i in range(k)]

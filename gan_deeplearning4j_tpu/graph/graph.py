@@ -272,6 +272,26 @@ class ComputationGraph:
         running-stat updates produced by train-mode layers.  ``axis_name``
         enables cross-replica sync-BN under shard_map (see ops/batchnorm.py).
         """
+        from gan_deeplearning4j_tpu.graph.layers import (
+            BatchNorm,
+            ConditionalBatchNorm,
+        )
+        from gan_deeplearning4j_tpu.runtime import backend
+
+        # full mixed precision (backend.compute_bf16, the TPU fast mode):
+        # run layer math with bf16 params/activations; BatchNorm layers are
+        # carved out (f32 params, f32-upcast input) so batch statistics and
+        # the running-stat EMAs never round through bf16.  Gradients flow
+        # through the casts back to the f32 master params; resolved at
+        # TRACE time like matmul_bf16.
+        mp = backend.config().compute_bf16
+        bf16 = jnp.bfloat16
+
+        def down(t):
+            return jax.tree.map(
+                lambda a: a.astype(bf16)
+                if getattr(a, "dtype", None) == jnp.float32 else a, t)
+
         values: Dict[str, jax.Array] = {}
         for inp in self.input_names:
             x = inputs[inp]
@@ -279,19 +299,29 @@ class ComputationGraph:
             if spec.kind == "cnn_flat":
                 h, w, c = spec.shape
                 x = x.reshape(x.shape[0], c, h, w)
-            values[inp] = x
+            values[inp] = down(x) if mp else x
         state_updates: Dict[str, Dict[str, jax.Array]] = {}
         for name, node in self.nodes.items():
+            is_bn = isinstance(node.layer, (BatchNorm, ConditionalBatchNorm))
             if node.layer.multi_input:
                 x = [values[i] for i in node.inputs]
+                if mp and is_bn:
+                    x = [x[0].astype(jnp.float32)] + x[1:]
             else:
                 x = values[node.inputs[0]]
                 if node.preprocessor is not None:
                     x = node.preprocessor(x)
+                if mp and is_bn:
+                    x = x.astype(jnp.float32)
             layer_train = train and name not in self.frozen
             layer_rng = prng.stream(rng, name) if rng is not None else None
-            y, upd = node.layer.apply(params[name], x, layer_train, layer_rng,
+            p = params[name]
+            if mp and not is_bn:
+                p = down(p)
+            y, upd = node.layer.apply(p, x, layer_train, layer_rng,
                                       axis_name=axis_name)
+            if mp and getattr(y, "dtype", None) == jnp.float32:
+                y = y.astype(bf16)
             if upd:
                 state_updates[name] = upd
             values[name] = y
@@ -320,7 +350,11 @@ class ComputationGraph:
         for name in self.output_names:
             node = self.nodes[name]
             loss_name = getattr(node.layer, "loss", "mse")
-            total = total + loss_lib.get(loss_name)(outputs[name], labels[name])
+            # f32 loss always: under compute_bf16 the head's probabilities
+            # arrive bf16 and the log/reduction must not round further
+            # (a no-op cast in the default f32 mode)
+            total = total + loss_lib.get(loss_name)(
+                outputs[name].astype(jnp.float32), labels[name])
         return total
 
     def _train_step(self, params, opt_state, rng, inputs, labels, reduce=None,

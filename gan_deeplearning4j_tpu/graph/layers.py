@@ -489,9 +489,15 @@ class MinibatchStdDev(Layer):
     within-batch signal.  With contiguous groups the halves never share
     a group, so a collapsed fake half shows up as low-std fake groups in
     the same forward.  Under a mesh each shard's contiguous slice
-    preserves group boundaries (shard sizes are multiples of the group),
-    so the statistic is shard-local AND bitwise the single-device one —
-    no cross-replica reduction needed or wanted."""
+    preserves group boundaries — the per-shard batch must be a group
+    multiple (apply() raises otherwise), AND mesh == single-device
+    exactness additionally needs every concatenated SEGMENT (the
+    D-step's per-shard real/fake halves) to be a group multiple, i.e.
+    batch_size/n_shards divisible by ``group_size`` — otherwise a shard
+    group straddles the real/fake seam that the single-device grouping
+    respects (tests/test_roadmap_models.py pins the aligned case).
+    Single-device batches not divisible by ``group_size`` fall back to
+    the largest dividing group (documented deviation)."""
 
     group_size: int = 4
     eps: float = 1e-8
@@ -510,6 +516,15 @@ class MinibatchStdDev(Layer):
         B = x.shape[0]
         g = self.group_size
         if B % g:  # static shapes: largest divisor of B within group_size
+            if axis_name is not None:
+                # under a mesh a silent fallback would give each shard a
+                # DIFFERENT grouping than the single-device run — the
+                # equivalence this layer documents.  Require divisibility.
+                raise ValueError(
+                    f"MinibatchStdDev: per-shard batch {B} not divisible "
+                    f"by group_size {self.group_size}; pick a batch whose "
+                    "shard size is a group multiple (mesh == single-device "
+                    "equivalence depends on identical grouping)")
             g = max(d for d in range(1, min(g, B) + 1) if B % d == 0)
         grouped = x.reshape((B // g, g) + x.shape[1:])
         mean = jnp.mean(grouped, axis=1, keepdims=True)

@@ -68,6 +68,44 @@ def _space_to_depth_rewrite(x: jax.Array, w: jax.Array):
     return xb, wb
 
 
+def _d2s_eligible(x: jax.Array, w: jax.Array, stride, padding) -> bool:
+    """Output-side polyphase rewrite eligibility: stride-1 convs whose
+    OUTPUT channel count starves the MXU (the generator's final
+    C_out=1 synthesis conv — the mirror of the C_in=1 problem the
+    space-to-depth rewrite solves on the input side)."""
+    O, I, kh, kw = w.shape
+    if not (tuple(stride) == (1, 1) and O <= 4 and I >= 4 * O
+            and kh % 2 == 1 and kw % 2 == 1 and kh >= 3 and kw >= 3):
+        return False
+    ph, pw = padding
+    ho = conv2d_out_size(x.shape[2], kh, 1, ph)
+    wo = conv2d_out_size(x.shape[3], kw, 1, pw)
+    return ho > 0 and wo > 0 and ho % 2 == 0 and wo % 2 == 0
+
+
+def _d2s_kernel(w: jax.Array) -> jax.Array:
+    """Embed the odd k x k kernel at the four (dy, dx) phase offsets of
+    an even (k+1) x (k+1) kernel -> [4*O, I, k+1, k+1], phase-major.
+
+      y[b,o,2u+dy,2v+dx] = sum_{c,i,j} xP[b,c,2u+dy+i,2v+dx+j] K[o,c,i,j]
+                         = (stride-2 conv of xP with K~_(dy,dx))[b,o,u,v]
+      with K~_(dy,dx)[o,c,m,n] = K[o,c,m-dy,n-dx]   (m = i+dy, n = j+dx)
+
+    Exact reindexing of the SAME taps (only float summation order can
+    change); the 4x denser output-channel axis tiles onto MXU lanes."""
+    planes = [jnp.pad(w, ((0, 0), (0, 0), (dy, 1 - dy), (dx, 1 - dx)))
+              for dy in (0, 1) for dx in (0, 1)]
+    return jnp.concatenate(planes, axis=0)
+
+
+def _d2s_reassemble(out4: jax.Array, n_out: int) -> jax.Array:
+    """[B, 4*O, Ho/2, Wo/2] phase-major -> [B, O, Ho, Wo]."""
+    B, _, hu, wv = out4.shape
+    out4 = out4.reshape(B, 2, 2, n_out, hu, wv)
+    out4 = out4.transpose(0, 3, 4, 1, 5, 2)  # [B, O, hu, dy, wv, dx]
+    return out4.reshape(B, n_out, 2 * hu, 2 * wv)
+
+
 def conv2d(
     x: jax.Array,
     w: jax.Array,
@@ -90,9 +128,14 @@ def conv2d(
     accumulates partial products in f32 internally."""
     from gan_deeplearning4j_tpu.runtime import backend
 
+    d2s_out = None
     if backend.conv_s2d_enabled() and _s2d_eligible(x, w, stride, padding):
         x, w = _space_to_depth_rewrite(x, w)
         stride, padding = (1, 1), (0, 0)
+    elif backend.conv_s2d_enabled() and _d2s_eligible(x, w, stride, padding):
+        d2s_out = w.shape[0]
+        w = _d2s_kernel(w)
+        stride = (2, 2)  # padding unchanged: windows cover the same taps
     orig_dtype = x.dtype
     if bf16:
         x = x.astype(jnp.bfloat16)
@@ -108,6 +151,8 @@ def conv2d(
     )
     if bf16:
         out = out.astype(orig_dtype)
+    if d2s_out is not None:
+        out = _d2s_reassemble(out, d2s_out)
     if b is not None:
         out = out + b.reshape(1, -1, 1, 1)
     return out

@@ -13,7 +13,10 @@ import os
 
 import jax
 
-from gan_deeplearning4j_tpu.ops.pallas.bn_act import fused_bn_act_train
+from gan_deeplearning4j_tpu.ops.pallas.bn_act import (
+    fused_bn_act_train,
+    fused_bn_act_train_4d,
+)
 
 _ENABLED = os.environ.get("GAN4J_PALLAS", "0") == "1"
 
@@ -37,4 +40,4 @@ def enabled() -> bool:
         return False
 
 
-__all__ = ["fused_bn_act_train", "enable", "enabled"]
+__all__ = ["fused_bn_act_train", "fused_bn_act_train_4d", "enable", "enabled"]

@@ -172,6 +172,116 @@ def _fused_fwd_impl(x, gamma, beta, eps: float, act_name: str,
     return y[:B, :F], mean[0, :F], var[0, :F]
 
 
+# -- 4-D per-channel variant (r4: the CelebA-family shapes) ---------------
+
+CH_BLOCK = 8  # channels per grid step (TPU wants sublane-divisible blocks)
+
+
+def _fused_kernel_4d(x_ref, gamma_ref, beta_ref, y_ref, mean_ref, var_ref, *,
+                     eps: float, act_name: str, n_valid: int):
+    """CH_BLOCK channels per grid step: block [B_pad, CH_BLOCK, HW_pad],
+    per-channel moments over ALL positions (padded entries are zero;
+    corrected by true count), normalize + scale/shift + activation in the
+    same VMEM residency."""
+    x = x_ref[:]                                   # [B_pad, CB, HW_pad]
+    inv_n = 1.0 / n_valid
+    mean = jnp.sum(x, axis=(0, 2)) * inv_n         # [CB]
+    m2 = jnp.sum(x * x, axis=(0, 2)) * inv_n
+    var = m2 - mean * mean
+    y = (x - mean[None, :, None]) * lax.rsqrt(var[None, :, None] + eps)
+    y = (y * gamma_ref[:, 0][None, :, None]
+         + beta_ref[:, 0][None, :, None])
+    y_ref[:] = act_lib.get(act_name)(y)
+    mean_ref[:] = jnp.broadcast_to(mean[:, None], (CH_BLOCK, LANE))
+    var_ref[:] = jnp.broadcast_to(var[:, None], (CH_BLOCK, LANE))
+
+
+def _reference_4d(x, gamma, beta, eps, act_name):
+    mean = jnp.mean(x, axis=(0, 2, 3))
+    var = jnp.mean(jnp.square(x), axis=(0, 2, 3)) - jnp.square(mean)
+    y = (x - mean[None, :, None, None]) * lax.rsqrt(
+        var[None, :, None, None] + eps)
+    y = y * gamma[None, :, None, None] + beta[None, :, None, None]
+    return act_lib.get(act_name)(y), mean, var
+
+
+# VMEM budget for one 8-channel block: x and y blocks, each
+# double-buffered by the pipeline -> 4 live copies must fit under the
+# ~16MB scoped-vmem limit (with headroom for the scalar vectors)
+_VMEM_BUDGET = 15 << 20
+
+
+def supports_4d(shape) -> bool:
+    """True iff the one-pass 4-D kernel's block fits VMEM for ``shape``
+    [B, C, H, W]; callers fall back to the XLA lowering otherwise."""
+    B, _, H, W = shape
+    B_pad = -(-B // SUBLANE) * SUBLANE
+    HW_pad = -(-(H * W) // LANE) * LANE
+    return 4 * (B_pad * CH_BLOCK * HW_pad * 4) <= _VMEM_BUDGET
+
+
+def _fused_fwd_impl_4d(x, gamma, beta, eps, act_name, interpret):
+    B, C, H, W = x.shape
+    if not supports_4d(x.shape):
+        # block would blow the scoped-vmem limit: XLA path (same math)
+        return _reference_4d(x, gamma, beta, eps, act_name)
+    hw = H * W
+    B_pad = -(-B // SUBLANE) * SUBLANE
+    HW_pad = -(-hw // LANE) * LANE
+    C_pad = -(-C // CH_BLOCK) * CH_BLOCK
+    xp = x.reshape(B, C, hw)
+    if B_pad != B or HW_pad != hw or C_pad != C:
+        xp = jnp.pad(xp, ((0, B_pad - B), (0, C_pad - C), (0, HW_pad - hw)))
+    gp = _pad_to(gamma.reshape(C, 1), C_pad, 1)
+    bp = _pad_to(beta.reshape(C, 1), C_pad, 1)
+    kernel = functools.partial(_fused_kernel_4d, eps=eps, act_name=act_name,
+                               n_valid=B * hw)
+    y, mean, var = pl.pallas_call(
+        kernel,
+        grid=(C_pad // CH_BLOCK,),
+        in_specs=[pl.BlockSpec((B_pad, CH_BLOCK, HW_pad),
+                               lambda c: (0, c, 0)),
+                  pl.BlockSpec((CH_BLOCK, 1), lambda c: (c, 0)),
+                  pl.BlockSpec((CH_BLOCK, 1), lambda c: (c, 0))],
+        out_specs=[pl.BlockSpec((B_pad, CH_BLOCK, HW_pad),
+                                lambda c: (0, c, 0)),
+                   pl.BlockSpec((CH_BLOCK, LANE), lambda c: (c, 0)),
+                   pl.BlockSpec((CH_BLOCK, LANE), lambda c: (c, 0))],
+        out_shape=[
+            jax.ShapeDtypeStruct((B_pad, C_pad, HW_pad), x.dtype),
+            jax.ShapeDtypeStruct((C_pad, LANE), x.dtype),
+            jax.ShapeDtypeStruct((C_pad, LANE), x.dtype),
+        ],
+        interpret=interpret,
+    )(xp, gp, bp)
+    return (y[:B, :C, :hw].reshape(B, C, H, W), mean[:C, 0], var[:C, 0])
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def fused_bn_act_train_4d(x, gamma, beta, eps: float = 1e-5,
+                          act_name: str = "identity",
+                          interpret: bool = False):
+    """4-D per-channel fused BN+activation: -> (y, mean[C], var[C]).
+    Single-device scope (the SPMD 4-D path stays on XLA sync-BN)."""
+    return _fused_fwd_impl_4d(x, gamma, beta, eps, act_name, interpret)
+
+
+def _fwd_4d(x, gamma, beta, eps, act_name, interpret):
+    return _fused_fwd_impl_4d(x, gamma, beta, eps, act_name, interpret), \
+        (x, gamma, beta)
+
+
+def _bwd_4d(eps, act_name, interpret, residuals, cotangents):
+    x, gamma, beta = residuals
+    _, vjp = jax.vjp(
+        lambda a, g, b: _reference_4d(a, g, b, eps, act_name),
+        x, gamma, beta)
+    return vjp(cotangents)
+
+
+fused_bn_act_train_4d.defvjp(_fwd_4d, _bwd_4d)
+
+
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
 def fused_bn_act_train(x, gamma, beta, eps: float = 1e-5,
                        act_name: str = "identity",

@@ -73,6 +73,15 @@ class RuntimeConfig:
     # the reference summation order.  True/False force it either way; only
     # float summation order changes in any case.
     conv_s2d: Optional[bool] = None
+    # Full mixed precision (the documented TPU fast mode): forward/backward
+    # run with bfloat16 params and activations while the MASTER params,
+    # optimizer state, batch-norm computation/statistics and the loss stay
+    # float32 (the standard mixed-precision recipe).  Halves the HBM
+    # traffic of every elementwise/normalization segment — the fused
+    # step's non-MXU time — on top of matmul_bf16's contraction speedup.
+    # Off by default: deviates further from the reference's fixed f32
+    # numerics than matmul_bf16 (quality spot-check in RESULTS.md).
+    compute_bf16: bool = False
     # seed 666 everywhere ("numberOfTheBeast", dl4jGANComputerVision.java:68).
     seed: int = 666
 
@@ -91,6 +100,21 @@ BF16_HELP = (
 def add_bf16_flag(parser) -> None:
     """Register the shared --bf16 CLI flag (one help text, no drift)."""
     parser.add_argument("--bf16", action="store_true", help=BF16_HELP)
+
+
+MP_HELP = (
+    "full mixed precision (the TPU fast mode): forward/backward in "
+    "bfloat16 params/activations with float32 master params, optimizer "
+    "state, batch-norm statistics and loss.  Implies nothing about "
+    "--bf16 (combine them for the fastest path).  Deviates further from "
+    "the reference's fixed float32 numerics — quality spot-check in "
+    "RESULTS.md."
+)
+
+
+def add_mp_flag(parser) -> None:
+    """Register the shared --mp (compute_bf16) CLI flag."""
+    parser.add_argument("--mp", action="store_true", help=MP_HELP)
 
 
 def configure(**kwargs) -> RuntimeConfig:

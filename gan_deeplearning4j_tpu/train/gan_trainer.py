@@ -669,7 +669,7 @@ class GANTrainer:
             self.classifier,
             os.path.join(c.res_path,
                          f"{name}_{self.w.classifier_model_name}_model.zip"))
-        self.metrics.flush()
+        self.metrics.flush(wait=True)
         return {
             "steps": self.batch_counter,
             "examples_per_sec": (

@@ -113,10 +113,13 @@ def main(argv=None) -> Dict[str, float]:
     from gan_deeplearning4j_tpu.runtime import backend
 
     backend.add_bf16_flag(p)
+    backend.add_mp_flag(p)
     args = p.parse_args(argv)
 
     if args.bf16:
         backend.configure(matmul_bf16=True)
+    if args.mp:
+        backend.configure(compute_bf16=True)
     check_recovery_args(p, args)
 
     config = default_config(

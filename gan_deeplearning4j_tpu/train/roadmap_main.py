@@ -286,7 +286,7 @@ def train(family: str, iterations: int, batch_size: int, res_path: str,
     device_fence((d_loss, g_loss))
     steps_timed = iterations - steady_start if steady_t0 is not None else 0
     wall = (time.perf_counter() - steady_t0) if steady_t0 is not None else 0.0
-    metrics.flush()
+    metrics.flush(wait=True)
     for name, graph in (("gen", pair.gen), ("dis", pair.dis)):
         serialization.write_model(
             graph, os.path.join(res_path, f"{family}_{name}_model.zip"))
@@ -375,9 +375,12 @@ def main(argv=None) -> Dict[str, float]:
     from gan_deeplearning4j_tpu.runtime import backend
 
     backend.add_bf16_flag(p)
+    backend.add_mp_flag(p)
     args = p.parse_args(argv)
     if args.bf16:
         backend.configure(matmul_bf16=True)
+    if args.mp:
+        backend.configure(compute_bf16=True)
     res = args.res_path or os.path.join("outputs", args.family)
     result = train(args.family, args.iterations, args.batch_size, res,
                    args.n_train, args.print_every, args.n_devices,

@@ -270,6 +270,54 @@ def test_conv_s2d_rewrite_matches_reference():
         np.asarray(conv_ops.conv2d(x1, w1, None, stride=(1, 1))))
 
 
+def test_conv_d2s_rewrite_matches_reference():
+    """The output-side polyphase rewrite of low-C_out stride-1 convs (the
+    generator's final C_out=1 synthesis conv — r4's MFU work) is an exact
+    reindexing: forward, weight- AND input-gradients match the direct
+    conv up to float summation order; ineligible shapes (odd output, big
+    C_out) are untouched."""
+    import jax
+
+    from gan_deeplearning4j_tpu.ops import conv as conv_ops
+    from gan_deeplearning4j_tpu.runtime import backend
+
+    rng = np.random.RandomState(4)
+    x = jnp.asarray(rng.randn(3, 64, 28, 28).astype(np.float32))
+    w = jnp.asarray(rng.randn(1, 64, 5, 5).astype(np.float32) * 0.1)
+    b = jnp.asarray(rng.randn(1).astype(np.float32))
+    args = dict(stride=(1, 1), padding=(2, 2))
+
+    ref = conv_ops.conv2d(x, w, b, **args)
+    ref_gw = jax.grad(lambda w: (conv_ops.conv2d(x, w, b, **args) ** 2)
+                      .sum())(w)
+    ref_gx = jax.grad(lambda x: (conv_ops.conv2d(x, w, b, **args) ** 2)
+                      .sum())(x)
+    backend.configure(conv_s2d=True)
+    try:
+        assert conv_ops._d2s_eligible(x, w, (1, 1), (2, 2))
+        out = conv_ops.conv2d(x, w, b, **args)
+        assert not np.array_equal(np.asarray(out), np.asarray(ref)), \
+            "d2s path bitwise-equal to direct conv: rewrite did not engage"
+        out_gw = jax.grad(lambda w: (conv_ops.conv2d(x, w, b, **args) ** 2)
+                          .sum())(w)
+        out_gx = jax.grad(lambda x: (conv_ops.conv2d(x, w, b, **args) ** 2)
+                          .sum())(x)
+        # odd output size / large C_out: ineligible, bitwise-identical
+        x_odd = jnp.asarray(rng.randn(2, 8, 9, 9).astype(np.float32))
+        w_odd = jnp.asarray(rng.randn(1, 8, 3, 3).astype(np.float32))
+        assert not conv_ops._d2s_eligible(x_odd, w_odd, (1, 1), (1, 1))
+        w_big = jnp.asarray(rng.randn(32, 64, 5, 5).astype(np.float32))
+        assert not conv_ops._d2s_eligible(x, w_big, (1, 1), (2, 2))
+    finally:
+        backend.configure(conv_s2d=None)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(out_gw), np.asarray(ref_gw),
+                               rtol=1e-4, atol=1e-3)
+    np.testing.assert_allclose(np.asarray(out_gx), np.asarray(ref_gx),
+                               rtol=1e-4, atol=1e-3)
+
+
 def test_conv_s2d_auto_resolution():
     """Tri-state default: auto (None) disables the rewrite on the CPU
     backend (reference summation order for every numerics test) and an

@@ -34,6 +34,36 @@ def test_fused_bn_act_forward(shape, act):
                                rtol=1e-4, atol=1e-5)
 
 
+@pytest.mark.parametrize("shape", [(8, 5, 7, 7), (6, 64, 8, 8),
+                                   (9, 3, 16, 16)])
+def test_fused_bn_act_4d_forward_and_grad(shape):
+    """The r4 4-D per-channel kernel (CelebA shapes): forward and
+    gradients match the plain-jnp reference, padding included."""
+    from gan_deeplearning4j_tpu.ops.pallas.bn_act import (
+        _reference_4d,
+        fused_bn_act_train_4d,
+    )
+
+    rng = np.random.RandomState(7)
+    x = jnp.asarray(rng.randn(*shape).astype(np.float32) * 2 + 1)
+    gamma = jnp.asarray(rng.rand(shape[1]).astype(np.float32) + 0.5)
+    beta = jnp.asarray(rng.randn(shape[1]).astype(np.float32))
+    y, mean, var = fused_bn_act_train_4d(x, gamma, beta, 1e-5, "tanh", True)
+    y_ref, mean_ref, var_ref = _reference_4d(x, gamma, beta, 1e-5, "tanh")
+    np.testing.assert_allclose(np.asarray(mean), np.asarray(mean_ref),
+                               rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(var), np.asarray(var_ref),
+                               rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(y), np.asarray(y_ref),
+                               rtol=1e-4, atol=1e-5)
+    g = jax.grad(lambda x: jnp.sum(
+        fused_bn_act_train_4d(x, gamma, beta, 1e-5, "tanh", True)[0] ** 2))(x)
+    g_ref = jax.grad(lambda x: jnp.sum(
+        _reference_4d(x, gamma, beta, 1e-5, "tanh")[0] ** 2))(x)
+    np.testing.assert_allclose(np.asarray(g), np.asarray(g_ref),
+                               rtol=1e-4, atol=1e-4)
+
+
 def test_fused_bn_act_gradients():
     rng = np.random.RandomState(1)
     x = jnp.asarray(rng.randn(16, 64).astype(np.float32))

@@ -154,14 +154,13 @@ def test_conditional_fidelity_metric():
         def __init__(self, faithful):
             self.faithful = faithful
 
-        def _forward(self, params, inputs, train, rng):
-            lab = np.asarray(inputs["label"])
-            cls = np.argmax(lab, axis=1)
+        def output(self, z, label, params=None):
+            cls = np.argmax(np.asarray(label), axis=1)
             if not self.faithful:
                 cls = np.zeros_like(cls)  # collapsed: always class 0
             vals = np.repeat((cls / k).astype(np.float32)[:, None],
                              3 * 8 * 8, axis=1)
-            return {"out": jnp.asarray(vals)}, None
+            return [jnp.asarray(vals)]
 
     kw = dict(sample_shape=(3, 8, 8), z_size=2, n_per_class=8,
               probe_steps=300, probe_batch=64)
@@ -307,8 +306,11 @@ def test_multistep_mesh_matches_single_device():
     def run(mesh):
         pair = GANPair(M.build_generator(cfg), M.build_discriminator(cfg),
                        mesh=mesh)
+        # batch 32 over 4 shards: per-shard real/fake segments of 8 stay
+        # multiples of MinibatchStdDev's group (4), so shard grouping ==
+        # single-device grouping (the layer's documented mesh contract)
         step_fn, state = pair.make_multistep(
-            jnp.asarray(x), jnp.asarray(y), batch_size=8, steps_per_call=3,
+            jnp.asarray(x), jnp.asarray(y), batch_size=32, steps_per_call=3,
             n_critic=1, z_size=cfg.z_size, seed_key=key)
         state, (dl, gl) = step_fn(state)
         pair.adopt_state(state)
