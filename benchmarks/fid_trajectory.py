@@ -1,0 +1,112 @@
+"""FID trajectory of a CV acceptance run — the r3 outlier-seed probe.
+
+VERDICT r3 weak-#6: one of the ten acceptance seeds (555) landed
+fid_primary 56.5 against a 16-38 band, with the EMA score WORSE than the
+live weights — unexplained.  This script re-runs a seed with periodic
+checkpoints and scores fid_frozen (live and EMA weights) at every 1k
+steps, distinguishing the two candidate failure modes:
+
+  - late collapse: the live trajectory degrades near the end;
+  - EMA pathology: the live trajectory is fine but the 0.999-decay
+    average trails a moving equilibrium (the adversarial weights orbit
+    rather than converge, so the trajectory MEAN can sit off the orbit).
+
+Prints one JSON line with the per-checkpoint trajectory.
+
+Run (TPU): python benchmarks/fid_trajectory.py [--seed 555]
+           [--iterations 10000] [--every 1000] [--fid-samples 10000]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+
+import numpy as np
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+
+def main(argv=None) -> None:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--seed", type=int, default=555)
+    p.add_argument("--iterations", type=int, default=10000)
+    p.add_argument("--every", type=int, default=1000)
+    p.add_argument("--fid-samples", type=int, default=10000)
+    p.add_argument("--res-path", default=None)
+    args = p.parse_args(argv)
+
+    import jax.numpy as jnp
+
+    from gan_deeplearning4j_tpu.checkpoint import TrainCheckpointer
+    from gan_deeplearning4j_tpu.data import datasets
+    from gan_deeplearning4j_tpu.eval import fid as fid_lib
+    from gan_deeplearning4j_tpu.eval import fid_extractor as fx
+    from gan_deeplearning4j_tpu.train import cv_main
+    from gan_deeplearning4j_tpu.train.gan_trainer import GANTrainer
+
+    res = args.res_path or tempfile.mkdtemp(prefix="fid_traj_")
+    n_ckpts = args.iterations // args.every + 1
+    config = cv_main.default_config(
+        seed=args.seed, num_iterations=args.iterations, res_path=res,
+        checkpoint_every=args.every, checkpoint_keep=n_ckpts,
+        ema_decay=0.999, metrics=False,
+        print_every=10 ** 9, save_every=args.iterations)
+    workload = cv_main.CVWorkload()
+    trainer = GANTrainer(workload, config)
+    trainer.train(log=lambda s: None)
+
+    real, _ = datasets.load_split(os.path.join(res, "mnist_test.csv"),
+                                  config.label_index)
+    real = real[: args.fid_samples].astype("float32")
+    frozen = fx.load_extractor()
+    f_real = fid_lib.extract_features(frozen, real, fx.FEATURE_LAYER)
+    mu_r, cov_r = f_real.mean(axis=0), np.cov(f_real, rowvar=False)
+
+    def fid_of(gen_graph, params=None) -> float:
+        orig = gen_graph.params
+        if params is not None:
+            gen_graph.params = params
+        try:
+            gx = fid_lib.synthesize_pixels(
+                gen_graph, args.fid_samples, real.shape[1],
+                z_size=config.z_size)
+        finally:
+            gen_graph.params = orig
+        f = fid_lib.extract_features(frozen, gx, fx.FEATURE_LAYER)
+        return float(fid_lib.frechet_distance(
+            mu_r, cov_r, f.mean(axis=0), np.cov(f, rowvar=False)))
+
+    ckpt = TrainCheckpointer(os.path.join(res, "checkpoints"),
+                             keep=n_ckpts)
+    trajectory = []
+    graphs = {"dis": trainer.dis, "gen": trainer.gen, "gan": trainer.gan,
+              "classifier": trainer.classifier}
+    for step in ckpt.steps():
+        _, extra = ckpt.restore(graphs, step=step)
+        ema = {}
+        for key, v in extra.items():
+            if key.startswith("ema:"):
+                _, layer, name = key.split(":", 2)
+                ema.setdefault(layer, {})[name] = jnp.asarray(v)
+        ema_params = ({layer: ema.get(layer, {})
+                       for layer in trainer.gen.params} if ema else None)
+        row = {"step": step, "fid_frozen": fid_of(trainer.gen)}
+        if ema_params is not None:
+            row["fid_frozen_ema"] = fid_of(trainer.gen, ema_params)
+        trajectory.append(row)
+        print(f"[traj] {row}", file=sys.stderr, flush=True)
+
+    print(json.dumps({
+        "metric": "fid_trajectory", "seed": args.seed,
+        "iterations": args.iterations, "trajectory": trajectory,
+    }))
+
+
+if __name__ == "__main__":
+    main()
