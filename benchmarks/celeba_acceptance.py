@@ -1,0 +1,119 @@
+"""CelebA-64 acceptance: 10k-step EMA run with a per-1k frozen-FID
+trajectory (VERDICT r4 next-step #1).
+
+The CelebA family is the one with TPU-scale convolutions, so its quality
+evidence must match the MNIST family's discipline: a full 10k-iteration
+EMA training run (roadmap_main's engine — GANPair multistep, checkpointed
+every 1k), then the frozen 64x64 attribute-CNN extractor
+(eval/fid_extractor.py, committed asset) scores FID at every checkpoint,
+live and EMA weights, against a held-out surrogate draw.  Replaces the
+r4 state of "eyeballed grids at 3k steps" with a committed number +
+trajectory.
+
+Prints ONE JSON line:
+  {"metric": "celeba_fid_frozen", "value": <final EMA FID>,
+   "trajectory": [{"step": N, "fid": F, "fid_ema": F}, ...],
+   "examples_per_sec": N, ...}
+
+Run (TPU): python benchmarks/celeba_acceptance.py
+           [--iterations 10000] [--every 1000] [--fid-samples 5000]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+
+def main(argv=None) -> None:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--iterations", type=int, default=10000)
+    p.add_argument("--every", type=int, default=1000)
+    p.add_argument("--batch", type=int, default=128)
+    p.add_argument("--n-train", type=int, default=10000)
+    p.add_argument("--fid-samples", type=int, default=5000)
+    p.add_argument("--ema-decay", type=float, default=0.999)
+    p.add_argument("--res-path", default=None)
+    args = p.parse_args(argv)
+    if args.iterations % args.every or args.iterations <= 0:
+        # roadmap_main checkpoints only at multiples of --every: a ragged
+        # horizon would silently report an earlier step's FID as final
+        raise SystemExit("--iterations must be a positive multiple of "
+                         "--every")
+
+    from gan_deeplearning4j_tpu.checkpoint import TrainCheckpointer
+    from gan_deeplearning4j_tpu.data import datasets
+    from gan_deeplearning4j_tpu.eval import fid as fid_lib
+    from gan_deeplearning4j_tpu.eval import fid_extractor as fx
+    from gan_deeplearning4j_tpu.models import dcgan_celeba
+    from gan_deeplearning4j_tpu.train import roadmap_main
+
+    res = args.res_path or tempfile.mkdtemp(prefix="celeba_accept_")
+    n_ckpts = args.iterations // args.every + 1
+
+    result = roadmap_main.train(
+        "celeba", args.iterations, args.batch, res, args.n_train,
+        print_every=args.every, ema_decay=args.ema_decay,
+        checkpoint_every=args.every, checkpoint_keep=n_ckpts,
+        log=lambda s: print(s, file=sys.stderr, flush=True))
+
+    # held-out real draw (training used the default seed-666 table)
+    cfg = dcgan_celeba.CelebAConfig()
+    real = datasets.synthetic_celeba(args.fid_samples, seed=cfg.seed + 1)
+    frozen = fx.load_extractor_celeba()
+    f_real = fid_lib.extract_features(frozen, real, fx.FEATURE_LAYER,
+                                      batch_size=250)
+
+    gen = dcgan_celeba.build_generator(cfg)
+
+    def fid_of(params=None) -> float:
+        orig = gen.params
+        if params is not None:
+            gen.params = params
+        try:
+            gx = fid_lib.synthesize_pixels(
+                gen, args.fid_samples, real.shape[1], z_size=cfg.z_size,
+                batch_size=250)
+        finally:
+            gen.params = orig
+        f = fid_lib.extract_features(frozen, gx, fx.FEATURE_LAYER,
+                                     batch_size=250)
+        return float(fid_lib.fid_from_features(f_real, f))
+
+    ckpt = TrainCheckpointer(os.path.join(res, "celeba_ckpt"),
+                             keep=n_ckpts)
+    dis = dcgan_celeba.build_discriminator(cfg)
+    trajectory = []
+    for step in ckpt.steps():
+        _, extra = ckpt.restore({"gen": gen, "dis": dis}, step=step)
+        row = {"step": step, "fid": fid_of()}
+        if "ema" in extra:
+            row["fid_ema"] = fid_of(extra["ema"])
+        trajectory.append(row)
+        print(f"[celeba-accept] {row}", file=sys.stderr, flush=True)
+
+    final = trajectory[-1] if trajectory else {}
+    print(json.dumps({
+        "metric": "celeba_fid_frozen",
+        "value": final.get("fid_ema", final.get("fid")),
+        "unit": "frozen-FID (64x64 attribute-CNN space)",
+        "iterations": args.iterations,
+        "batch": args.batch,
+        "ema_decay": args.ema_decay,
+        "examples_per_sec": result["examples_per_sec"],
+        "d_loss": result["d_loss"],
+        "g_loss": result["g_loss"],
+        "trajectory": trajectory,
+        "res_path": res,
+    }, default=float))
+
+
+if __name__ == "__main__":
+    main()

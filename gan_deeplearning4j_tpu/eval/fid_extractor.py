@@ -99,6 +99,108 @@ def save_asset(graph, path: str = ASSET_PATH) -> str:
     return path
 
 
+# --------------------------------------------------------------------------
+# CelebA-64 frozen extractor (VERDICT r4 next-step #1): same recipe
+# discipline at the one shape with TPU-scale convs.  Real CelebA is an
+# attribute-labeled dataset (40 binary attributes), so the domain-matched
+# frozen embedding is an attribute-prediction CNN trained ONCE on the
+# procedural surrogate's 8 controllable attributes
+# (data/datasets.py CELEBA_ATTR_NAMES) under a fully pinned recipe and
+# committed as an asset zip.  Features = the 256-wide penultimate dense
+# ("feat"), same convention as the MNIST extractor above.
+
+CELEBA_RECIPE_VERSION = 1
+CELEBA_ASSET_PATH = os.path.join(
+    _ASSET_DIR, f"fid_extractor_celeba_v{CELEBA_RECIPE_VERSION}.zip")
+
+# pinned CelebA-extractor recipe — changing ANY of these bumps the version
+_CELEBA_SEED = 666
+_CELEBA_N_TRAIN = 8000
+_CELEBA_BATCH = 100
+_CELEBA_STEPS = 600
+_CELEBA_LR = 1e-3
+
+
+def build_extractor_celeba():
+    """Fixed 64x64 architecture: 4 stride-2 convs (3->16->32->64->128,
+    4x4 pad 1 — the DCGAN-D shape family) -> 256-d dense ("feat") ->
+    8 sigmoid attribute heads.  ~0.8M params."""
+    from gan_deeplearning4j_tpu.graph import (
+        Conv2D,
+        Dense,
+        GraphBuilder,
+        InputSpec,
+        Output,
+    )
+    from gan_deeplearning4j_tpu.optim.rmsprop import RmsProp
+
+    lr = RmsProp(_CELEBA_LR, 1e-8, 1e-8)
+    b = GraphBuilder(seed=_CELEBA_SEED, l2=1e-4, activation="relu",
+                     weight_init="xavier", clip_threshold=1.0)
+    b.add_inputs("in")
+    b.set_input_types(InputSpec.convolutional_flat(64, 64, 3))
+    chans = [3, 16, 32, 64, 128]
+    prev = "in"
+    for i in range(4):
+        name = f"conv{i + 1}"
+        b.add_layer(name, Conv2D(kernel=(4, 4), stride=(2, 2),
+                                 padding=(1, 1), n_in=chans[i],
+                                 n_out=chans[i + 1], updater=lr), prev)
+        prev = name
+    b.add_layer(FEATURE_LAYER, Dense(n_out=256, updater=lr), prev)
+    b.add_layer("out", Output(n_out=8, loss="xent", activation="sigmoid",
+                              updater=lr), FEATURE_LAYER)
+    b.set_outputs("out")
+    return b.build().init()
+
+
+def train_extractor_celeba(log=print):
+    """The pinned CelebA recipe: attribute-labeled surrogate, seed-666
+    batches, ``_CELEBA_STEPS`` steps.  Deterministic end to end."""
+    import jax.numpy as jnp
+
+    from gan_deeplearning4j_tpu.data import datasets
+
+    x, attrs = datasets.synthetic_celeba(
+        _CELEBA_N_TRAIN, seed=_CELEBA_SEED, return_attrs=True)
+    graph = build_extractor_celeba()
+    order = np.random.RandomState(_CELEBA_SEED)
+    for step in range(_CELEBA_STEPS):
+        idx = order.randint(0, _CELEBA_N_TRAIN, _CELEBA_BATCH)
+        loss = graph.fit(jnp.asarray(x[idx]), jnp.asarray(attrs[idx]))
+        if log and (step + 1) % 100 == 0:
+            log(f"[fid-extractor-celeba] step {step + 1}/{_CELEBA_STEPS} "
+                f"loss {float(loss):.4f}")
+    return graph
+
+
+_cached_celeba = None
+
+
+def load_extractor_celeba():
+    """The committed frozen 64x64 extractor (cached per process)."""
+    global _cached_celeba
+    if _cached_celeba is None:
+        if not os.path.exists(CELEBA_ASSET_PATH):
+            raise FileNotFoundError(
+                f"{CELEBA_ASSET_PATH} missing — regenerate with: python -m "
+                "gan_deeplearning4j_tpu.eval.fid_extractor --family celeba")
+        from gan_deeplearning4j_tpu.graph import serialization
+
+        _cached_celeba = serialization.read_model(CELEBA_ASSET_PATH)
+    return _cached_celeba
+
+
+def frozen_fid_celeba(real: np.ndarray, generated: np.ndarray,
+                      batch_size: int = 250) -> float:
+    """FID between 64x64 pixel sets ([n, 3*64*64], tanh range) in the
+    FROZEN CelebA feature space."""
+    from gan_deeplearning4j_tpu.eval import fid as fid_lib
+
+    return fid_lib.compute_fid(load_extractor_celeba(), real, generated,
+                               layer=FEATURE_LAYER, batch_size=batch_size)
+
+
 _cached = None
 
 
@@ -129,16 +231,37 @@ def frozen_fid(real: np.ndarray, generated: np.ndarray,
                                layer=FEATURE_LAYER, batch_size=batch_size)
 
 
-def main() -> None:
+def main(argv=None) -> None:
+    import argparse
+
     from gan_deeplearning4j_tpu.eval import metrics  # noqa: F401 (package init)
+
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--family", choices=("mnist", "celeba"), default="mnist")
+    args = p.parse_args(argv)
+
+    import jax.numpy as jnp
+
+    from gan_deeplearning4j_tpu.data import datasets
+
+    if args.family == "celeba":
+        graph = train_extractor_celeba()
+        # held-out self-check: per-attribute accuracy before freezing
+        xt, at = datasets.synthetic_celeba(2000, seed=_CELEBA_SEED + 1,
+                                           return_attrs=True)
+        pred = np.asarray(graph.output(jnp.asarray(xt))[0]) > 0.5
+        per_attr = (pred == (at > 0.5)).mean(axis=0)
+        acc = float(per_attr.mean())
+        print("[fid-extractor-celeba] held-out per-attr acc "
+              + " ".join(f"{a:.3f}" for a in per_attr))
+        path = save_asset(graph, CELEBA_ASSET_PATH)
+        print(f"[fid-extractor-celeba] wrote {path} "
+              f"(recipe v{CELEBA_RECIPE_VERSION}, mean acc {acc:.4f})")
+        return
 
     graph = train_extractor()
     # quick self-check on held-out data before freezing
-    from gan_deeplearning4j_tpu.data import datasets
-
     xt, yt = datasets.synthetic_mnist(4000, seed=_SEED + 1)
-    import jax.numpy as jnp
-
     pred = np.asarray(graph.output(jnp.asarray(xt))[0]).argmax(axis=1)
     acc = float((pred == yt).mean())
     print(f"[fid-extractor] held-out accuracy {acc:.4f}")

@@ -312,6 +312,12 @@ class GANPair:
         def step_fn(state):
             return jit_multi(state, *invariants)
 
+        # introspection hooks: the benchmark's cost-analysis path
+        # (bench.py celeba block) lowers the jitted program against the
+        # exact invariants this closure would pass
+        step_fn.jitted = jit_multi
+        step_fn.invariants = invariants
+
         ema0 = ema_lib.ema_init(self.gen) if ema_decay else None
         # ``start_step`` seeds the carry's iteration counter, which drives
         # the counter-based z/batch draws (fold_in(key0, it)) — a resumed

@@ -119,7 +119,8 @@ def _data(family: str, n: int, seed: int, sample_shape=None,
 def train(family: str, iterations: int, batch_size: int, res_path: str,
           n_train: int, print_every: int, n_devices=None,
           data_dir: str = None, ema_decay: float = 0.0,
-          checkpoint_every: int = 0, resume: bool = False,
+          checkpoint_every: int = 0, checkpoint_keep: int = 3,
+          resume: bool = False,
           steps_per_call: int = None, lr_decay_steps: int = None,
           fidelity_steps: int = 400, log=print) -> Dict[str, float]:
     os.makedirs(res_path, exist_ok=True)
@@ -199,7 +200,8 @@ def train(family: str, iterations: int, batch_size: int, res_path: str,
             from gan_deeplearning4j_tpu.checkpoint import TrainCheckpointer
 
             ckpt = TrainCheckpointer(os.path.join(res_path,
-                                                  f"{family}_ckpt"))
+                                                  f"{family}_ckpt"),
+                                     keep=checkpoint_keep)
             if resume and ckpt.latest_step() is not None:
                 start_it, extra = ckpt.restore(
                     {"gen": pair.gen, "dis": pair.dis})

@@ -31,6 +31,37 @@ def test_frozen_extractor_version_pin():
     """A recipe bump must change the asset path — a stale asset can never
     be loaded under a new recipe version silently."""
     assert f"_v{fx.RECIPE_VERSION}.zip" in fx.ASSET_PATH
+    assert f"_v{fx.CELEBA_RECIPE_VERSION}.zip" in fx.CELEBA_ASSET_PATH
+
+
+def test_celeba_attrs_pixels_unchanged_and_balanced():
+    """return_attrs must not perturb the pixel stream (r4 CelebA evidence
+    was generated without it), and every attribute stays usable as a
+    training target (neither constant nor near-constant)."""
+    a = datasets.synthetic_celeba(128, seed=7)
+    b, attrs = datasets.synthetic_celeba(128, seed=7, return_attrs=True)
+    assert np.array_equal(a, b)
+    assert attrs.shape == (128, len(datasets.CELEBA_ATTR_NAMES))
+    _, big = datasets.synthetic_celeba(1500, seed=8, return_attrs=True)
+    means = big.mean(axis=0)
+    assert np.all(means > 0.3) and np.all(means < 0.7), means
+
+
+def test_frozen_celeba_extractor_discriminates():
+    """The committed 64x64 asset embeds: FID(real, real') far below
+    FID(real, junk) and FID(real, color-collapsed); deterministic."""
+    x1 = datasets.synthetic_celeba(400, seed=10)
+    x2 = datasets.synthetic_celeba(400, seed=20)
+    junk = np.random.RandomState(1).uniform(
+        -1, 1, x1.shape).astype(np.float32)
+    close = fx.frozen_fid_celeba(x1, x2)
+    far = fx.frozen_fid_celeba(x1, junk)
+    assert close < 8.0, close
+    assert far > 10 * close, (close, far)
+    gray = x1.reshape(400, 3, -1).mean(axis=1)  # [n, H*W]
+    collapsed = np.repeat(gray[:, None, :], 3, axis=1).reshape(400, -1)
+    assert fx.frozen_fid_celeba(x1, collapsed) > 10 * close
+    assert fx.frozen_fid_celeba(x1, x2) == close  # deterministic reload
 
 
 @pytest.mark.slow
