@@ -40,6 +40,11 @@ def main(argv=None) -> None:
     p.add_argument("--n-train", type=int, default=10000)
     p.add_argument("--fid-samples", type=int, default=5000)
     p.add_argument("--ema-decay", type=float, default=0.999)
+    p.add_argument("--lr-decay-steps", type=int, default=-1,
+                   help="hold-then-sigmoid-decay horizon; -1 (default) = "
+                        "the run length (the measured stabilizer: constant "
+                        "LR degrades past ~3k as D overpowers G), 0 = "
+                        "constant LR")
     p.add_argument("--res-path", default=None)
     args = p.parse_args(argv)
     if args.iterations % args.every or args.iterations <= 0:
@@ -58,14 +63,19 @@ def main(argv=None) -> None:
     res = args.res_path or tempfile.mkdtemp(prefix="celeba_accept_")
     n_ckpts = args.iterations // args.every + 1
 
+    decay = args.iterations if args.lr_decay_steps < 0 \
+        else (args.lr_decay_steps or None)
     result = roadmap_main.train(
         "celeba", args.iterations, args.batch, res, args.n_train,
         print_every=args.every, ema_decay=args.ema_decay,
         checkpoint_every=args.every, checkpoint_keep=n_ckpts,
+        lr_decay_steps=decay,
         log=lambda s: print(s, file=sys.stderr, flush=True))
 
-    # held-out real draw (training used the default seed-666 table)
-    cfg = dcgan_celeba.CelebAConfig()
+    # held-out real draw (training used the default seed-666 table).
+    # decay_steps must match the run's: checkpoint restore validates the
+    # opt_state tree and a Scheduled updater carries an extra counter.
+    cfg = dcgan_celeba.CelebAConfig(decay_steps=decay)
     real = datasets.synthetic_celeba(args.fid_samples, seed=cfg.seed + 1)
     frozen = fx.load_extractor_celeba()
     f_real = fid_lib.extract_features(frozen, real, fx.FEATURE_LAYER,

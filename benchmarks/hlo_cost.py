@@ -1,0 +1,392 @@
+"""Compile-time per-op attribution of the training programs' cost
+(VERDICT r4 next-step #2): no profiler needed — the tunneled profiler's
+op ids are opaque, but `jit.lower().compile().as_text()` yields the
+optimized HLO with full shapes, windows and source metadata, enough to
+compute per-op FLOPs and bytes and a roofline time estimate for every
+instruction.
+
+For each named program this script reports:
+  - per-op table rows: {op, kind, flops, bytes, t_est_us, source}
+    sorted by the roofline estimate t_est = max(flops/PEAK, bytes/BW);
+  - aggregates: matmul/conv FLOPs vs the XLA cost model's total,
+    total top-level bytes, roofline-implied step time, and the measured
+    step time when the chip is reachable (--measure).
+
+Programs: the MNIST protocol multistep at b200 f32 (the default
+headline), b1600 fast mode (s2d+bf16+mp), b3200 f32 (the r4 regression),
+and the CelebA-64 GANPair multistep at b128.
+
+Run: python benchmarks/hlo_cost.py [--programs b200_f32,b1600_fast,...]
+     [--measure] [--top 12]
+Prints ONE JSON line; human-readable tables go to stderr.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import re
+import sys
+from typing import Dict, List, Optional, Tuple
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+# v5e (TPU v5 lite): dense bf16 peak and HBM bandwidth — the roofline
+# axes.  f32 convs execute through the MXU's bf16 pipeline (multiple
+# passes), so PEAK is the optimistic denominator for both dtypes.
+PEAK_FLOPS = 197e12
+HBM_BW = 819e9
+
+_DTYPE_BYTES = {"f32": 4, "bf16": 2, "f16": 2, "s32": 4, "u32": 4,
+                "s8": 1, "u8": 1, "pred": 1, "s64": 8, "u64": 8,
+                "f64": 8, "s16": 2, "u16": 2}
+
+_SHAPE_RE = re.compile(r"(\w+)\[([\d,]*)\]")
+_INSTR_RE = re.compile(
+    r"^\s*(?:ROOT\s+)?%([\w.\-]+)\s*=\s*(\([^)]*\)|\S+)\s+([\w\-]+)\(")
+_OPERAND_RE = re.compile(r"%([\w.\-]+)")
+
+
+def _shape_bytes(type_str: str) -> int:
+    """Total logical bytes of an HLO type string (tuples summed)."""
+    total = 0
+    for m in _SHAPE_RE.finditer(type_str):
+        dt, dims = m.group(1), m.group(2)
+        if dt not in _DTYPE_BYTES:
+            continue
+        n = 1
+        if dims:
+            for d in dims.split(","):
+                n *= int(d)
+        total += n * _DTYPE_BYTES[dt]
+    return total
+
+
+def _out_elems(type_str: str) -> int:
+    m = _SHAPE_RE.search(type_str)
+    if not m:
+        return 0
+    n = 1
+    if m.group(2):
+        for d in m.group(2).split(","):
+            n *= int(d)
+    return n
+
+
+def _conv_flops(line: str, out_type: str,
+                shapes: Dict[str, str]) -> Optional[float]:
+    """2 * out_elems * K for a convolution instruction; K = reduction
+    size = window elements x input feature depth, read off dim_labels
+    and the rhs operand's shape."""
+    out_n = _out_elems(out_type)
+    dl = re.search(r"dim_labels=(\S+?)(?:,|$| )", line)
+    if not dl:
+        return None
+    labels = dl.group(1)
+    lhs_l, rest = labels.split("_", 1)
+    rhs_l, out_l = rest.split("->")
+    ops = _OPERAND_RE.findall(line.split("(", 1)[1])
+    if len(ops) < 2:
+        return None
+    rhs_type = shapes.get(ops[1])
+    if rhs_type is None:
+        return None
+    m = _SHAPE_RE.search(rhs_type)
+    if not m or not m.group(2):
+        return None
+    rhs_dims = [int(d) for d in m.group(2).split(",")]
+    if len(rhs_dims) != len(rhs_l):
+        return None
+    # reduction = input-feature dim x spatial window dims of the rhs
+    k = 1
+    for ch, d in zip(rhs_l, rhs_dims):
+        if ch == "i" or ch.isdigit():
+            k *= d
+    # grouped convs (feature_group_count) divide the i-depth; the s2d/d2s
+    # rewrites don't use them, but parse defensively
+    g = re.search(r"feature_group_count=(\d+)", line)
+    if g:
+        k //= max(1, int(g.group(1)))
+    return 2.0 * out_n * k
+
+
+def analyze_hlo(txt: str) -> List[dict]:
+    """Per-instruction rows from optimized HLO text.  Instructions in
+    "executed-at-top-level" computations (ENTRY, while bodies/conds —
+    targets of ``body=``/``condition=``) carry bytes; computations that
+    are fusion internals or scalar lambdas (targets of ``calls=`` /
+    ``to_apply=``) don't — their HBM traffic is the call site's operand/
+    result bytes.  Convolution FLOPs are attributed wherever the
+    instruction appears (TPU convs live INSIDE kConv fusion bodies)."""
+    shapes: Dict[str, str] = {}
+    for m in re.finditer(
+            r"%([\w.\-]+)\s*=\s*((?:\([^)]*\))|(?:\w+\[[\d,]*\]\S*))", txt):
+        shapes.setdefault(m.group(1), m.group(2))
+    # call-graph pass: computations whose instructions are NOT separately
+    # scheduled (inlined fusion bodies, reduction lambdas, async slices)
+    inlined = set()
+    for m in re.finditer(r"(?:calls|to_apply|select|scatter)=%([\w.\-]+)",
+                         txt):
+        inlined.add(m.group(1))
+
+    rows: List[dict] = []
+    computation = ""
+    in_fusion_body = False
+    for line in txt.splitlines():
+        header = re.match(r"^\s*(?:ENTRY\s+)?(?:ROOT\s+)?%?([\w.\-]+)\s+\(",
+                          line) if (line.rstrip().endswith("{")
+                                    and "->" in line) else None
+        if header:
+            computation = header.group(1)
+            in_fusion_body = computation in inlined
+            continue
+        m = _INSTR_RE.match(line)
+        if not m:
+            continue
+        name, out_type, op = m.groups()
+        if op in ("parameter", "constant", "get-tuple-element", "tuple",
+                  "bitcast"):
+            continue
+        out_bytes = _shape_bytes(out_type)
+        if op in ("slice", "dynamic-slice", "gather"):
+            # reads only the sliced window, not the whole operand
+            in_bytes = out_bytes
+        elif op == "dynamic-update-slice":
+            # writes (and reads) only the update window
+            ops_ = _OPERAND_RE.findall(line.split("(", 1)[1])
+            upd = _shape_bytes(shapes.get(ops_[1], "")) if len(ops_) > 1 \
+                else out_bytes
+            in_bytes, out_bytes = upd, upd
+        elif op.endswith(("-start", "-done")) or op == "async-update":
+            # DMA halves of overlapped transfers: the payload is counted
+            # once at the consuming/producing op, and prefetches overlap
+            # compute — charging both halves serially double-counts
+            continue
+        elif op == "custom-call" and "Bitcast" in line:
+            in_bytes = 0  # ConcatBitcast and friends: layout fictions
+        else:
+            operands = _OPERAND_RE.findall(line.split("(", 1)[1])
+            in_bytes = sum(_shape_bytes(shapes.get(o, ""))
+                           for o in operands)
+        flops = 0.0
+        if op == "convolution":
+            flops = _conv_flops(line, out_type, shapes) or 0.0
+        meta = re.search(r'op_name="([^"]*)"', line)
+        src = re.search(r'source_file="([^"]*)"', line)
+        rows.append({
+            "name": name, "op": op, "computation": computation,
+            "in_fusion_body": in_fusion_body,
+            "flops": flops,
+            "bytes": 0 if in_fusion_body else in_bytes + out_bytes,
+            "op_name": meta.group(1) if meta else "",
+            "source": os.path.basename(src.group(1)) if src else "",
+        })
+    return rows
+
+
+def summarize(rows: List[dict], top: int) -> dict:
+    for r in rows:
+        r["t_est_us"] = max(r["flops"] / PEAK_FLOPS,
+                            r["bytes"] / HBM_BW) * 1e6
+    # a conv inside a fusion body: merge its flops into the call site's
+    # row is nontrivial to resolve textually; keep both rows but mark.
+    ranked = sorted(rows, key=lambda r: -r["t_est_us"])
+    total_flops = sum(r["flops"] for r in rows)
+    total_bytes = sum(r["bytes"] for r in rows)
+    roofline_us = sum(r["t_est_us"] for r in rows)
+    out_rows = []
+    for r in ranked[:top]:
+        out_rows.append({
+            "op": f"{r['op']}:{r['name']}",
+            "flops_g": round(r["flops"] / 1e9, 3),
+            "mbytes": round(r["bytes"] / 1e6, 3),
+            "t_est_us": round(r["t_est_us"], 2),
+            "bound": ("flops" if r["flops"] / PEAK_FLOPS
+                      >= r["bytes"] / HBM_BW else "bytes"),
+            "where": (r["op_name"].split("/")[-1] or r["op"])
+            + (f" [{r['source']}]" if r["source"] else ""),
+        })
+    by_kind: Dict[str, float] = {}
+    for r in rows:
+        by_kind[r["op"]] = by_kind.get(r["op"], 0.0) + r["t_est_us"]
+    return {
+        "total_conv_dot_flops": total_flops,
+        "total_toplevel_bytes": total_bytes,
+        "roofline_us_per_step": round(roofline_us, 1),
+        "flops_us": round(total_flops / PEAK_FLOPS * 1e6, 1),
+        "bytes_us": round(total_bytes / HBM_BW * 1e6, 1),
+        "top_ops": out_rows,
+        "t_est_by_opkind_us": {k: round(v, 1) for k, v in
+                               sorted(by_kind.items(),
+                                      key=lambda kv: -kv[1])[:10]},
+    }
+
+
+# -- program builders ------------------------------------------------------
+
+def _mnist_program(batch: int, fast: bool, k: int = 10):
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from gan_deeplearning4j_tpu.models import dcgan_mnist as M
+    from gan_deeplearning4j_tpu.runtime import backend
+    from gan_deeplearning4j_tpu.train import fused_step as fused
+
+    backend.configure(conv_s2d=True if fast else None,
+                      matmul_bf16=fast, compute_bf16=fast)
+    dev = jax.devices()[0]
+    with jax.default_device(dev):
+        dis, gen, gan = (M.build_discriminator(), M.build_generator(),
+                         M.build_gan())
+        clf = M.build_classifier(dis)
+        rng = np.random.RandomState(0)
+        ones = jnp.ones((batch, 1), jnp.float32)
+        key = jax.random.key(0)
+        step = fused.make_protocol_step(
+            dis, gen, gan, clf,
+            M.DIS_TO_GAN, M.GAN_TO_GEN, M.DIS_TO_CLASSIFIER,
+            z_size=2, num_features=784,
+            data_on_device=True, steps_per_call=k)
+        state = jax.device_put(
+            fused.state_from_graphs(dis, gen, gan, clf), dev)
+        table = jax.device_put(
+            rng.rand(4 * batch, 784).astype(np.float32), dev)
+        labels = jax.device_put(
+            np.eye(10, dtype=np.float32)[rng.randint(0, 10, 4 * batch)],
+            dev)
+        inv = (key, jax.random.fold_in(key, 1),
+               ones + 0.05 * jnp.asarray(rng.randn(batch, 1), jnp.float32),
+               0.05 * jnp.asarray(rng.randn(batch, 1), jnp.float32), ones)
+        args = (state, table, labels) + inv
+        return step, args, k
+
+
+def _celeba_program(batch: int = 128, k: int = 10):
+    import jax
+    import jax.numpy as jnp
+
+    from gan_deeplearning4j_tpu.data import datasets
+    from gan_deeplearning4j_tpu.models import dcgan_celeba as M
+    from gan_deeplearning4j_tpu.runtime import backend
+    from gan_deeplearning4j_tpu.train.gan_pair import GANPair
+
+    backend.configure(conv_s2d=None, matmul_bf16=False, compute_bf16=False)
+    dev = jax.devices()[0]
+    with jax.default_device(dev):
+        cfg = M.CelebAConfig()
+        pair = GANPair(M.build_generator(cfg), M.build_discriminator(cfg))
+        table = jax.device_put(
+            jnp.asarray(datasets.synthetic_celeba(4 * batch, seed=0)), dev)
+        step_fn, state = pair.make_multistep(
+            table, None, batch_size=batch, steps_per_call=k,
+            real_label=cfg.real_label, z_size=cfg.z_size)
+        state = jax.device_put(state, dev)
+        return step_fn.jitted, (state,) + step_fn.invariants, k
+
+
+PROGRAMS = {
+    "b200_f32": lambda: _mnist_program(200, fast=False),
+    "b1600_fast": lambda: _mnist_program(1600, fast=True),
+    "b3200_f32": lambda: _mnist_program(3200, fast=False),
+    "celeba_b128": lambda: _celeba_program(128),
+}
+
+
+def run_program(name: str, top: int, measure: bool,
+                dump_dir: Optional[str]) -> dict:
+    import jax
+
+    jitted, args, k = PROGRAMS[name]()
+    lowered = jitted.lower(*args)
+    compiled = lowered.compile()
+    txt = compiled.as_text()
+    if dump_dir:
+        os.makedirs(dump_dir, exist_ok=True)
+        with open(os.path.join(dump_dir, f"{name}.hlo.txt"), "w") as f:
+            f.write(txt)
+    rows = analyze_hlo(txt)
+    summary = summarize(rows, top)
+    ca = compiled.cost_analysis() or {}
+    summary["xla_cost_flops"] = float(ca.get("flops", 0.0))
+    summary["xla_cost_bytes"] = float(ca.get("bytes accessed", 0.0))
+    summary["program"] = name
+    if measure:
+        import statistics
+        import time
+
+        from gan_deeplearning4j_tpu.utils import device_fence
+
+        out = jitted(*args)
+        device_fence(out)
+
+        def window(n):
+            t0 = time.perf_counter()
+            o = None
+            for _ in range(n):
+                o = jitted(*args)
+            device_fence(o)
+            return time.perf_counter() - t0
+
+        # adaptive windows: the tunnel's ~0.1s round trip rides on every
+        # fenced window, so the long window must be seconds — size it
+        # from a first timed call, then slope over 3 repeats (median)
+        t_call = max(window(1), 1e-3)
+        hi = max(4, min(60, int(3.0 / t_call)))
+        lo = max(1, hi // 5)
+        slopes = []
+        for _ in range(3):
+            t_lo = window(lo)
+            t_hi = window(hi)
+            slopes.append((t_hi - t_lo) / ((hi - lo) * k))
+        t = statistics.median(slopes)
+        summary["measured_us_per_step"] = round(t * 1e6, 1)
+        if summary["xla_cost_flops"]:
+            summary["measured_mfu"] = round(
+                summary["xla_cost_flops"] / t / PEAK_FLOPS, 4)
+    return summary
+
+
+def main(argv=None) -> None:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--programs", default=",".join(PROGRAMS))
+    p.add_argument("--top", type=int, default=12)
+    p.add_argument("--measure", action="store_true",
+                   help="also time each program on the chip (slope "
+                        "method) for roofline-vs-measured comparison")
+    p.add_argument("--dump-dir", default=None,
+                   help="also write each program's optimized HLO text")
+    args = p.parse_args(argv)
+
+    results = []
+    for name in args.programs.split(","):
+        name = name.strip()
+        if not name:
+            continue
+        if name not in PROGRAMS:
+            raise SystemExit(f"unknown program {name!r}; "
+                             f"have {sorted(PROGRAMS)}")
+        print(f"[hlo-cost] compiling {name}...", file=sys.stderr,
+              flush=True)
+        s = run_program(name, args.top, args.measure, args.dump_dir)
+        results.append(s)
+        print(f"[hlo-cost] {name}: roofline {s['roofline_us_per_step']}us "
+              f"(flops-bound {s['flops_us']}us, bytes {s['bytes_us']}us)"
+              + (f", measured {s['measured_us_per_step']}us"
+                 if "measured_us_per_step" in s else ""),
+              file=sys.stderr, flush=True)
+        for r in s["top_ops"]:
+            print(f"[hlo-cost]   {r['t_est_us']:>9.1f}us {r['bound']:>5} "
+                  f"{r['flops_g']:>8.2f}GF {r['mbytes']:>8.2f}MB "
+                  f"{r['op'][:46]:<46} {r['where'][:60]}",
+                  file=sys.stderr, flush=True)
+    print(json.dumps({"metric": "hlo_cost_attribution",
+                      "peak_flops": PEAK_FLOPS, "hbm_bw": HBM_BW,
+                      "programs": results}, default=float))
+
+
+if __name__ == "__main__":
+    main()

@@ -22,8 +22,10 @@ import tempfile
 
 import numpy as np
 
-# runnable from anywhere: the repo root is the package home
-_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+# runnable from anywhere: the repo root is the package home (inside the
+# .ipynb rendering there is no __file__ — the kernel starts at the root)
+_REPO = (os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+         if "__file__" in globals() else os.path.abspath(os.getcwd()))
 if _REPO not in sys.path:
     sys.path.insert(0, _REPO)
 

@@ -555,6 +555,27 @@ def main(argv=None) -> None:
             out["e2e_img_per_sec"] = round(e2e_img_per_sec(tmp), 2)
             out["e2e_stream_img_per_sec"] = round(
                 e2e_img_per_sec(tmp, data_on_device=False), 2)
+        if default.platform != "cpu":
+            # host->device link bandwidth at measurement time: the
+            # streaming path's sensitivity axis.  With the r5 dedup tier
+            # the e2e_stream number no longer rides it (only the index
+            # schedule streams per chunk), but epoch >> chunk datasets
+            # still do: sustainable img/s there = link_BW / bytes_per_row
+            # (u8: 824 B for the CV workload).
+            import jax.numpy as jnp
+            import numpy as np
+
+            blob = np.zeros((8 << 20,), np.uint8)
+            total = jax.jit(lambda a: jnp.sum(a.astype(jnp.int32)))
+            t_best = float("inf")
+            for _ in range(3):
+                t0 = time.perf_counter()
+                # fence via a scalar REDUCTION of the uploaded buffer —
+                # device_fence would read the 8 MB back and time the
+                # downlink too
+                _fence(total(jax.device_put(blob, default)))
+                t_best = min(t_best, time.perf_counter() - t0)
+            out["link_mb_s"] = round(blob.nbytes / t_best / 1e6, 1)
     print(json.dumps(out))
 
 

@@ -149,19 +149,35 @@ class ChunkPrefetchIterator(PrefetchIterator):
     the assembled feature chunk before device_put (e.g. the exact uint8
     fixed-point codec, data/codec.py — 4x fewer bytes on the wire; the
     consuming program dequantizes on device).
+
+    ``dedup``: the adaptive epoch-in-chunk tier.  When one chunk spans
+    whole passes of a DETERMINISTIC source (chunk_batches >= batches per
+    pass), assembling K batches re-ships every distinct row once per
+    occurrence — pure waste on a bandwidth-bound link.  In dedup mode
+    the iterator uploads the distinct-row tables ONCE (the first pass's
+    batches, verified against every later pass by exact comparison) and
+    each chunk yields a 3-tuple ``(features_table, labels_table,
+    row_idx[int32 K*B])`` — only the index schedule crosses the link per
+    chunk; the consuming program (fused_step ``chunk_indexed``) gathers
+    batches on device.  A source that changes batch content or pass
+    structure between passes raises (the contract is the reference's
+    fixed CSV order, dl4jGANComputerVision.java:524-526).
     """
 
     def __init__(self, source, chunk_batches: int, batch_size: int,
                  prefetch_depth: int = 2, sharding=None,
-                 encode_features=None):
+                 encode_features=None, dedup: bool = False):
         if chunk_batches < 1:
             raise ValueError("chunk_batches must be >= 1")
         self.chunk_batches = chunk_batches
         self.encode_features = encode_features
+        self.dedup = dedup
         super().__init__(source, prefetch_depth=prefetch_depth,
                          sharding=sharding, loop=True, min_rows=batch_size)
 
     def _worker(self):
+        if self.dedup:
+            return self._worker_dedup()
         import numpy as np
 
         try:
@@ -201,4 +217,78 @@ class ChunkPrefetchIterator(PrefetchIterator):
                     return
             self._put_stop_aware(None)
         except BaseException as e:  # surface decode errors to the consumer
+            self._put_stop_aware(e)
+
+    def _worker_dedup(self):
+        import numpy as np
+
+        try:
+            host_feats, host_labs = [], []   # first pass = the tables
+            table = None                      # (dev_feats, dev_labels)
+            first_pass_done = False
+            pos = 0                           # batch position in pass
+            idx_parts, appended = [], 0
+            while not self._stop.is_set():
+                if not self.source.has_next():
+                    if not host_feats:
+                        break  # empty (or all-partial) dataset
+                    first_pass_done = True
+                    self.source.reset()
+                    pos = 0
+                    if not self.source.has_next():
+                        break
+                    continue
+                ds = self.source.next()
+                if self.min_rows and ds.num_examples() < self.min_rows:
+                    continue  # partial epoch tail: skip-and-wrap
+                f = np.asarray(ds.features)
+                lab = np.asarray(ds.labels)
+                if not first_pass_done and pos == len(host_feats):
+                    if table is not None:
+                        # the table already shipped but the first pass is
+                        # STILL producing new batches: chunk_batches does
+                        # not cover a pass — later indices would exceed
+                        # the table and jnp.take would silently clip
+                        raise RuntimeError(
+                            "dedup=True requires chunk_batches >= batches "
+                            "per pass (the shipped distinct-row table "
+                            f"held {len(host_feats)} batches but the "
+                            "first pass keeps going); use plain chunking "
+                            "for chunk-smaller-than-epoch streams")
+                    host_feats.append(f)
+                    host_labs.append(lab)
+                elif pos >= len(host_feats) or not (
+                        np.array_equal(f, host_feats[pos])
+                        and np.array_equal(lab, host_labs[pos])):
+                    raise RuntimeError(
+                        "dedup chunk streaming requires a deterministic "
+                        f"source: batch at pass position {pos} differs "
+                        "from (or extends) the first pass; disable dedup "
+                        "for shuffling/nondeterministic iterators")
+                idx_parts.append(np.arange(
+                    pos * f.shape[0], (pos + 1) * f.shape[0],
+                    dtype=np.int32))
+                pos += 1
+                appended += 1
+                if appended < self.chunk_batches:
+                    continue
+                if table is None:
+                    # tables cross the link ONCE, here (the chunk covers
+                    # >= one full pass, so the first pass is complete)
+                    tf = np.concatenate(host_feats)
+                    if self.encode_features is not None:
+                        tf = self.encode_features(tf)
+                    tl = np.concatenate(host_labs)
+                    if self.sharding is not None:
+                        tf = jax.device_put(tf, self.sharding)
+                        tl = jax.device_put(tl, self.sharding)
+                    table = (tf, tl)
+                chunk_idx = np.concatenate(idx_parts)
+                idx_parts, appended = [], 0
+                if self.sharding is not None:
+                    chunk_idx = jax.device_put(chunk_idx, self.sharding)
+                if not self._put_stop_aware((*table, chunk_idx)):
+                    return
+            self._put_stop_aware(None)
+        except BaseException as e:  # surface errors to the consumer
             self._put_stop_aware(e)

@@ -117,3 +117,88 @@ def conditional_fidelity(
         "n_per_class": n_per_class,
         "probe": probe,
     }
+
+
+def conditional_class_metrics(
+    gen,
+    x: np.ndarray,
+    y_onehot: np.ndarray,
+    *,
+    sample_shape,
+    z_size: int,
+    frozen=None,
+    n_per_class: int = 400,
+    real_cap: int = 1000,
+    seed: int = prng.NUMBER_OF_THE_BEAST,
+    use_ema: bool = False,
+    batch_size: int = 250,
+    real_features=None,
+) -> Dict[str, object]:
+    """Per-class FROZEN-SPACE FID and intra-class diversity — the
+    non-saturating companions to ``conditional_fidelity`` (VERDICT r4
+    #4: agreement-rate fidelity hits the probe's ceiling and stops
+    moving; distribution distances keep discriminating above it).
+
+    ``frozen``: a frozen feature extractor graph (default: the committed
+    CIFAR-32 asset, eval/fid_extractor.py).  For each class c, FID is
+    computed between the real rows labeled c and ``n_per_class``
+    conditioned samples, in the frozen 256-d feature space; intra-class
+    diversity is the generated class's mean per-feature std over the
+    real class's (ratio ~1 healthy, -> 0 under within-class collapse —
+    detectable even at fidelity == ceiling).
+
+    ``real_features``: the previous call's ``_real_features`` return —
+    the real side depends only on (x, y, frozen), so scoring several
+    parameter sets (live + EMA) should extract it once and pass it back.
+    Returns {per_class_fid, mean_class_fid, diversity_ratio,
+    mean_diversity_ratio, _real_features}.
+    """
+    from gan_deeplearning4j_tpu.eval import fid as fid_lib
+    from gan_deeplearning4j_tpu.eval import fid_extractor as fx
+
+    if frozen is None:
+        frozen = fx.load_extractor_cifar()
+    c, h, w = sample_shape
+    k = y_onehot.shape[1]
+    y = np.argmax(np.asarray(y_onehot), axis=1)
+    x = np.asarray(x, np.float32)
+
+    params = None
+    if use_ema:
+        params = getattr(gen, "ema_params", None)
+        if params is None:
+            raise ValueError("use_ema=True but the generator carries no "
+                             "ema_params")
+    z_key = prng.stream(prng.root_key(seed), "class-metrics-z")
+    labels = np.repeat(np.arange(k), n_per_class)
+    cond = jnp.asarray(np.eye(k, dtype=np.float32)[labels])
+    z = jax.random.uniform(z_key, (labels.size, z_size),
+                           minval=-1.0, maxval=1.0)
+    gen_rows = np.empty((labels.size, c * h * w), np.float32)
+    for i in range(0, labels.size, batch_size):
+        j = min(i + batch_size, labels.size)
+        out = gen.output(z[i:j], cond[i:j], params=params)[0]
+        gen_rows[i:j] = np.asarray(out).reshape(j - i, -1)
+
+    f_gen = fid_lib.extract_features(frozen, gen_rows, fx.FEATURE_LAYER,
+                                     batch_size=batch_size)
+    if real_features is None:
+        real_features = [
+            fid_lib.extract_features(frozen, x[y == cls][:real_cap],
+                                     fx.FEATURE_LAYER,
+                                     batch_size=batch_size)
+            for cls in range(k)]
+    per_fid, div_ratio = [], []
+    for cls in range(k):
+        f_real = real_features[cls]
+        f_g = f_gen[labels == cls]
+        per_fid.append(float(fid_lib.fid_from_features(f_real, f_g)))
+        div_ratio.append(float(f_g.std(axis=0).mean()
+                               / max(f_real.std(axis=0).mean(), 1e-9)))
+    return {
+        "per_class_fid": per_fid,
+        "mean_class_fid": float(np.mean(per_fid)),
+        "diversity_ratio": div_ratio,
+        "mean_diversity_ratio": float(np.mean(div_ratio)),
+        "_real_features": real_features,
+    }

@@ -201,6 +201,93 @@ def frozen_fid_celeba(real: np.ndarray, generated: np.ndarray,
                                layer=FEATURE_LAYER, batch_size=batch_size)
 
 
+# --------------------------------------------------------------------------
+# CIFAR-32 frozen extractor (VERDICT r4 next-step #4): the frozen feature
+# space for the cGAN family's per-class FID and intra-class diversity
+# metrics (eval/conditional.py).  Trained ONCE on the CALIBRATED surrogate
+# tier (probe Bayes ceiling ~0.96 — label-preserving ambiguous tail, see
+# data/datasets.synthetic_cifar10) under a pinned recipe.
+
+CIFAR_RECIPE_VERSION = 1
+CIFAR_ASSET_PATH = os.path.join(
+    _ASSET_DIR, f"fid_extractor_cifar_v{CIFAR_RECIPE_VERSION}.zip")
+
+_CIFAR_SEED = 666
+_CIFAR_N_TRAIN = 8000
+_CIFAR_BATCH = 100
+_CIFAR_STEPS = 600
+_CIFAR_LR = 1e-3
+
+
+def build_extractor_cifar():
+    """Fixed 32x32x3 architecture: 3 stride-2 convs (3->16->32->64) ->
+    256-d dense ("feat") -> 10-way softmax."""
+    from gan_deeplearning4j_tpu.graph import (
+        Conv2D,
+        Dense,
+        GraphBuilder,
+        InputSpec,
+        Output,
+    )
+    from gan_deeplearning4j_tpu.optim.rmsprop import RmsProp
+
+    lr = RmsProp(_CIFAR_LR, 1e-8, 1e-8)
+    b = GraphBuilder(seed=_CIFAR_SEED, l2=1e-4, activation="relu",
+                     weight_init="xavier", clip_threshold=1.0)
+    b.add_inputs("in")
+    b.set_input_types(InputSpec.convolutional_flat(32, 32, 3))
+    chans = [3, 16, 32, 64]
+    prev = "in"
+    for i in range(3):
+        name = f"conv{i + 1}"
+        b.add_layer(name, Conv2D(kernel=(4, 4), stride=(2, 2),
+                                 padding=(1, 1), n_in=chans[i],
+                                 n_out=chans[i + 1], updater=lr), prev)
+        prev = name
+    b.add_layer(FEATURE_LAYER, Dense(n_out=256, updater=lr), prev)
+    b.add_layer("out", Output(n_out=10, loss="mcxent",
+                              activation="softmax", updater=lr),
+                FEATURE_LAYER)
+    b.set_outputs("out")
+    return b.build().init()
+
+
+def train_extractor_cifar(log=print):
+    import jax.numpy as jnp
+
+    from gan_deeplearning4j_tpu.data import datasets
+
+    x, y = datasets.synthetic_cifar10(_CIFAR_N_TRAIN, seed=_CIFAR_SEED,
+                                      difficulty="calibrated")
+    onehot = np.eye(10, dtype=np.float32)[y]
+    graph = build_extractor_cifar()
+    order = np.random.RandomState(_CIFAR_SEED)
+    for step in range(_CIFAR_STEPS):
+        idx = order.randint(0, _CIFAR_N_TRAIN, _CIFAR_BATCH)
+        loss = graph.fit(jnp.asarray(x[idx]), jnp.asarray(onehot[idx]))
+        if log and (step + 1) % 100 == 0:
+            log(f"[fid-extractor-cifar] step {step + 1}/{_CIFAR_STEPS} "
+                f"loss {float(loss):.4f}")
+    return graph
+
+
+_cached_cifar = None
+
+
+def load_extractor_cifar():
+    """The committed frozen 32x32 extractor (cached per process)."""
+    global _cached_cifar
+    if _cached_cifar is None:
+        if not os.path.exists(CIFAR_ASSET_PATH):
+            raise FileNotFoundError(
+                f"{CIFAR_ASSET_PATH} missing — regenerate with: python -m "
+                "gan_deeplearning4j_tpu.eval.fid_extractor --family cifar")
+        from gan_deeplearning4j_tpu.graph import serialization
+
+        _cached_cifar = serialization.read_model(CIFAR_ASSET_PATH)
+    return _cached_cifar
+
+
 _cached = None
 
 
@@ -237,12 +324,26 @@ def main(argv=None) -> None:
     from gan_deeplearning4j_tpu.eval import metrics  # noqa: F401 (package init)
 
     p = argparse.ArgumentParser(description=__doc__)
-    p.add_argument("--family", choices=("mnist", "celeba"), default="mnist")
+    p.add_argument("--family", choices=("mnist", "celeba", "cifar"),
+                   default="mnist")
     args = p.parse_args(argv)
 
     import jax.numpy as jnp
 
     from gan_deeplearning4j_tpu.data import datasets
+
+    if args.family == "cifar":
+        graph = train_extractor_cifar()
+        xt, yt = datasets.synthetic_cifar10(2000, seed=_CIFAR_SEED + 1,
+                                            difficulty="calibrated")
+        pred = np.asarray(graph.output(jnp.asarray(xt))[0]).argmax(axis=1)
+        acc = float((pred == yt).mean())
+        print(f"[fid-extractor-cifar] held-out accuracy {acc:.4f} "
+              "(calibrated tier: Bayes ceiling ~0.96)")
+        path = save_asset(graph, CIFAR_ASSET_PATH)
+        print(f"[fid-extractor-cifar] wrote {path} "
+              f"(recipe v{CIFAR_RECIPE_VERSION}, acc {acc:.4f})")
+        return
 
     if args.family == "celeba":
         graph = train_extractor_celeba()

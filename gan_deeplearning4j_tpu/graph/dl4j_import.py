@@ -13,8 +13,14 @@ The ONLY artifact the reference ever persists is a DL4J
     records (shape-info, then data), each ``writeUTF(allocationMode)``,
     ``writeLong(length)``, ``writeUTF(dataType)``, big-endian elements
     (the 1.0.0-beta3 layout of the reference's classpath),
-  - optionally ``updaterState.bin`` (ignored here — like the Keras
-    importer, training config is not imported; pass ``updater=``).
+  - optionally ``updaterState.bin`` — the updater's state view as one
+    flat ``Nd4j.write`` vector (the reference saves with
+    ``saveUpdater=true``): per-parameter RmsProp accumulators in
+    coefficient order, EXCEPT batch-norm mean/var (NoOp updater, zero
+    state elements).  Imported into ``opt_state`` when an RmsProp
+    ``updater=`` is supplied (``load_updater=False`` opts out), written
+    back by ``export_dl4j(..., save_updater=True)`` — a migrating DL4J
+    user continues training with optimizer state intact.
 
 ``import_dl4j`` reads such a zip into a native ``ComputationGraph`` for
 the layer types the reference uses (Dense, Output, Convolution
@@ -227,6 +233,18 @@ def _param_order(layer) -> List[Tuple[str, str]]:
     return []
 
 
+def _updater_state_order(layer) -> List[Tuple[str, str]]:
+    """Per-layer (param, flatten order) segments of ``updaterState.bin``.
+    Same parameter order as the coefficients vector, EXCEPT batch norm's
+    running mean/var: DL4J assigns those a NoOp updater
+    (``BatchNormalization.getUpdaterByParam`` — they are advanced by the
+    forward pass's running average, not by gradients), and NoOp
+    contributes zero state elements to the view."""
+    if isinstance(layer, BatchNorm):
+        return [("gamma", "C"), ("beta", "C")]
+    return _param_order(layer)
+
+
 def _parse_layer(simple: str, cfg: dict):
     """DL4J layer JSON -> (native layer, needs_n_in_fixup)."""
     if simple in ("DenseLayer", "OutputLayer"):
@@ -351,12 +369,17 @@ def _topo_order(inputs: List[str], vertex_inputs: Dict[str, List[str]]
     return order
 
 
-def import_dl4j(path: str, *, updater=None, seed: int = 666
-                ) -> ComputationGraph:
+def import_dl4j(path: str, *, updater=None, seed: int = 666,
+                load_updater: bool = True) -> ComputationGraph:
     """Read a DL4J ModelSerializer zip into a native ComputationGraph
     with identical inference behavior.  ``updater``: optimizer for
-    subsequent ``fit`` calls (updater state in the zip is not imported —
-    the Keras importer's ``enforceTrainingConfig=False`` convention)."""
+    subsequent ``fit`` calls.  ``load_updater``: when the zip carries
+    ``updaterState.bin`` (the reference saves with ``saveUpdater=true``,
+    dl4jGANComputerVision.java:529-533) and ``updater`` is RmsProp, the
+    saved accumulators are restored into ``opt_state`` so training
+    CONTINUES from the artifact rather than restarting the optimizer —
+    the ``ModelSerializer.restoreComputationGraph(file, loadUpdater)``
+    semantic."""
     with zipfile.ZipFile(path) as zf:
         names = set(zf.namelist())
         if "configuration.json" not in names:
@@ -367,6 +390,18 @@ def import_dl4j(path: str, *, updater=None, seed: int = 666
         if "coefficients.bin" in names:
             flat = read_nd4j(io.BytesIO(zf.read("coefficients.bin")))
             flat = np.asarray(flat, np.float32).ravel()
+        state_flat = None
+        if load_updater and updater is not None \
+                and "updaterState.bin" in names:
+            from gan_deeplearning4j_tpu.optim.rmsprop import RmsProp
+
+            if not isinstance(updater, RmsProp):
+                raise NotImplementedError(
+                    "updaterState.bin import is implemented for RmsProp "
+                    "(the only updater the reference persists); pass "
+                    "load_updater=False to import weights only")
+            state_flat = read_nd4j(io.BytesIO(zf.read("updaterState.bin")))
+            state_flat = np.asarray(state_flat, np.float32).ravel()
 
     net_inputs = _get(conf, "networkInputs", required=True)
     net_outputs = _get(conf, "networkOutputs", required=True)
@@ -421,6 +456,27 @@ def import_dl4j(path: str, *, updater=None, seed: int = 666
             raise ValueError(
                 f"coefficients.bin has {flat.size} values; configuration "
                 f"accounts for {off}")
+
+    if state_flat is not None:
+        import jax.numpy as jnp
+
+        off = 0
+        for name, layer in parsed:
+            for pname, forder in _updater_state_order(layer):
+                shape = tuple(graph.params[name][pname].shape)
+                n = int(np.prod(shape, dtype=np.int64))
+                if off + n > state_flat.size:
+                    raise ValueError(
+                        f"updaterState.bin too short at {name}.{pname}: "
+                        f"need {off + n}, have {state_flat.size}")
+                seg = state_flat[off:off + n].reshape(shape, order=forder)
+                graph.opt_state[name][pname] = jnp.asarray(
+                    np.ascontiguousarray(seg))
+                off += n
+        if off != state_flat.size:
+            raise ValueError(
+                f"updaterState.bin has {state_flat.size} values; "
+                f"configuration accounts for {off}")
     return graph
 
 
@@ -504,16 +560,46 @@ def _input_type_to_json(spec: InputSpec) -> dict:
             "channels": int(c), "height": int(h), "width": int(w)}
 
 
-def export_dl4j(graph: ComputationGraph, path: str) -> None:
+def export_dl4j(graph: ComputationGraph, path: str,
+                save_updater: bool = True) -> None:
     """Write the graph as a DL4J ModelSerializer zip (beta3 layout) —
     the reverse migration path, and the fixture generator for the
-    import parity tests."""
+    import parity tests.  ``save_updater``: also write
+    ``updaterState.bin`` (RmsProp accumulators in DL4J's state-view
+    layout) when the graph carries RmsProp-style optimizer state — the
+    ``ModelSerializer.writeModel(model, path, true)`` semantic the
+    reference uses (dl4jGANComputerVision.java:529-533).  Graphs with
+    non-RmsProp state (Adam/Scheduled — DL4J's Adam view layout is
+    per-updater-block, not implemented) degrade to a weights-only zip
+    with a logged warning."""
     vertices, vertex_inputs = {}, {}
     segments: List[np.ndarray] = []
+    state_segments: Optional[List[np.ndarray]] = []
     for name, node in graph.nodes.items():
         layer = node.layer
         params = {p: np.asarray(v, np.float32)
                   for p, v in graph.params.get(name, {}).items()}
+        if save_updater and getattr(graph, "opt_state", None) \
+                and state_segments is not None:
+            st = graph.opt_state.get(name, {})
+            for pname, forder in _updater_state_order(layer):
+                leaf = st.get(pname)
+                if leaf is None:
+                    continue
+                if isinstance(leaf, dict):
+                    # Adam/Scheduled state has no DL4J RmsProp view
+                    # equivalent: degrade to the weights-only zip (the
+                    # pre-r5 behavior) rather than failing the export
+                    import logging
+
+                    logging.getLogger(__name__).warning(
+                        "%s.%s carries non-RmsProp updater state; "
+                        "updaterState.bin not written (weights-only "
+                        "zip)", name, pname)
+                    state_segments = None
+                    break
+                state_segments.append(
+                    np.asarray(leaf, np.float32).ravel(order=forder))
         vertex = {"@class": f"{_NS}.graph.LayerVertex",
                   "layerConf": {
                       "@class": f"{_NS}.NeuralNetConfiguration",
@@ -542,7 +628,12 @@ def export_dl4j(graph: ComputationGraph, path: str) -> None:
     if segments:
         flat = np.concatenate(segments).reshape(1, -1)
         write_nd4j(coeffs, flat)
+    state = io.BytesIO()
+    if state_segments:
+        write_nd4j(state, np.concatenate(state_segments).reshape(1, -1))
     with zipfile.ZipFile(path, "w", zipfile.ZIP_DEFLATED) as zf:
         zf.writestr("configuration.json", json.dumps(conf, indent=2))
         if segments:
             zf.writestr("coefficients.bin", coeffs.getvalue())
+        if state_segments:
+            zf.writestr("updaterState.bin", state.getvalue())

@@ -27,6 +27,7 @@ from gan_deeplearning4j_tpu.graph import (
     FeedForwardToCnn,
     GraphBuilder,
     InputSpec,
+    MinibatchStdDev,
     Output,
 )
 from gan_deeplearning4j_tpu.optim.adam import Adam
@@ -48,10 +49,34 @@ class CelebAConfig:
     real_label: float = 0.9
     clip: float = 1.0
     bf16: Optional[bool] = None  # None = follow runtime policy
+    # hold-then-sigmoid-decay horizon for BOTH networks (the cgan_cifar10
+    # recipe: ~rate to 0.4·H, rate/2 at 0.7·H, ~0 at H).  The r5 10k
+    # acceptance measured the need: constant-LR live FID bottoms at ~109
+    # (3k) then DEGRADES to 186 by 10k as D overpowers G (d 0.16, g 10.7)
+    # — freezing the game over the horizon pins the endpoint near the
+    # optimum instead of past it.
+    decay_steps: int = None
+    # batch-diversity feature before D's output head (same rationale as
+    # cgan_cifar10.minibatch_stddev: a collapsing G is directly visible)
+    minibatch_stddev: bool = True
+
+
+def _lr(rate: float, cfg: CelebAConfig):
+    adam = Adam(rate, 0.5, 0.999)
+    if cfg.decay_steps:
+        from gan_deeplearning4j_tpu.optim.schedules import (
+            Scheduled,
+            SigmoidSchedule,
+        )
+
+        return Scheduled(adam, SigmoidSchedule(
+            rate, gamma=-1.0 / (0.06 * cfg.decay_steps),
+            step=0.7 * cfg.decay_steps))
+    return adam
 
 
 def build_generator(cfg: CelebAConfig = CelebAConfig()):
-    lr = Adam(cfg.learning_rate, 0.5, 0.999)
+    lr = _lr(cfg.learning_rate, cfg)
     f = cfg.base_filters
     b = GraphBuilder(seed=cfg.seed, activation="relu", weight_init="xavier",
                      clip_threshold=cfg.clip)
@@ -85,7 +110,7 @@ def build_generator(cfg: CelebAConfig = CelebAConfig()):
 
 
 def build_discriminator(cfg: CelebAConfig = CelebAConfig()):
-    lr = Adam(cfg.d_learning_rate, 0.5, 0.999)
+    lr = _lr(cfg.d_learning_rate, cfg)
     f = cfg.base_filters
     b = GraphBuilder(seed=cfg.seed, activation="leakyrelu",
                      weight_init="xavier", clip_threshold=cfg.clip)
@@ -106,8 +131,13 @@ def build_discriminator(cfg: CelebAConfig = CelebAConfig()):
             bn = f"dis_bn{i + 1}"
             b.add_layer(bn, BatchNorm(updater=lr), name)
             prev = bn
+    n_in = 8 * f * 4 * 4
+    if cfg.minibatch_stddev:
+        b.add_layer("dis_mbstd", MinibatchStdDev(), prev)
+        prev = "dis_mbstd"
+        n_in = (8 * f + 1) * 4 * 4
     b.add_layer("dis_out",
-                Output(n_out=1, n_in=8 * f * 4 * 4, loss="xent",
+                Output(n_out=1, n_in=n_in, loss="xent",
                        activation="sigmoid", updater=lr,
                        bf16_matmul=cfg.bf16),
                 prev)

@@ -87,6 +87,7 @@ def make_protocol_step(
     ema_decay: float = 0.0,
     data_codec: Optional[str] = None,
     codec_chunk_decode: bool = False,
+    chunk_indexed: bool = False,
 ):
     """Build the fused step:
     (state, real, labels, z_key, rng_key, y_real, y_fake, ones) ->
@@ -136,6 +137,15 @@ def make_protocol_step(
     streaming-chunk mode, where the f32 working copy is chunk-sized and
     the decode cost amortizes over steps_per_call; per-step decode (the
     default) keeps a u8-RESIDENT table at 1/4 HBM for its whole life.
+
+    ``chunk_indexed``: the step takes an extra ``row_idx`` argument
+    (after ``labels``) and ``real``/``labels`` are DISTINCT-row tables,
+    not pre-assembled batches — step ``it`` gathers rows
+    ``row_idx[(it % n_batches) * B : ...]``.  The adaptive streaming
+    tier's program shape (data/prefetch.py dedup mode): when a chunk
+    spans whole epochs of a deterministic iterator, each distinct row
+    crosses the link once per chunk instead of once per occurrence —
+    the epoch-in-chunk regime's bandwidth lever.
     """
     axis_name = axis if mesh is not None else None
     n_shards = mesh.shape[axis] if mesh is not None else 1
@@ -146,6 +156,10 @@ def make_protocol_step(
     if codec_chunk_decode and steps_per_call <= 1:
         raise ValueError("codec_chunk_decode requires steps_per_call > 1 "
                          "(it amortizes the decode over a scan)")
+    if chunk_indexed and (not data_on_device or steps_per_call <= 1):
+        raise ValueError("chunk_indexed is the streaming-chunk gather "
+                         "mode: it requires data_on_device=True and "
+                         "steps_per_call > 1")
     if data_codec == "u8x100":
         from gan_deeplearning4j_tpu.data.codec import U8X100_TABLE
 
@@ -164,18 +178,26 @@ def make_protocol_step(
                 lax.pmean(grads, axis_name))
 
     def step(state: ProtocolState, real, labels, z_key, rng_key,
-             y_real, y_fake, ones):
+             y_real, y_fake, ones, row_idx=None):
         global_batch = ones.shape[0]  # ones is replicated, so global
         step_idx = state.it
         if data_on_device:
             # slice this step's (local) batch out of the resident dataset
-            n_batches = real.shape[0] // global_batch
+            # (chunk_indexed: gather it through the row-index schedule —
+            # the tables hold each distinct row once)
+            src = row_idx if chunk_indexed else real
+            n_batches = src.shape[0] // global_batch
             local_b = global_batch // n_shards
             off = (step_idx % n_batches) * global_batch
             if axis_name is not None:
                 off = off + lax.axis_index(axis_name) * local_b
-            real = lax.dynamic_slice_in_dim(real, off, local_b)
-            labels = lax.dynamic_slice_in_dim(labels, off, local_b)
+            if chunk_indexed:
+                ids = lax.dynamic_slice_in_dim(row_idx, off, local_b)
+                real = jnp.take(real, ids, axis=0)
+                labels = jnp.take(labels, ids, axis=0)
+            else:
+                real = lax.dynamic_slice_in_dim(real, off, local_b)
+                labels = lax.dynamic_slice_in_dim(labels, off, local_b)
         if step_codec == "u8x100":
             # slice first (above), then dequantize just this batch
             real = dequant(real)
@@ -247,21 +269,40 @@ def make_protocol_step(
         donate = False
         inner = step
 
-        def step(state, real, labels, z_key, rng_key, y_real, y_fake, ones):
-            if codec_chunk_decode:
-                # one exact decode of the whole chunk, amortized over the
-                # K scanned steps (the per-step decode would re-pay the
-                # one-hot matmul every iteration)
-                real = dequant(real)
+        if chunk_indexed:
+            def step(state, real, labels, row_idx, z_key, rng_key,
+                     y_real, y_fake, ones):
+                if codec_chunk_decode:
+                    # one exact decode of the distinct-row table —
+                    # amortized over the scan AND over row repetitions
+                    real = dequant(real)
 
-            def body(s, _):
-                s, losses = inner(s, real, labels, z_key, rng_key,
-                                  y_real, y_fake, ones)
-                return s, losses
+                def body(s, _):
+                    s, losses = inner(s, real, labels, z_key, rng_key,
+                                      y_real, y_fake, ones,
+                                      row_idx=row_idx)
+                    return s, losses
 
-            state, losses = lax.scan(
-                body, state, None, length=steps_per_call)
-            return state, losses  # each loss stacked [steps_per_call]
+                state, losses = lax.scan(
+                    body, state, None, length=steps_per_call)
+                return state, losses
+        else:
+            def step(state, real, labels, z_key, rng_key, y_real, y_fake,
+                     ones):
+                if codec_chunk_decode:
+                    # one exact decode of the whole chunk, amortized over
+                    # the K scanned steps (the per-step decode would
+                    # re-pay the one-hot matmul every iteration)
+                    real = dequant(real)
+
+                def body(s, _):
+                    s, losses = inner(s, real, labels, z_key, rng_key,
+                                      y_real, y_fake, ones)
+                    return s, losses
+
+                state, losses = lax.scan(
+                    body, state, None, length=steps_per_call)
+                return state, losses  # each loss stacked [steps_per_call]
 
     if mesh is None:
         return jax.jit(step, donate_argnums=(0,) if donate else ())
@@ -269,12 +310,15 @@ def make_protocol_step(
     # with a device-resident dataset every replica holds the full table and
     # slices its own shard; streaming batches arrive pre-sharded
     data_spec = P() if data_on_device else P(axis)
+    n_data = 3 if chunk_indexed else 2  # tables (+ row schedule)
     sharded = shard_map(
         step,
         mesh=mesh,
         # state (incl. device step counter), keys and global target
-        # vectors replicated; real, labels batch-sharded (or resident)
-        in_specs=(P(), data_spec, data_spec, P(), P(), P(), P(), P()),
+        # vectors replicated; real, labels batch-sharded (or resident);
+        # the chunk_indexed row schedule replicated (each replica
+        # gathers its own shard's ids)
+        in_specs=(P(),) + (data_spec,) * n_data + (P(),) * 5,
         out_specs=(P(), P()),
         check_vma=False,
     )

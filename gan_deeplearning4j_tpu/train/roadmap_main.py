@@ -49,9 +49,9 @@ def _build(family: str, mesh, num_classes: int = None,
     if lr_decay_steps is not None and lr_decay_steps <= 0:
         raise ValueError(f"--lr-decay-steps must be positive, "
                          f"got {lr_decay_steps}")
-    if lr_decay_steps and family != "cgan-cifar10":
+    if lr_decay_steps and family not in ("cgan-cifar10", "celeba"):
         raise ValueError("--lr-decay-steps is currently wired for "
-                         "cgan-cifar10 only")
+                         "cgan-cifar10 and celeba only")
     if family == "cgan-cifar10":
         import dataclasses
 
@@ -75,9 +75,13 @@ def _build(family: str, mesh, num_classes: int = None,
                        mode="wgan-gp", gp_weight=cfg.gp_weight, mesh=mesh)
         return pair, cfg, (cfg.channels, cfg.height, cfg.width)
     if family == "celeba":
+        import dataclasses
+
         from gan_deeplearning4j_tpu.models import dcgan_celeba as M
 
         cfg = M.CelebAConfig()
+        if lr_decay_steps:
+            cfg = dataclasses.replace(cfg, decay_steps=lr_decay_steps)
         pair = GANPair(M.build_generator(cfg), M.build_discriminator(cfg),
                        mesh=mesh)
         return pair, cfg, (cfg.channels, cfg.height, cfg.width)
@@ -108,7 +112,11 @@ def _data(family: str, n: int, seed: int, sample_shape=None,
             return x, np.eye(len(classes), dtype=np.float32)[labels]
         return x, None
     if family == "cgan-cifar10":
-        x, y = datasets.synthetic_cifar10(n, seed=seed)
+        # calibrated tier (r5): label-preserving ambiguous tail puts the
+        # probe's Bayes ceiling at ~0.96, so conditional_fidelity cannot
+        # saturate at 1.000 (VERDICT r4 #4)
+        x, y = datasets.synthetic_cifar10(n, seed=seed,
+                                          difficulty="calibrated")
         return x, np.eye(10, dtype=np.float32)[y]
     if family == "wgan-gp":
         x, _ = datasets.synthetic_mnist(n, seed=seed)
@@ -337,6 +345,32 @@ def train(family: str, iterations: int, batch_size: int, res_path: str,
                 z_size=cfg.z_size, probe_steps=fidelity_steps,
                 use_ema=True, probe=fid["probe"])
             result["conditional_fidelity_ema"] = fid_ema["fidelity"]
+        if family == "cgan-cifar10":
+            # the non-saturating companions (frozen 32x32 space): per-
+            # class FID + intra-class diversity keep discriminating when
+            # agreement hits the probe ceiling
+            from gan_deeplearning4j_tpu.eval.conditional import (
+                conditional_class_metrics,
+            )
+
+            cm = conditional_class_metrics(
+                pair.gen, x, y, sample_shape=sample_shape,
+                z_size=cfg.z_size)
+            result["per_class_fid"] = cm["per_class_fid"]
+            result["mean_class_fid"] = cm["mean_class_fid"]
+            result["diversity_ratio"] = cm["mean_diversity_ratio"]
+            log(f"[{family}] per-class frozen FID mean "
+                f"{cm['mean_class_fid']:.2f} "
+                + " ".join(f"{v:.1f}" for v in cm["per_class_fid"])
+                + f"; diversity ratio {cm['mean_diversity_ratio']:.3f}")
+            if getattr(pair.gen, "ema_params", None) is not None:
+                cme = conditional_class_metrics(
+                    pair.gen, x, y, sample_shape=sample_shape,
+                    z_size=cfg.z_size, use_ema=True,
+                    real_features=cm["_real_features"])
+                result["mean_class_fid_ema"] = cme["mean_class_fid"]
+                result["diversity_ratio_ema"] = \
+                    cme["mean_diversity_ratio"]
     return result
 
 

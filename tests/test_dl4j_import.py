@@ -239,6 +239,129 @@ def test_insurance_roundtrip(tmp_path):
         np.asarray(dis.output(x)[0]), np.asarray(g2.output(x)[0]))
 
 
+def test_handwritten_updater_state_fixture(tmp_path):
+    """Format fixture for updaterState.bin: per-param RmsProp caches in
+    coefficient order with batch-norm mean/var EXCLUDED (DL4J gives the
+    running stats a NoOp updater with zero state elements) and dense W
+    in f-order, matching the gradient-view layout."""
+    from gan_deeplearning4j_tpu.optim.rmsprop import RmsProp
+
+    path = str(tmp_path / "fixture.zip")
+    _fixture_zip(path)  # writes config + coefficients (+ junk state)
+    # overwrite updaterState.bin with a well-formed state vector:
+    # d1.W (4x3 f-order), d1.b (3), bn gamma (3), beta (3), out.W
+    # (3x2 f-order), out.b (2) = 26 elements — NO mean/var segments
+    st_d1w = np.arange(12, dtype=np.float32).reshape(4, 3) * 0.01
+    st_d1b = np.float32([1, 2, 3])
+    st_gamma = np.float32([4, 5, 6])
+    st_beta = np.float32([7, 8, 9])
+    st_outw = np.float32([[10, 11], [12, 13], [14, 15]])
+    st_outb = np.float32([16, 17])
+    flat = np.concatenate([
+        st_d1w.ravel(order="F"), st_d1b, st_gamma, st_beta,
+        st_outw.ravel(order="F"), st_outb]).reshape(1, -1)
+    buf = io.BytesIO()
+    write_nd4j(buf, flat)
+    import os
+    import shutil
+
+    tmp2 = str(tmp_path / "fixture2.zip")
+    with zipfile.ZipFile(path) as zin, zipfile.ZipFile(tmp2, "w") as zout:
+        for n in zin.namelist():
+            if n != "updaterState.bin":
+                zout.writestr(n, zin.read(n))
+        zout.writestr("updaterState.bin", buf.getvalue())
+    shutil.move(tmp2, path)
+    assert os.path.exists(path)
+
+    g = import_dl4j(path, updater=RmsProp(0.01, 0.95, 1e-8))
+    np.testing.assert_array_equal(
+        np.asarray(g.opt_state["d1"]["W"]), st_d1w)
+    np.testing.assert_array_equal(
+        np.asarray(g.opt_state["d1"]["b"]), st_d1b)
+    np.testing.assert_array_equal(
+        np.asarray(g.opt_state["bn"]["gamma"]), st_gamma)
+    np.testing.assert_array_equal(
+        np.asarray(g.opt_state["bn"]["beta"]), st_beta)
+    # mean/var carry NO saved state: still the zero init
+    assert not np.asarray(g.opt_state["bn"]["mean"]).any()
+    np.testing.assert_array_equal(
+        np.asarray(g.opt_state["out"]["W"]), st_outw)
+    np.testing.assert_array_equal(
+        np.asarray(g.opt_state["out"]["b"]), st_outb)
+    # opting out leaves a fresh optimizer
+    g2 = import_dl4j(path, updater=RmsProp(0.01, 0.95, 1e-8),
+                     load_updater=False)
+    assert not np.asarray(g2.opt_state["d1"]["W"]).any()
+
+
+def _training_net(updater):
+    from gan_deeplearning4j_tpu.graph import (
+        BatchNorm,
+        Dense,
+        GraphBuilder,
+        InputSpec,
+        Output,
+    )
+
+    b = GraphBuilder(seed=666, activation="tanh", weight_init="xavier",
+                     clip_threshold=1.0)
+    b.add_inputs("in")
+    b.set_input_types(InputSpec.feed_forward(6))
+    b.add_layer("d1", Dense(n_out=16, updater=updater), "in")
+    b.add_layer("bn", BatchNorm(updater=updater), "d1")
+    b.add_layer("out", Output(n_out=1, n_in=16, loss="xent",
+                              activation="sigmoid", updater=updater), "bn")
+    b.set_outputs("out")
+    return b.build().init()
+
+
+def test_continue_training_with_updater_state(tmp_path):
+    """The saveUpdater=true migration story: train N steps, export,
+    import, continue K steps — identical to an uninterrupted N+K run.
+    A history-bearing rms_decay (0.95, unlike the reference's 1e-8)
+    makes the accumulators genuinely matter: the same continuation
+    WITHOUT the state diverges."""
+    import jax.numpy as jnp
+
+    from gan_deeplearning4j_tpu.optim.rmsprop import RmsProp
+
+    upd = RmsProp(0.05, 0.95, 1e-8)
+    rng = np.random.RandomState(0)
+    x = rng.rand(40, 6).astype(np.float32)
+    y = (x.sum(axis=1, keepdims=True) > 3).astype(np.float32)
+    xb, yb = jnp.asarray(x), jnp.asarray(y)
+
+    N, K = 12, 6
+    straight = _training_net(upd)
+    for _ in range(N + K):
+        loss_straight = straight.fit(xb, yb)
+
+    target = _training_net(upd)
+    for _ in range(N):
+        target.fit(xb, yb)
+    path = str(tmp_path / "mid.zip")
+    export_dl4j(target, path, save_updater=True)
+    with zipfile.ZipFile(path) as zf:
+        assert "updaterState.bin" in zf.namelist()
+
+    resumed = import_dl4j(path, updater=upd)
+    for _ in range(K):
+        loss_resumed = resumed.fit(xb, yb)
+    np.testing.assert_allclose(float(loss_resumed), float(loss_straight),
+                               rtol=1e-6)
+    np.testing.assert_allclose(
+        np.asarray(resumed.get_param("d1", "W")),
+        np.asarray(straight.get_param("d1", "W")), rtol=1e-5, atol=1e-7)
+
+    cold = import_dl4j(path, updater=upd, load_updater=False)
+    for _ in range(K):
+        loss_cold = cold.fit(xb, yb)
+    assert abs(float(loss_cold) - float(loss_straight)) > 1e-5, (
+        "fresh-optimizer continuation should diverge; the state carries "
+        "no information otherwise")
+
+
 def test_unsupported_configs_raise(tmp_path):
     ns = "org.deeplearning4j.nn.conf"
 

@@ -64,6 +64,80 @@ def test_frozen_celeba_extractor_discriminates():
     assert fx.frozen_fid_celeba(x1, x2) == close  # deterministic reload
 
 
+def test_frozen_cifar_extractor_and_calibrated_ceiling():
+    """The committed 32x32 asset loads, its held-out accuracy on the
+    CALIBRATED tier sits in the de-saturated band (strictly below 1.0,
+    comfortably above chance-plus: the ambiguous 18% tail binds), and
+    its feature space discriminates."""
+    from gan_deeplearning4j_tpu.eval import fid as fid_lib
+
+    frozen = fx.load_extractor_cifar()
+    import jax.numpy as jnp
+
+    xt, yt = datasets.synthetic_cifar10(1500, seed=31,
+                                        difficulty="calibrated")
+    pred = np.asarray(frozen.output(jnp.asarray(xt))[0]).argmax(axis=1)
+    acc = float((pred == yt).mean())
+    assert 0.90 <= acc <= 0.995, f"held-out acc {acc:.4f} out of band"
+    x2, _ = datasets.synthetic_cifar10(600, seed=32,
+                                       difficulty="calibrated")
+    junk = np.random.RandomState(1).uniform(
+        -1, 1, (600, xt.shape[1])).astype(np.float32)
+    f1 = fid_lib.extract_features(frozen, xt[:600], fx.FEATURE_LAYER)
+    f2 = fid_lib.extract_features(frozen, x2, fx.FEATURE_LAYER)
+    fj = fid_lib.extract_features(frozen, junk, fx.FEATURE_LAYER)
+    close = fid_lib.fid_from_features(f1, f2)
+    far = fid_lib.fid_from_features(f1, fj)
+    assert far > 10 * close, (close, far)
+
+
+def test_conditional_class_metrics_detect_collapse():
+    """Falsifiability by construction (VERDICT r4 #4): a 'generator'
+    that echoes real rows of the requested class scores small per-class
+    FID and diversity ~1; one that collapses each class to a single
+    image scores large FID and diversity ~0 — even though BOTH obey
+    their labels perfectly (agreement-rate fidelity can't tell them
+    apart)."""
+    from gan_deeplearning4j_tpu.eval.conditional import (
+        conditional_class_metrics,
+    )
+
+    x, yl = datasets.synthetic_cifar10(3000, seed=41,
+                                       difficulty="calibrated")
+    y = np.eye(10, dtype=np.float32)[yl]
+
+    class EchoGen:
+        """Returns fresh real rows of each requested class."""
+
+        def __init__(self, collapse: bool):
+            self.collapse = collapse
+            self._xe, self._ye = datasets.synthetic_cifar10(
+                3000, seed=42, difficulty="calibrated")
+
+        def output(self, z, cond, params=None):
+            cls = np.argmax(np.asarray(cond), axis=1)
+            rows = np.empty((cls.size, self._xe.shape[1]), np.float32)
+            for c in range(10):
+                pool = self._xe[self._ye == c]
+                m = cls == c
+                if self.collapse:
+                    rows[m] = pool[0]  # one frozen image per class
+                else:
+                    rows[m] = pool[:m.sum()]
+            return [rows]
+
+    healthy = conditional_class_metrics(
+        EchoGen(False), x, y, sample_shape=(3, 32, 32), z_size=100,
+        n_per_class=200)
+    collapsed = conditional_class_metrics(
+        EchoGen(True), x, y, sample_shape=(3, 32, 32), z_size=100,
+        n_per_class=200)
+    assert healthy["mean_class_fid"] < 30, healthy["mean_class_fid"]
+    assert collapsed["mean_class_fid"] > 3 * healthy["mean_class_fid"]
+    assert healthy["mean_diversity_ratio"] > 0.8
+    assert collapsed["mean_diversity_ratio"] < 0.1
+
+
 @pytest.mark.slow
 def test_calibrated_surrogate_difficulty_band():
     """The raw-pixel linear probe must stay in the calibrated band

@@ -365,6 +365,163 @@ def test_stream_chunked_u8_codec_matches_resident(tmp_path):
 
 
 @pytest.mark.slow
+def test_stream_dedup_tier_matches_resident(tmp_path):
+    """The adaptive epoch-in-chunk streaming tier (r5): when one chunk
+    covers whole epochs, the distinct-row tables ship once and only the
+    row-index schedule streams; the chunk_indexed program gathers batches
+    on device.  Must train BITWISE like the resident path and the plain
+    chunked path (same counter-based draws, same data order)."""
+    import json
+
+    from gan_deeplearning4j_tpu.train import cv_main
+    from gan_deeplearning4j_tpu.train.gan_trainer import GANTrainer
+
+    # n_train=32, B=16 -> 2 batches/pass; iterations/cadences resolve
+    # K=4 -> one chunk = 2 full passes: the dedup tier engages.  (A
+    # plain-chunked control at this size is impossible by construction —
+    # one pass's bytes == the table's bytes, so any budget that allows a
+    # pass-covering chunk also admits the table; the plain path's parity
+    # is pinned by the r4 triangle tests at non-covering sizes.)
+    modes = {
+        "resident": dict(data_on_device=True),
+        "dedup": dict(data_on_device=False),
+        "perstep": dict(data_on_device=False, stream_chunk_bytes=0),
+    }
+    recs, trainers = {}, {}
+    for mode, kw in modes.items():
+        d = str(tmp_path / mode)
+        config = cv_main.default_config(
+            num_iterations=8, batch_size=16, res_path=d, print_every=4,
+            save_every=8, **kw)
+        t = GANTrainer(cv_main.CVWorkload(n_train=32, n_test=16), config)
+        t.train(log=lambda s: None)
+        trainers[mode] = t
+        with open(os.path.join(d, "mnist_metrics.jsonl")) as f:
+            recs[mode] = [json.loads(line) for line in f]
+    assert trainers["dedup"]._stream_dedup            # tier engaged
+    assert trainers["dedup"]._steps_per_call == 4
+    assert not trainers["perstep"]._stream_dedup
+    assert trainers["perstep"]._steps_per_call == 1
+    assert not trainers["resident"]._stream_dedup
+    steps = [r["step"] for r in recs["resident"]]
+    assert steps == list(range(1, 9))
+    for mode in ("dedup", "perstep"):
+        assert [r["step"] for r in recs[mode]] == steps, mode
+        for a, b in zip(recs[mode], recs["resident"]):
+            for key in ("d_loss", "g_loss", "classifier_loss"):
+                if mode == "dedup":
+                    # same program family (slice/gather + chunk decode):
+                    # bitwise
+                    assert a[key] == b[key], (mode, a["step"], key)
+                elif a["step"] == 1:
+                    # per-step ships raw f32 (no dequant in the program):
+                    # fusion-order 1-ulp noise, amplified through the
+                    # feature BN (measured 5e-4 rel at step 1) and then
+                    # multiplicatively per step by the near-sign-SGD
+                    # RmsProp (6e-2 by step 5 on this 32-row set) — so
+                    # only step 1 carries a meaningful band here;
+                    # per-step parity proper is the r4 triangle test's
+                    # job at a saner workload size.
+                    assert a[key] == pytest.approx(b[key], rel=1e-2,
+                                                   abs=5e-7), (
+                        mode, a["step"], key)
+                else:
+                    assert np.isfinite(a[key]), (mode, a["step"], key)
+    for f in ["mnist_out_4.csv", "mnist_out_8.csv",
+              "mnist_test_predictions_8.csv"]:
+        want = open(os.path.join(str(tmp_path / "resident"), f),
+                    "rb").read()
+        got = open(os.path.join(str(tmp_path / "dedup"), f), "rb").read()
+        assert got == want, f  # dedup artifacts bitwise like the losses
+
+
+@pytest.mark.slow
+def test_stream_chunked_mesh_matches_single_device(tmp_path):
+    """Chunked streaming x mesh (VERDICT r4 weak-#5): the triangle
+    (resident / chunked-stream / per-step-stream) under a 4-device mesh
+    trains like the single-device resident run — the chunk transfer is
+    placed replicated and every replica slices its own shard, so the
+    composition must be the same computation, not just 'runs'."""
+    import json
+
+    from gan_deeplearning4j_tpu.train import cv_main
+    from gan_deeplearning4j_tpu.train.gan_trainer import GANTrainer
+
+    modes = {
+        "resident1": dict(n_devices=1, data_on_device=True),
+        "resident4": dict(n_devices=4, data_on_device=True),
+        "chunked4": dict(n_devices=4, data_on_device=False),
+        "perstep4": dict(n_devices=4, data_on_device=False,
+                         stream_chunk_bytes=0),
+    }
+    recs, trainers = {}, {}
+    for mode, kw in modes.items():
+        d = str(tmp_path / mode)
+        config = cv_main.default_config(
+            num_iterations=4, batch_size=16, res_path=d, print_every=2,
+            save_every=4, use_data_codec=False, **kw)
+        t = GANTrainer(cv_main.CVWorkload(n_train=64, n_test=16), config)
+        t.train(log=lambda s: None)
+        trainers[mode] = t
+        with open(os.path.join(d, "mnist_metrics.jsonl")) as f:
+            recs[mode] = [json.loads(line) for line in f]
+    # the mesh runs really meshed, the chunked run really chunked
+    assert trainers["resident4"]._mesh is not None
+    assert trainers["chunked4"]._mesh is not None
+    assert trainers["chunked4"]._steps_per_call == 2
+    assert trainers["chunked4"]._fused_multi is not None
+    assert trainers["perstep4"]._steps_per_call == 1
+    steps = [r["step"] for r in recs["resident1"]]
+    assert steps == [1, 2, 3, 4]
+    for mode in ("resident4", "chunked4", "perstep4"):
+        assert [r["step"] for r in recs[mode]] == steps, mode
+    # chunked vs resident, same mesh: the SAME data_on_device SPMD
+    # program (batches sliced on device) fed from HBM table vs streamed
+    # chunk — tight band (the single-device triangle test's standard)
+    for a, b in zip(recs["chunked4"], recs["resident4"]):
+        for key in ("d_loss", "g_loss", "classifier_loss"):
+            assert a[key] == pytest.approx(b[key], rel=2e-5), (
+                "chunked4", a["step"], key)
+    # per-step streaming (pre-sharded data args, a differently
+    # structured program) and mesh-vs-1dev: equal up to float noise from
+    # reduction-order differences, which the near-sign-SGD RmsProp
+    # (rsqrt at eps 1e-8) amplifies MULTIPLICATIVELY across steps —
+    # measured here ~1e-2 rel by step 4; the r4 TPU dryrun saw 1.3e-2 in
+    # 3 steps.  So the binding alignment proof is STEP 1 (no accumulated
+    # noise; a shard/label misalignment diverges O(1) immediately), and
+    # later steps get the amplification allowance.
+    for mode, base in (("perstep4", "resident4"),
+                       ("resident4", "resident1")):
+        for a, b in zip(recs[mode], recs[base]):
+            band = 1e-3 if a["step"] == 1 else 5e-2
+            for key in ("d_loss", "g_loss", "classifier_loss"):
+                assert a[key] == pytest.approx(b[key], rel=band), (
+                    mode, a["step"], key)
+    import numpy as _np
+
+    for f in ["mnist_out_2.csv", "mnist_out_4.csv",
+              "mnist_test_predictions_4.csv"]:
+        # chunked == resident bitwise on the same mesh
+        want = open(os.path.join(str(tmp_path / "resident4"), f),
+                    "rb").read()
+        got = open(os.path.join(str(tmp_path / "chunked4"), f),
+                   "rb").read()
+        assert got == want, f
+        # across program structures the accumulated ~1e-2 weight drift
+        # perturbs dumped pixels/probabilities slightly (measured: ~4% of
+        # cells beyond 0.06, max ~0.2 after 4 steps); a misalignment
+        # produces DIFFERENT images — O(1) differences in most cells
+        a4 = _np.loadtxt(os.path.join(str(tmp_path / "resident4"), f),
+                         delimiter=",", ndmin=2)
+        for mode in ("perstep4", "resident1"):
+            other = _np.loadtxt(os.path.join(str(tmp_path / mode), f),
+                                delimiter=",", ndmin=2)
+            diff = _np.abs(a4 - other)
+            assert diff.mean() < 0.03 and diff.max() < 0.5, (
+                mode, f, diff.mean(), diff.max())
+
+
+@pytest.mark.slow
 def test_stream_chunked_resume_with_changed_cadence(tmp_path):
     """Resuming on the streaming path from a checkpoint step that the new
     config's chunk size would not divide must keep chunks aligned (K is

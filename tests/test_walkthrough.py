@@ -13,6 +13,34 @@ import pytest
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
+def test_notebook_in_sync_with_script():
+    """The committed docs/walkthrough.ipynb must be the conversion of
+    docs/walkthrough.py (cell-for-cell source match) and carry executed
+    outputs — regenerate with `python docs/make_notebook.py` after
+    editing the script."""
+    import nbformat
+
+    sys.path.insert(0, os.path.join(REPO, "docs"))
+    try:
+        import make_notebook
+    finally:
+        sys.path.pop(0)
+
+    with open(os.path.join(REPO, "docs", "walkthrough.ipynb")) as f:
+        committed = nbformat.read(f, as_version=4)
+    built = make_notebook.build_notebook()
+    assert [c.cell_type for c in committed.cells] == \
+        [c.cell_type for c in built.cells]
+    for got, want in zip(committed.cells, built.cells):
+        assert got.source.strip() == want.source.strip()
+    executed = [c for c in committed.cells
+                if c.cell_type == "code" and c.get("outputs")]
+    assert len(executed) >= 8, "committed notebook must carry real outputs"
+    text = "".join(str(c.get("outputs")) for c in committed.cells
+                   if c.cell_type == "code")
+    assert "walkthrough complete" in text
+
+
 @pytest.mark.slow
 def test_walkthrough_executes():
     env = dict(os.environ)
