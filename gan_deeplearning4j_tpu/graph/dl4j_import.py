@@ -581,15 +581,27 @@ def export_dl4j(graph: ComputationGraph, path: str,
                   for p, v in graph.params.get(name, {}).items()}
         if save_updater and getattr(graph, "opt_state", None) \
                 and state_segments is not None:
+            from gan_deeplearning4j_tpu.optim.rmsprop import RmsProp
+
+            # the guard is by updater TYPE, not leaf shape: AdaGrad's
+            # sum-of-squares leaf is shape-identical to an RmsProp cache
+            # (a shape check would silently serialize wrong dynamics)
+            # and Sgd's scalar leaf would corrupt the segmentation.
+            # A missing per-layer updater is the frozen (_FROZEN RmsProp)
+            # case — exportable zeros.
+            up = getattr(getattr(graph, "updater", None),
+                         "layer_updaters", {}).get(name)
             st = graph.opt_state.get(name, {})
             for pname, forder in _updater_state_order(layer):
                 leaf = st.get(pname)
                 if leaf is None:
                     continue
-                if isinstance(leaf, dict):
-                    # Adam/Scheduled state has no DL4J RmsProp view
-                    # equivalent: degrade to the weights-only zip (the
-                    # pre-r5 behavior) rather than failing the export
+                if isinstance(leaf, dict) or (
+                        up is not None and not isinstance(up, RmsProp)):
+                    # Adam/Scheduled/Sgd/AdaGrad state has no DL4J
+                    # RmsProp view equivalent: degrade to the
+                    # weights-only zip (the pre-r5 behavior) rather
+                    # than failing the export
                     import logging
 
                     logging.getLogger(__name__).warning(

@@ -100,6 +100,12 @@ class GANTrainerConfig:
     # (double-buffered).  0 disables chunking (per-batch transfer +
     # per-step dispatch, the r3 behavior).
     stream_chunk_bytes: int = 256 << 20
+    # Adaptive epoch-in-chunk dedup tier: None = auto (engage when one
+    # chunk covers >= a full pass of the DETERMINISTIC iterator and the
+    # distinct-row tables fit stream_chunk_bytes); False = never (the
+    # escape hatch for nondeterministic/augmenting iterators, whose
+    # changing pass content the dedup worker rejects by design).
+    stream_dedup: Optional[bool] = None
     # Exact uint8 transport/residency codec (data/codec.py): when the
     # training features are bitwise the 2-decimal fixed-point contract,
     # the RESIDENT table is stored in HBM as u8 codes (4x residency
@@ -543,9 +549,15 @@ class GANTrainer:
                 # once per occurrence is pure waste on a bandwidth-bound
                 # link (the r4 e2e_stream driver capture's bound).
                 self._stream_dedup = False
-                if not resident:
+                if not resident and c.stream_dedup is not False:
+                    # UNCAPPED_STREAM: streaming-path semantics (resume-
+                    # step chunk alignment stays active) without a byte
+                    # bound — in dedup mode only the index schedule
+                    # streams per chunk, so the per-chunk transfer budget
+                    # doesn't constrain K
+                    UNCAPPED_STREAM = 1 << 62
                     k_nocap = self._resolve_steps_per_call(
-                        codec=self._stream_codec)
+                        byte_cap=UNCAPPED_STREAM, codec=self._stream_codec)
                     n_full = iter_train.num_examples() // c.batch_size
                     fb = 1 if self._stream_codec == "u8x100" else 4
                     table_bytes = n_full * c.batch_size * (
@@ -553,7 +565,7 @@ class GANTrainer:
                     if (0 < n_full <= k_nocap and k_nocap > 1
                             and table_bytes <= c.stream_chunk_bytes):
                         self._stream_dedup = True
-                        byte_cap = None  # only the index schedule streams
+                        byte_cap = UNCAPPED_STREAM
                 self._steps_per_call = self._resolve_steps_per_call(
                     byte_cap=byte_cap, codec=self._stream_codec)
                 if self._steps_per_call <= 1:

@@ -362,6 +362,29 @@ def test_continue_training_with_updater_state(tmp_path):
         "no information otherwise")
 
 
+def test_non_rmsprop_state_degrades_to_weights_only(tmp_path):
+    """Adam (dict leaves), Sgd (scalar leaves) and AdaGrad (leaves
+    shape-identical to RmsProp caches — the dangerous case) must all
+    degrade to a weights-only zip, never serialize wrong-dynamics state
+    as updaterState.bin."""
+    from gan_deeplearning4j_tpu.optim.adagrad import AdaGrad
+    from gan_deeplearning4j_tpu.optim.adam import Adam
+    from gan_deeplearning4j_tpu.optim.sgd import Sgd
+
+    for i, upd in enumerate((Adam(1e-3), Sgd(0.1), AdaGrad(0.1))):
+        g = _training_net(upd)
+        g.fit(np.zeros((4, 6), np.float32), np.zeros((4, 1), np.float32))
+        path = str(tmp_path / f"m{i}.zip")
+        export_dl4j(g, path, save_updater=True)
+        with zipfile.ZipFile(path) as zf:
+            assert "updaterState.bin" not in zf.namelist(), type(upd)
+        # and the weights-only zip still round-trips
+        g2 = import_dl4j(path)
+        x = np.random.RandomState(i).rand(3, 6).astype(np.float32)
+        np.testing.assert_array_equal(
+            np.asarray(g.output(x)[0]), np.asarray(g2.output(x)[0]))
+
+
 def test_unsupported_configs_raise(tmp_path):
     ns = "org.deeplearning4j.nn.conf"
 

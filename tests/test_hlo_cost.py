@@ -1,0 +1,62 @@
+"""The HLO cost-attribution parser (benchmarks/hlo_cost.py) on a
+hand-written optimized-HLO fragment: conv FLOPs from dim_labels + rhs
+shape, slice/DMA byte accounting, and fusion-internal exclusion — the
+rules the r5 roofline attribution (RESULTS §-2) rests on."""
+
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+from benchmarks import hlo_cost  # noqa: E402
+
+FRAGMENT = """\
+HloModule jit_step, is_scheduled=true
+
+%fused_computation.1 (param_0.1: f32[8,16,10,10], param_1.1: f32[32,16,3,3]) -> f32[8,32,8,8] {
+  %param_0.1 = f32[8,16,10,10]{3,2,1,0} parameter(0)
+  %param_1.1 = f32[32,16,3,3]{3,2,1,0} parameter(1)
+  ROOT %conv.1 = f32[8,32,8,8]{3,2,1,0} convolution(%param_0.1, %param_1.1), window={size=3x3}, dim_labels=bf01_oi01->bf01
+}
+
+ENTRY %main.1 (p0: f32[8,16,10,10], p1: f32[32,16,3,3], p2: f32[1000,64]) -> f32[8,32,8,8] {
+  %p0 = f32[8,16,10,10]{3,2,1,0} parameter(0)
+  %p1 = f32[32,16,3,3]{3,2,1,0} parameter(1)
+  %p2 = f32[1000,64]{1,0} parameter(2)
+  %slice.7 = f32[10,64]{1,0} slice(%p2), slice={[0:10], [0:64]}
+  %copy-start.3 = f32[1000,64]{1,0} copy-start(%p2)
+  %copy-done.3 = f32[1000,64]{1,0} copy-done(%copy-start.3)
+  ROOT %fusion.9 = f32[8,32,8,8]{3,2,1,0} fusion(%p0, %p1), kind=kOutput, calls=%fused_computation.1
+}
+"""
+
+
+def test_conv_flops_and_byte_rules():
+    rows = hlo_cost.analyze_hlo(FRAGMENT)
+    by_name = {r["name"]: r for r in rows}
+
+    # conv inside the fusion body: FLOPs = 2 * out(8*32*8*8) * k(16*3*3),
+    # bytes 0 (the call site carries them)
+    conv = by_name["conv.1"]
+    assert conv["flops"] == 2 * (8 * 32 * 8 * 8) * (16 * 3 * 3)
+    assert conv["bytes"] == 0 and conv["in_fusion_body"]
+
+    # the fusion call site: operand + result bytes, no flops of its own
+    fus = by_name["fusion.9"]
+    assert not fus["in_fusion_body"]
+    expect = (8 * 16 * 10 * 10 + 32 * 16 * 3 * 3 + 8 * 32 * 8 * 8) * 4
+    assert fus["bytes"] == expect and fus["flops"] == 0
+
+    # slice reads only the window (2x out bytes), not the 1000-row table
+    sl = by_name["slice.7"]
+    assert sl["bytes"] == 2 * (10 * 64 * 4)
+
+    # DMA halves are skipped entirely
+    assert "copy-start.3" not in by_name
+    assert "copy-done.3" not in by_name
+
+    s = hlo_cost.summarize(rows, top=5)
+    assert s["total_conv_dot_flops"] == conv["flops"]
+    assert s["top_ops"][0]["op"].startswith(("fusion", "convolution"))

@@ -436,6 +436,51 @@ def test_stream_dedup_tier_matches_resident(tmp_path):
 
 
 @pytest.mark.slow
+def test_stream_dedup_resume_and_opt_out(tmp_path):
+    """(r5 review findings) A resumed run on the dedup tier must keep
+    chunks aligned with the resume step (the byte_cap=None shortcut
+    used to skip the alignment gcd and crash with 'chunk misalignment');
+    and config stream_dedup=False is the documented escape hatch for
+    nondeterministic iterators."""
+    from gan_deeplearning4j_tpu.train import cv_main
+    from gan_deeplearning4j_tpu.train.gan_trainer import GANTrainer
+
+    d = str(tmp_path)
+    base = dict(res_path=d, data_on_device=False, batch_size=16)
+    # run 1: dedup engaged (2 batches/pass, K=3 covers a pass),
+    # checkpoint at step 3
+    cfg1 = cv_main.default_config(
+        num_iterations=3, checkpoint_every=3, print_every=3 * 10 ** 8,
+        save_every=3 * 10 ** 8, **base)
+    t1 = GANTrainer(cv_main.CVWorkload(n_train=32, n_test=16), cfg1)
+    t1.train(log=lambda s: None)
+    assert t1._stream_dedup and t1._steps_per_call == 3
+
+    # resume at step 3 with cadences that would resolve K=4: alignment
+    # must force K to divide the resume step (gcd -> 1), not crash
+    cfg2 = cv_main.default_config(
+        num_iterations=8, checkpoint_every=4, print_every=4 * 10 ** 8,
+        save_every=4 * 10 ** 8, resume=True, **base)
+    t2 = GANTrainer(cv_main.CVWorkload(n_train=32, n_test=16), cfg2)
+    res = t2.train(log=lambda s: None)
+    assert res["steps"] == 8
+    assert t2._steps_per_call == 1  # gcd(gcd(8,4), 3) == 1
+    assert not t2._stream_dedup    # K=1 cannot cover a pass
+    assert np.isfinite(res["d_loss"])
+
+    # opt-out: same eligible shape, dedup forced off -> plain chunking
+    d3 = str(tmp_path / "optout")
+    cfg3 = cv_main.default_config(
+        num_iterations=8, print_every=4, save_every=8,
+        res_path=d3, data_on_device=False, batch_size=16,
+        stream_dedup=False)
+    t3 = GANTrainer(cv_main.CVWorkload(n_train=32, n_test=16), cfg3)
+    t3.train(log=lambda s: None)
+    assert not t3._stream_dedup
+    assert t3._steps_per_call > 1  # still chunked, just not dedup
+
+
+@pytest.mark.slow
 def test_stream_chunked_mesh_matches_single_device(tmp_path):
     """Chunked streaming x mesh (VERDICT r4 weak-#5): the triangle
     (resident / chunked-stream / per-step-stream) under a 4-device mesh
