@@ -324,9 +324,14 @@ def run_program(name: str, top: int, measure: bool,
     if summary["xla_cost_flops"]:
         summary["flops_xla_us"] = round(
             summary["xla_cost_flops"] / PEAK_FLOPS * 1e6, 1)
-    summary["flops_us_note"] = ("per-instruction total; upper bound "
-                                "(loop-peel duplicates included) — "
-                                "flops_xla_us is canonical")
+        summary["flops_us_note"] = ("per-instruction total; upper bound "
+                                    "(loop-peel duplicates included) — "
+                                    "flops_xla_us is canonical")
+    else:
+        summary["flops_us_note"] = ("per-instruction total; upper bound "
+                                    "(loop-peel duplicates included); no "
+                                    "cost-model count available on this "
+                                    "backend")
     summary["program"] = name
     if measure:
         import statistics

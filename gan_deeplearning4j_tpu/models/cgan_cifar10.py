@@ -75,6 +75,12 @@ class CGANConfig:
     conditional_bn: bool = True
     projection_d: bool = True
     minibatch_stddev: bool = True
+    # mode-seeking regularizer weight (train/gan_pair.py ms_weight —
+    # MSGAN): the r5 per-class-FID/diversity metrics measured
+    # within-class mode shrinkage (diversity ratio ~0.4) that the
+    # structural fixes above don't address; this is the targeted lever.
+    # 0 = off (the r4-compatible default).
+    ms_weight: float = 0.0
 
 
 def _lr(rate: float, cfg: CGANConfig):

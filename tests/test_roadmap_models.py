@@ -250,7 +250,12 @@ def test_gan_pair_dp_matches_single_device(cpu_devices):
     pair1 = GANPair(g1, d1)
     pair2 = GANPair(g2, d2, mesh=data_mesh(4))
     rng = np.random.RandomState(0)
-    B = 8
+    # B=32: per-shard real/fake halves (B/4 = 8 rows each) are multiples
+    # of MinibatchStdDev's group_size=4 — the layer's documented
+    # mesh==single-device alignment requirement (graph/layers.py); the
+    # r5 CelebAConfig turns the layer on by default, so the old B=8
+    # (2-row halves straddling a group) no longer satisfies exactness
+    B = 32
     real = jnp.asarray(rng.rand(B, 3 * 64 * 64).astype(np.float32))
     z = jnp.asarray(rng.randn(B, 8).astype(np.float32))
     l1 = pair1.d_step(real, {"z": z})
@@ -261,6 +266,32 @@ def test_gan_pair_dp_matches_single_device(cpu_devices):
             np.testing.assert_allclose(
                 np.asarray(v), np.asarray(d2.params[layer][name]),
                 rtol=1e-4, atol=1e-5, err_msg=f"{layer}/{name}")
+
+
+@pytest.mark.slow
+def test_gan_pair_ms_weight_dp_matches_single_device(cpu_devices):
+    """The mode-seeking regularizer under a mesh: the |G(z1)-G(z2)|/|z1-z2|
+    ratio must form from GLOBAL-pmean'd distances — per-shard ratios
+    diverge from single-device by ~2e-3 (Jensen; the r5 review's
+    measured bug), the fixed version by float noise only."""
+    from gan_deeplearning4j_tpu.models import cgan_cifar10 as C
+
+    cfg = C.CGANConfig(base_filters=8, z_size=16, ms_weight=1.0)
+    mk = lambda: (C.build_generator(cfg), C.build_discriminator(cfg))
+    g1, d1 = mk()
+    g2, d2 = mk()
+    pair1 = GANPair(g1, d1, ms_weight=cfg.ms_weight)
+    pair2 = GANPair(g2, d2, mesh=data_mesh(4), ms_weight=cfg.ms_weight)
+    rng = np.random.RandomState(0)
+    B = 32
+    z = jnp.asarray(rng.randn(B, 16).astype(np.float32))
+    cond = jnp.asarray(np.eye(10, dtype=np.float32)[
+        np.arange(B) % 10])
+    l1 = pair1.g_step({"z": z, "label": cond}, {"label": cond})
+    l2 = pair2.g_step({"z": z, "label": cond}, {"label": cond})
+    np.testing.assert_allclose(float(l1), float(l2), rtol=1e-4)
+    with pytest.raises(ValueError, match="ms_weight must be >= 0"):
+        GANPair(g1, d1, ms_weight=-0.1)
 
 
 @pytest.mark.slow
