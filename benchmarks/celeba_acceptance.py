@@ -45,6 +45,10 @@ def main(argv=None) -> None:
                         "the run length (the measured stabilizer: constant "
                         "LR degrades past ~3k as D overpowers G), 0 = "
                         "constant LR")
+    p.add_argument("--ms-weight", type=float, default=0.0,
+                   help="mode-seeking regularizer weight (the r5 cGAN "
+                        "diversity lever, applied to the unconditional "
+                        "family's measured geometric collapse)")
     p.add_argument("--res-path", default=None)
     args = p.parse_args(argv)
     if args.iterations % args.every or args.iterations <= 0:
@@ -69,7 +73,7 @@ def main(argv=None) -> None:
         "celeba", args.iterations, args.batch, res, args.n_train,
         print_every=args.every, ema_decay=args.ema_decay,
         checkpoint_every=args.every, checkpoint_keep=n_ckpts,
-        lr_decay_steps=decay,
+        lr_decay_steps=decay, ms_weight=args.ms_weight,
         log=lambda s: print(s, file=sys.stderr, flush=True))
 
     # held-out real draw (training used the default seed-666 table).

@@ -59,6 +59,12 @@ class CelebAConfig:
     # batch-diversity feature before D's output head (same rationale as
     # cgan_cifar10.minibatch_stddev: a collapsing G is directly visible)
     minibatch_stddev: bool = True
+    # mode-seeking regularizer weight (train/gan_pair.py ms_weight): the
+    # r5 trajectory diagnosed GEOMETRIC mode collapse (pose/size/mouth
+    # attribute diversity lost while renders sharpen) — the same
+    # z-to-image diversity failure the cgan family's metrics caught.
+    # 0 = off (r4-compatible default).
+    ms_weight: float = 0.0
 
 
 def _lr(rate: float, cfg: CelebAConfig):

@@ -79,6 +79,21 @@ class GANPair:
 
     # -- pure forwards -------------------------------------------------------
 
+    def _global_uniform(self, key, local_shape, axis_name, dtype,
+                        minval=-1.0, maxval=1.0):
+        """U[minval,maxval] draw with mesh == single-device parity: every
+        replica draws the GLOBAL batch from the replicated key and slices
+        its own shard (replicated per-shard draws would correlate shards;
+        per-shard keys would break single-device equivalence).  The one
+        home for the idiom the GP-alpha and mode-seeking paths share."""
+        n_shards = self.mesh.shape[self.axis] if axis_name is not None else 1
+        b = local_shape[0]
+        g = jax.random.uniform(key, (b * n_shards,) + local_shape[1:],
+                               dtype=dtype, minval=minval, maxval=maxval)
+        if axis_name is not None:
+            g = lax.dynamic_slice_in_dim(g, lax.axis_index(axis_name) * b, b)
+        return g
+
     def _gen_forward(self, params_g, z_inputs, train, rng, axis_name=None):
         values, updates = self.gen._forward(params_g, z_inputs, train, rng,
                                             axis_name)
@@ -122,16 +137,12 @@ class GANPair:
                 gp_key = prng.stream(rng, "gp")
                 alpha = None
                 if axis_name is not None:
-                    # the step rng is replicated: draw the GLOBAL batch's
-                    # alphas on every replica and slice this shard's —
-                    # replicated draws would correlate the GP estimator
-                    # across shards and break mesh==single-device parity
-                    n_shards = self.mesh.shape[self.axis]
-                    n = real.shape[0]
-                    galpha = jax.random.uniform(
-                        gp_key, (n * n_shards, 1), dtype=real.dtype)
-                    alpha = lax.dynamic_slice_in_dim(
-                        galpha, lax.axis_index(axis_name) * n, n)
+                    # replicated per-shard draws would correlate the GP
+                    # estimator across shards — _global_uniform's
+                    # draw-global-slice-own-shard rule
+                    alpha = self._global_uniform(
+                        gp_key, (real.shape[0], 1), axis_name,
+                        real.dtype, minval=0.0, maxval=1.0)
                 gp = loss_lib.gradient_penalty(
                     critic, real, fake, gp_key, alpha=alpha)
                 loss = loss + self.gp_weight * gp
@@ -159,17 +170,8 @@ class GANPair:
             if self.ms_weight:
                 z_name = self.gen.input_names[0]
                 z1 = z_inputs[z_name]
-                b = z1.shape[0]
-                # GLOBAL second draw sliced per shard (the multistep
-                # draw() pattern) so mesh == single-device holds exactly
-                n_shards = (self.mesh.shape[self.axis]
-                            if axis_name is not None else 1)
-                z2 = jax.random.uniform(
-                    prng.stream(rng, "ms"), (b * n_shards, z1.shape[1]),
-                    dtype=z1.dtype, minval=-1.0, maxval=1.0)
-                if axis_name is not None:
-                    z2 = lax.dynamic_slice_in_dim(
-                        z2, lax.axis_index(axis_name) * b, b)
+                z2 = self._global_uniform(
+                    prng.stream(rng, "ms"), z1.shape, axis_name, z1.dtype)
                 fake2, _ = self._gen_forward(
                     p, {**z_inputs, z_name: z2}, True,
                     prng.stream(rng, "gen-ms"), axis_name)

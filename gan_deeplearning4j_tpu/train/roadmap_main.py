@@ -52,9 +52,9 @@ def _build(family: str, mesh, num_classes: int = None,
     if lr_decay_steps and family not in ("cgan-cifar10", "celeba"):
         raise ValueError("--lr-decay-steps is currently wired for "
                          "cgan-cifar10 and celeba only")
-    if ms_weight and family != "cgan-cifar10":
+    if ms_weight and family not in ("cgan-cifar10", "celeba"):
         raise ValueError("--ms-weight is currently wired for "
-                         "cgan-cifar10 only")
+                         "cgan-cifar10 and celeba only")
     if family == "cgan-cifar10":
         import dataclasses
 
@@ -87,8 +87,10 @@ def _build(family: str, mesh, num_classes: int = None,
         cfg = M.CelebAConfig()
         if lr_decay_steps:
             cfg = dataclasses.replace(cfg, decay_steps=lr_decay_steps)
+        if ms_weight:
+            cfg = dataclasses.replace(cfg, ms_weight=ms_weight)
         pair = GANPair(M.build_generator(cfg), M.build_discriminator(cfg),
-                       mesh=mesh)
+                       mesh=mesh, ms_weight=cfg.ms_weight)
         return pair, cfg, (cfg.channels, cfg.height, cfg.width)
     raise ValueError(f"unknown family {family!r}; choose from {FAMILIES}")
 
@@ -351,9 +353,8 @@ def train(family: str, iterations: int, batch_size: int, res_path: str,
                 z_size=cfg.z_size, probe_steps=fidelity_steps,
                 use_ema=True, probe=fid["probe"])
             result["conditional_fidelity_ema"] = fid_ema["fidelity"]
-        min_class = int(np.bincount(
-            np.argmax(y, axis=1), minlength=y.shape[1]).min())
-        if family == "cgan-cifar10" and min_class >= 50:
+        if family == "cgan-cifar10" and int(np.bincount(
+                np.argmax(y, axis=1), minlength=y.shape[1]).min()) >= 50:
             # the non-saturating companions (frozen 32x32 space): per-
             # class FID + intra-class diversity keep discriminating when
             # agreement hits the probe ceiling.  Skipped for toy runs

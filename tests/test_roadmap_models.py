@@ -290,6 +290,13 @@ def test_gan_pair_ms_weight_dp_matches_single_device(cpu_devices):
     l1 = pair1.g_step({"z": z, "label": cond}, {"label": cond})
     l2 = pair2.g_step({"z": z, "label": cond}, {"label": cond})
     np.testing.assert_allclose(float(l1), float(l2), rtol=1e-4)
+    # the GRADIENT path too: post-update params must match — a value-only
+    # check would miss a cotangent-path divergence in the pmean'd ratio
+    for layer in g1.params:
+        for name, v in g1.params[layer].items():
+            np.testing.assert_allclose(
+                np.asarray(v), np.asarray(g2.params[layer][name]),
+                rtol=1e-4, atol=1e-5, err_msg=f"{layer}/{name}")
     with pytest.raises(ValueError, match="ms_weight must be >= 0"):
         GANPair(g1, d1, ms_weight=-0.1)
 
