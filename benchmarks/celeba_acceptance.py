@@ -121,6 +121,10 @@ def main(argv=None) -> None:
         "iterations": args.iterations,
         "batch": args.batch,
         "ema_decay": args.ema_decay,
+        # the two recipe flags that distinguish the ablation runs — an
+        # evidence JSON must be tied to the configuration that made it
+        "lr_decay_steps": decay,
+        "ms_weight": args.ms_weight,
         "examples_per_sec": result["examples_per_sec"],
         "d_loss": result["d_loss"],
         "g_loss": result["g_loss"],

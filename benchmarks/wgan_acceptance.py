@@ -1,0 +1,88 @@
+"""WGAN-GP 10k acceptance with frozen-space FID (r5: the family was
+previously validated only at 2k steps with an eyeballed grid).
+
+The wgan-gp roadmap family trains on the MNIST-shaped surrogate in
+[0, 1], which is exactly the committed frozen MNIST extractor's domain
+(eval/fid_extractor.py) — so its quality evidence can ride the same
+cross-round-comparable FID as the CV flagship, live and EMA weights.
+
+Prints ONE JSON line:
+  {"metric": "wgan_gp_fid_frozen", "value": <final EMA FID>, ...}
+
+Run (TPU): python benchmarks/wgan_acceptance.py [--iterations 10000]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+
+def main(argv=None) -> None:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--iterations", type=int, default=10000)
+    p.add_argument("--batch", type=int, default=128)
+    p.add_argument("--n-train", type=int, default=10000)
+    p.add_argument("--fid-samples", type=int, default=5000)
+    p.add_argument("--ema-decay", type=float, default=0.999)
+    p.add_argument("--res-path", default=None)
+    args = p.parse_args(argv)
+
+    from gan_deeplearning4j_tpu.data import datasets
+    from gan_deeplearning4j_tpu.eval import fid as fid_lib
+    from gan_deeplearning4j_tpu.eval import fid_extractor as fx
+    from gan_deeplearning4j_tpu.models import wgan_gp
+    from gan_deeplearning4j_tpu.train import roadmap_main
+
+    res = args.res_path or tempfile.mkdtemp(prefix="wgan_accept_")
+    result = roadmap_main.train(
+        "wgan-gp", args.iterations, args.batch, res, args.n_train,
+        print_every=max(1000, args.iterations // 10),
+        ema_decay=args.ema_decay,
+        log=lambda s: print(s, file=sys.stderr, flush=True))
+
+    cfg = wgan_gp.WGANGPConfig()
+    # held-out real draw; the family's data law is the CALIBRATED
+    # MNIST surrogate in [0,1] (roadmap_main._data)
+    real, _ = datasets.synthetic_mnist(args.fid_samples,
+                                       seed=cfg.seed + 1)
+    real = real.astype("float32")
+
+    from gan_deeplearning4j_tpu.graph import serialization
+
+    fids = {}
+    for tag, fname in (("fid_frozen", "wgan-gp_gen_model.zip"),
+                       ("fid_frozen_ema", "wgan-gp_gen_ema_model.zip")):
+        path = os.path.join(res, fname)
+        if not os.path.exists(path):
+            continue
+        gen = serialization.read_model(path)
+        gx = fid_lib.synthesize_pixels(gen, args.fid_samples,
+                                       real.shape[1], z_size=cfg.z_size)
+        fids[tag] = float(fx.frozen_fid(real, gx))
+        print(f"[wgan-accept] {tag} {fids[tag]:.2f}", file=sys.stderr,
+              flush=True)
+
+    print(json.dumps({
+        "metric": "wgan_gp_fid_frozen",
+        "value": fids.get("fid_frozen_ema", fids.get("fid_frozen")),
+        "unit": "frozen-FID (MNIST extractor space)",
+        "iterations": args.iterations,
+        "batch": args.batch,
+        "d_loss": result["d_loss"],
+        "g_loss": result["g_loss"],
+        "examples_per_sec": result["examples_per_sec"],
+        **fids,
+        "res_path": res,
+    }, default=float))
+
+
+if __name__ == "__main__":
+    main()
