@@ -39,6 +39,10 @@ Verified failure path: run with the tunnel down (or
 ``JAX_PLATFORMS=tpu`` on a host with no TPU) — the skip line appears
 within attempts*(timeout+backoff) seconds; tests/test_bench_entry.py
 pins this behavior with a guaranteed-dead backend.
+
+Exception to the exit-0 contract: ``--dryrun`` (the CI smoke lane) runs
+the inner benchmark's CPU build-and-execute smoke with NO probe and
+exits nonzero when it fails — CI wants the red X, not a structured skip.
 """
 
 from __future__ import annotations
@@ -141,7 +145,44 @@ def _record_lastgood(payload: dict, platform: str, rt_ms: float) -> None:
         _log(f"could not write {LASTGOOD_PATH}: {e}")
 
 
+def _dryrun(argv) -> int:
+    """CI smoke lane: no probe, no accelerator — run the inner bench's
+    --dryrun (build + execute the fused program on CPU) in a bounded
+    subprocess and relay its JSON line.  A collection/trace regression
+    in the fused-step stack fails this in seconds."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    cmd = [sys.executable, "-m", "gan_deeplearning4j_tpu.bench"] + argv
+    try:
+        out = subprocess.run(cmd, cwd=REPO, capture_output=True, text=True,
+                             timeout=600, env=env)
+    except subprocess.TimeoutExpired:
+        # a hung build is exactly what this lane guards — red X, not a
+        # structured skip
+        print(json.dumps({"metric": "dcgan_mnist_img_per_sec",
+                          "dryrun": True, "ok": False,
+                          "reason": "dryrun exceeded 600s"}))
+        return 1
+    for line in out.stderr.strip().splitlines()[-10:]:
+        _log(f"inner! {line}")
+    if out.returncode != 0:
+        print(json.dumps({"metric": "dcgan_mnist_img_per_sec",
+                          "dryrun": True, "ok": False,
+                          "reason": out.stderr.strip()[-500:]}))
+        return 1  # the ONE mode where a failure should fail the caller
+    line = out.stdout.strip().splitlines()[-1]
+    print(line)
+    try:
+        ok = bool(json.loads(line).get("ok"))
+    except ValueError:
+        ok = False
+    # the smoke can fail WITHOUT crashing (ok:false, e.g. NaN losses) —
+    # CI keys on the exit code, so ok:false must be red too
+    return 0 if ok else 1
+
+
 def _main_inner(argv) -> int:
+    if "--dryrun" in argv:
+        return _dryrun(argv)
     try:
         platform, rt_ms = probe_with_retry()
     except RuntimeError as e:
@@ -193,6 +234,14 @@ def main(argv=None) -> int:
     try:
         return _main_inner(argv)
     except Exception as e:  # the contract: one JSON line, exit 0, ALWAYS
+        if "--dryrun" in argv:
+            # ...except the CI smoke lane, which must go red on ANY
+            # failure (module docstring) — a swallowed exception here
+            # would green-light exactly what the lane guards against
+            print(json.dumps({"metric": "dcgan_mnist_img_per_sec",
+                              "dryrun": True, "ok": False,
+                              "reason": f"shim error: {e!r}"}))
+            return 1
         try:
             return _skip(f"unexpected shim error: {e!r}")
         except Exception:

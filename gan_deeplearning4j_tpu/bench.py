@@ -201,12 +201,18 @@ def protocol_step_time(device, want_flops: bool = False,
 def protocol_multistep_time(device, k: Optional[int] = None,
                             repeats: int = REPEATS,
                             want_flops: bool = False,
-                            batch: Optional[int] = None):
+                            batch: Optional[int] = None,
+                            telemetry: bool = False):
     """Seconds per protocol step when ONE dispatch advances ``k`` steps
     (lax.scan inside the program, device-resident data — the trainer's
     steps_per_call fast path).  Removes the per-dispatch latency bound
     that protocol_step_time includes; the gap between the two numbers IS
-    the dispatch overhead."""
+    the dispatch overhead.
+
+    ``telemetry``: measure the program WITH the in-graph numerics block
+    (norms/NaN counters, train/fused_step.py) — the stacked telemetry
+    outputs stay on device (only a loss fences each window), so this
+    times exactly what a telemetry-on trainer dispatches."""
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -229,8 +235,13 @@ def protocol_multistep_time(device, k: Optional[int] = None,
             dis, gen, gan, classifier,
             M.DIS_TO_GAN, M.GAN_TO_GEN, M.DIS_TO_CLASSIFIER,
             z_size=2, num_features=784,
-            data_on_device=True, steps_per_call=k,
+            data_on_device=True, steps_per_call=k, telemetry=telemetry,
         )
+
+        def run_step(state, *args):
+            out = step(state, *args)
+            # telemetry rides as ((losses), tel); only losses are fenced
+            return (out[0], out[1][0]) if telemetry else out
         state = jax.device_put(  # committed: keep one signature across calls
             fused.state_from_graphs(dis, gen, gan, classifier), device)
         table = jax.device_put(
@@ -259,7 +270,7 @@ def protocol_multistep_time(device, k: Optional[int] = None,
             except Exception:
                 flops = None
 
-        state, losses = step(state, table, labels, *inv)  # compile
+        state, losses = run_step(state, table, labels, *inv)  # compile
         _fence(losses)
 
         def window(n_calls):
@@ -267,7 +278,7 @@ def protocol_multistep_time(device, k: Optional[int] = None,
             t0 = time.perf_counter()
             losses = None
             for _ in range(n_calls):
-                state, losses = step(state, table, labels, *inv)
+                state, losses = run_step(state, table, labels, *inv)
             _fence(losses)
             return time.perf_counter() - t0
 
@@ -341,12 +352,17 @@ def celeba_multistep_time(device, batch: int = 128, k: int = 20,
         return statistics.median(slopes), flops
 
 
-def e2e_img_per_sec(res_path: str, data_on_device=None) -> float:
+def e2e_img_per_sec(res_path: str, data_on_device=None,
+                    telemetry: bool = False, detail: bool = False):
     """Protocol throughput through the REAL trainer loop on the default
     device (steady-state wall clock, excluding the compile step).
     ``data_on_device`` None = the trainer's default (device-resident
     dataset); False = force the streaming CSV/prefetch/transfer path.
-    ``res_path`` holds the dataset CSVs, shared between measurements."""
+    ``res_path`` holds the dataset CSVs, shared between measurements.
+    ``telemetry``: run the trainer with the in-graph numerics block on.
+    ``detail``: return ``(img_per_sec, {"goodput": ..., "run_id": ...})``
+    — the run's phase breakdown and manifest id — instead of the bare
+    float."""
     from gan_deeplearning4j_tpu.train import cv_main
     from gan_deeplearning4j_tpu.train.gan_trainer import GANTrainer
 
@@ -354,12 +370,44 @@ def e2e_img_per_sec(res_path: str, data_on_device=None) -> float:
     config = cv_main.default_config(
         num_iterations=E2E_STEPS, batch_size=BATCH, res_path=res_path,
         print_every=10 ** 9, save_every=10 ** 9, metrics=False,
-        data_on_device=data_on_device,
+        data_on_device=data_on_device, telemetry=telemetry,
     )
     trainer = GANTrainer(
         cv_main.CVWorkload(n_train=n_train, n_test=BATCH), config)
     result = trainer.train(log=lambda s: None)
-    return float(result["examples_per_sec"])
+    value = float(result["examples_per_sec"])
+    if detail:
+        return value, {"goodput": result["goodput"],
+                       "run_id": result["run_id"]}
+    return value
+
+
+def dryrun(telemetry: bool = True) -> dict:
+    """CI smoke: build and execute the fused protocol program — single
+    step AND a 2-step scanned multistep, telemetry on — at a toy batch
+    on whatever the default platform is (CPU in CI).  Catches exactly
+    the class of regression that has bitten before: an import/trace
+    error that breaks every consumer of the fused step without any
+    benchmark running.  No probe, no baseline, seconds not minutes."""
+    global BATCH
+    prev_batch, BATCH = BATCH, 8
+    try:
+        import math
+
+        import jax
+
+        device = jax.devices()[0]
+        step, state, real, labels, inv = _build_step_and_args(device)
+        state, losses = step(state, real, labels, *inv)
+        ok = all(math.isfinite(float(l)) for l in losses)
+        t = protocol_multistep_time(device, k=2, repeats=1,
+                                    telemetry=telemetry)
+        return {"metric": "dcgan_mnist_img_per_sec", "dryrun": True,
+                "ok": bool(ok and math.isfinite(t)),
+                "platform": device.platform,
+                "telemetry": telemetry}
+    finally:
+        BATCH = prev_batch
 
 
 def main(argv=None) -> None:
@@ -367,6 +415,22 @@ def main(argv=None) -> None:
     p.add_argument("--profile", default=None, metavar="DIR",
                    help="capture a jax.profiler trace of the timed steps")
     p.add_argument("--skip-e2e", action="store_true")
+    p.add_argument("--dryrun", action="store_true",
+                   help="CI smoke: build + execute the fused program "
+                        "(single and 2-step scanned, telemetry on) at a "
+                        "toy batch and print one JSON line — no probe, "
+                        "no measurement")
+    tele = p.add_mutually_exclusive_group()
+    tele.add_argument("--telemetry", dest="telemetry", action="store_true",
+                      default=True,
+                      help="measure the multistep/e2e paths WITH the "
+                           "in-graph numerics telemetry block (default: "
+                           "on — it rides the same dispatch; the <2%% "
+                           "budget is part of the published number)")
+    tele.add_argument("--no-telemetry", dest="telemetry",
+                      action="store_false",
+                      help="measure without the telemetry block (the "
+                           "A/B baseline for its cost)")
     p.add_argument("--batch", type=int, default=200,
                    help="global batch (default: the reference's 200; the "
                         "CPU-baseline ratio is only reported at 200, "
@@ -401,6 +465,10 @@ def main(argv=None) -> None:
                    help="CelebA block batch (default: the roadmap "
                         "trainer's 128)")
     args = p.parse_args(argv)
+
+    if args.dryrun:
+        print(json.dumps(dryrun(telemetry=args.telemetry)))
+        return
 
     # idempotent (not latch-on): repeated in-process main() calls — the
     # A/B measurement pattern — must reset state for the baseline run
@@ -459,7 +527,8 @@ def main(argv=None) -> None:
         else:
             step_s, flops = protocol_step_time(default, want_flops=True)
             value = BATCH / step_s
-            multi_s = protocol_multistep_time(default)
+            multi_s = protocol_multistep_time(
+                default, telemetry=args.telemetry)
 
     # v6: the headline is the multistep (trainer-default) path; the
     # single-dispatch rate is tunnel-load-dependent and secondary
@@ -477,6 +546,10 @@ def main(argv=None) -> None:
         "compute_bf16": bool(backend.config().compute_bf16
                              and default.platform != "cpu"),
         "conv_s2d": backend.conv_s2d_enabled(),
+        # whether the in-graph numerics block rode the measured programs
+        # (the e2e blocks honor it on every platform; the CPU headline
+        # itself comes from the cached telemetry-free baseline)
+        "telemetry": bool(args.telemetry),
     }
     if baseline:
         out["vs_baseline"] = round(headline / baseline, 3)
@@ -507,7 +580,7 @@ def main(argv=None) -> None:
         try:
             fast_s, fast_flops = protocol_multistep_time(
                 default, repeats=REPEATS, want_flops=True,
-                batch=FAST_BATCH)
+                batch=FAST_BATCH, telemetry=args.telemetry)
             fast = {
                 "batch": FAST_BATCH,
                 "multistep_img_per_sec": round(FAST_BATCH / fast_s, 2),
@@ -552,9 +625,17 @@ def main(argv=None) -> None:
                     compute_bf16=prev.compute_bf16)
     if not args.skip_e2e:
         with tempfile.TemporaryDirectory() as tmp:
-            out["e2e_img_per_sec"] = round(e2e_img_per_sec(tmp), 2)
+            e2e, e2e_detail = e2e_img_per_sec(
+                tmp, telemetry=args.telemetry, detail=True)
+            out["e2e_img_per_sec"] = round(e2e, 2)
+            # the run's goodput ledger + manifest id: every second of
+            # the e2e window attributed, and the number traceable to the
+            # exact config/versions run_manifest.json recorded
+            out["e2e_goodput"] = e2e_detail["goodput"]
+            out["e2e_run_id"] = e2e_detail["run_id"]
             out["e2e_stream_img_per_sec"] = round(
-                e2e_img_per_sec(tmp, data_on_device=False), 2)
+                e2e_img_per_sec(tmp, data_on_device=False,
+                                telemetry=args.telemetry), 2)
         if default.platform != "cpu":
             # host->device link bandwidth at measurement time: the
             # streaming path's sensitivity axis.  With the r5 dedup tier

@@ -1,0 +1,33 @@
+"""JAX version-compat shims.
+
+The package targets the modern JAX surface (pyproject pins >= 0.7 where
+``jax.shard_map`` is top-level and takes ``check_vma``), but the baked-in
+toolchain of some hosts carries an older jax whose only spelling is
+``jax.experimental.shard_map.shard_map(check_rep=...)``.  Importing
+``shard_map`` from here instead of ``jax`` keeps every call site on the
+new-style API on both: the wrapper translates the ``check_vma`` keyword
+to ``check_rep`` when the experimental fallback is what's available.
+"""
+
+from __future__ import annotations
+
+try:  # jax >= 0.7: the supported top-level export
+    from jax import shard_map as _shard_map
+
+    _TRANSLATE_CHECK_VMA = False
+except ImportError:  # older jax: the experimental spelling
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    _TRANSLATE_CHECK_VMA = True
+
+
+def shard_map(f, /, **kwargs):
+    """``jax.shard_map`` with new-style keywords on any supported jax.
+
+    Call sites pass ``mesh=``, ``in_specs=``, ``out_specs=`` and
+    (optionally) ``check_vma=`` exactly as with jax >= 0.7; on an older
+    jax the keyword is renamed to its ``check_rep`` predecessor (same
+    semantics: disable the replication-consistency check)."""
+    if _TRANSLATE_CHECK_VMA and "check_vma" in kwargs:
+        kwargs["check_rep"] = kwargs.pop("check_vma")
+    return _shard_map(f, **kwargs)

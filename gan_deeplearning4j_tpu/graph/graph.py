@@ -358,11 +358,15 @@ class ComputationGraph:
         return total
 
     def _train_step(self, params, opt_state, rng, inputs, labels, reduce=None,
-                    axis_name=None):
+                    axis_name=None, telemetry=False):
         """One optimization step.  ``reduce`` is the cross-replica hook the
         distributed layer injects (pmean of loss/BN-stats/grads inside
         shard_map) so single-device and DP steps share one source of truth;
-        ``axis_name`` additionally makes BN use global-batch stats (sync-BN)."""
+        ``axis_name`` additionally makes BN use global-batch stats (sync-BN).
+        ``telemetry`` adds a fourth return: the in-graph numerics block
+        (grad/param norms, update ratio, NaN/Inf count —
+        telemetry/ingraph.py), computed from the reduced grads so its
+        values are replica-identical under a mesh."""
         def loss_fn(p):
             values, state_updates = self._forward(p, inputs, True, rng, axis_name)
             outputs = {n: values[n] for n in self.output_names}
@@ -376,6 +380,11 @@ class ComputationGraph:
             merged = dict(new_params[lname])
             merged.update(upd)
             new_params[lname] = merged
+        if telemetry:
+            from gan_deeplearning4j_tpu.telemetry import ingraph
+
+            tel = ingraph.graph_telemetry(params, new_params, grads, loss)
+            return new_params, new_opt_state, loss, tel
         return new_params, new_opt_state, loss
 
     def _score(self, params, inputs, labels):

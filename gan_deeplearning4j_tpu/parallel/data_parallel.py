@@ -60,7 +60,7 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 from jax import lax
-from jax import shard_map
+from gan_deeplearning4j_tpu.compat.jaxver import shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from gan_deeplearning4j_tpu.graph.graph import ComputationGraph

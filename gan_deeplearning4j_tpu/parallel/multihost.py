@@ -38,6 +38,31 @@ def initialize(
     )
 
 
+def aggregate_goodput(report: Dict[str, float]) -> Dict[str, float]:
+    """Cross-host goodput aggregation: MEAN of every numeric phase over
+    all processes (each host times its own training thread; the fleet
+    breakdown is their average — a straggler shows up as everyone
+    else's readback/other inflation, which is exactly the signal).
+
+    Single process (this environment, and any test rig): passthrough,
+    no device contact at all — the same no-op discipline as
+    ``initialize``.  Multi-process: one ``process_allgather`` (the
+    standard allreduce helper) carries the few floats over DCN."""
+    if jax.process_count() == 1:
+        return report
+    from jax.experimental import multihost_utils
+
+    keys = sorted(k for k, v in report.items()
+                  if isinstance(v, (int, float)))
+    vals = np.asarray([float(report[k]) for k in keys], np.float32)
+    gathered = multihost_utils.process_allgather(vals)  # [n_proc, len]
+    mean = np.asarray(gathered).reshape(-1, len(keys)).mean(axis=0)
+    out = dict(report)
+    out.update({k: round(float(m), 6) for k, m in zip(keys, mean)})
+    out["aggregated_processes"] = jax.process_count()
+    return out
+
+
 def hybrid_mesh(ici_shape: Dict[str, int], dcn_axis: str,
                 num_slices: Optional[int] = None) -> Mesh:
     """Mesh for multi-slice TPU jobs: ``dcn_axis`` spans slices (hosts),

@@ -33,7 +33,9 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax import lax, shard_map
+from jax import lax
+
+from gan_deeplearning4j_tpu.compat.jaxver import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
 

@@ -28,7 +28,9 @@ from __future__ import annotations
 from functools import partial
 
 import jax
-from jax import lax, shard_map
+from jax import lax
+
+from gan_deeplearning4j_tpu.compat.jaxver import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
 from gan_deeplearning4j_tpu.parallel.ring_attention import attention

@@ -1,0 +1,31 @@
+"""Numerics + goodput telemetry — the two observability layers every
+serious TPU training stack carries and the reference (slf4j step logs +
+Spark's executor UI, SURVEY.md §5) never had:
+
+* ``telemetry.ingraph`` — model numerics computed INSIDE the compiled
+  step (gradient/param norms, update ratios, NaN/Inf counters), riding
+  the existing dispatch as a few extra scalar outputs.  Zero extra
+  dispatches, zero host syncs: the arrays materialize on the async
+  MetricsLogger worker like the losses do.
+* ``telemetry.goodput`` — host-side phase accounting that attributes
+  every wall-clock second of a run to data-wait / dispatch / readback /
+  checkpoint / eval / other, plus the per-run ``run_manifest.json``
+  (run id, config, versions, mesh) that metrics and bench JSONs
+  reference.
+"""
+
+from gan_deeplearning4j_tpu.telemetry.goodput import (
+    GoodputTimer,
+    write_run_manifest,
+)
+from gan_deeplearning4j_tpu.telemetry.ingraph import (
+    NanAlarm,
+    NanAlarmError,
+    count_nonfinite,
+    graph_telemetry,
+    tree_norm,
+)
+
+__all__ = ["GoodputTimer", "write_run_manifest", "NanAlarm",
+           "NanAlarmError", "count_nonfinite", "graph_telemetry",
+           "tree_norm"]

@@ -1,0 +1,168 @@
+"""Goodput accounting — where every wall-clock second of a run went.
+
+The r3 finding that "bookkeeping halves e2e throughput" was folklore
+reconstructed from before/after benchmarks; this module makes it a
+number that every run emits.  A ``GoodputTimer`` attributes the
+training thread's wall time to named phases:
+
+  data_wait   — blocking on the data pipeline (prefetch/chunk queues,
+                CSV generation, iterator construction)
+  dispatch    — dispatching device programs (includes the XLA compile,
+                which happens inside the first dispatch)
+  readback    — fencing on / reading back device results
+  checkpoint  — checkpoint save/restore
+  eval        — artifact dumps (latent grids, prediction CSVs)
+  other       — everything unattributed (host bookkeeping, logging,
+                the python loop itself)
+
+``other`` is the complement of the attributed phases within total wall
+time, so the breakdown always sums to the measured wall exactly; the
+interesting signal is how small ``dispatch``'s share is (on a tunneled
+PJRT link the device finishes long before the host returns from
+dispatch, so host-side attribution is a LOWER bound on device idleness).
+
+The companion ``write_run_manifest`` emits ``run_manifest.json`` — run
+id, config, jax/libtpu versions, mesh/device topology — so metrics
+JSONLs and bench JSONs can reference the exact software+topology a
+number was measured under.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+import uuid
+from contextlib import contextmanager
+from typing import Dict, Optional
+
+PHASES = ("data_wait", "dispatch", "readback", "checkpoint", "eval")
+
+
+class GoodputTimer:
+    """Accumulating phase timer for one run (one thread — the training
+    thread; the async workers' time is by design NOT goodput-relevant,
+    that is the point of moving work onto them).
+
+    Pure host arithmetic: ``phase()`` costs two perf_counter reads, no
+    device contact ever.  Phases may nest (e.g. a checkpoint that
+    flushes artifacts inside an ``eval`` block): inner phases claim
+    their own time and the outer phase gets the remainder, so no second
+    is double-counted."""
+
+    def __init__(self):
+        self._t0 = time.perf_counter()
+        self._acc: Dict[str, float] = {p: 0.0 for p in PHASES}
+        self._stack = []  # (phase_name, start, inner_time) frames
+
+    @contextmanager
+    def phase(self, name: str):
+        if name not in self._acc:
+            raise ValueError(f"unknown goodput phase {name!r}; "
+                             f"one of {PHASES}")
+        start = time.perf_counter()
+        self._stack.append([name, start, 0.0])
+        try:
+            yield
+        finally:
+            _, _, inner = self._stack.pop()
+            elapsed = time.perf_counter() - start
+            self._acc[name] += elapsed - inner
+            if self._stack:  # credit the whole span to the outer frame's
+                self._stack[-1][2] += elapsed  # inner-time ledger
+
+    def report(self) -> Dict[str, float]:
+        """Breakdown so far: per-phase seconds, ``other`` (unattributed),
+        ``wall_s`` (their exact sum), and ``compute_fraction`` —
+        dispatch share of wall, the headline goodput number."""
+        wall = time.perf_counter() - self._t0
+        phases = {p: round(t, 6) for p, t in self._acc.items()}
+        attributed = sum(phases.values())
+        phases["other"] = round(max(0.0, wall - attributed), 6)
+        return {
+            **phases,
+            "wall_s": round(wall, 6),
+            "compute_fraction": round(
+                phases["dispatch"] / wall if wall > 0 else 0.0, 4),
+        }
+
+
+def versions() -> Dict[str, str]:
+    """jax / jaxlib / libtpu versions actually loaded (libtpu absent on
+    CPU hosts; lookup failures degrade to "unknown", never raise)."""
+    out = {}
+    try:
+        import jax
+
+        out["jax"] = jax.__version__
+    except Exception:
+        out["jax"] = "unknown"
+    try:
+        import jaxlib
+
+        out["jaxlib"] = getattr(jaxlib, "__version__", "unknown")
+    except Exception:
+        out["jaxlib"] = "unknown"
+    try:
+        from importlib import metadata
+
+        for dist in ("libtpu", "libtpu-nightly"):
+            try:
+                out["libtpu"] = metadata.version(dist)
+                break
+            except metadata.PackageNotFoundError:
+                continue
+    except Exception:
+        pass
+    return out
+
+
+def write_run_manifest(res_path: str, config=None, mesh=None,
+                       extra: Optional[Dict] = None) -> Dict:
+    """Write ``res_path/run_manifest.json`` and return its payload
+    (callers key their metrics/bench records on ``run_id``).
+
+    ``config``: a dataclass (asdict-ed) or plain dict; ``mesh``: a
+    jax.sharding.Mesh or None.  Device topology is read from an ALREADY
+    initialized jax backend only — this must never be the call that
+    first touches a possibly-wedged device link."""
+    manifest: Dict = {
+        "run_id": uuid.uuid4().hex[:12],
+        "unix_time": int(time.time()),
+        "versions": versions(),
+    }
+    if config is not None:
+        import dataclasses
+
+        cfg = (dataclasses.asdict(config)
+               if dataclasses.is_dataclass(config) else dict(config))
+        manifest["config"] = {
+            k: v for k, v in cfg.items()
+            if isinstance(v, (int, float, str, bool, type(None)))}
+    if mesh is not None:
+        manifest["mesh"] = {str(k): int(v)
+                            for k, v in dict(mesh.shape).items()}
+    try:
+        import jax
+
+        manifest["process_index"] = jax.process_index()
+        manifest["process_count"] = jax.process_count()
+        dev = jax.devices()[0]
+        manifest["devices"] = {
+            "count": len(jax.devices()),
+            "platform": dev.platform,
+            "kind": getattr(dev, "device_kind", "unknown"),
+        }
+    except Exception:
+        pass  # manifest stays useful without topology
+    if extra:
+        manifest.update(extra)
+    path = os.path.join(res_path, "run_manifest.json")
+    try:
+        os.makedirs(res_path, exist_ok=True)
+        with open(path, "w") as f:
+            json.dump(manifest, f, indent=1)
+        manifest["path"] = path
+    except OSError:
+        pass  # read-only res dir: the in-memory payload still flows
+    return manifest

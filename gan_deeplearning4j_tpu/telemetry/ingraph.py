@@ -1,0 +1,130 @@
+"""In-graph numerics telemetry — the signals that explain a diverging GAN
+run BEFORE the FID collapses.
+
+Everything here is traced into the training program itself: global
+gradient norm, parameter norm and update ratio per trained graph, plus
+NaN/Inf counters over gradients and losses.  The step returns them as a
+small fixed-shape block of device scalars alongside the losses — the
+SAME dispatch, no host round trip; under ``lax.scan`` they stack to
+(K,) arrays exactly like the chunked losses, and the async
+MetricsLogger worker materializes them off the training thread.
+
+Host side, ``NanAlarm`` watches the materialized records and trips on
+the first non-finite step; the trainer decides what a trip means
+(warn / snapshot / abort — train/gan_trainer.py).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+# update_ratio's divide-by-zero guard; in f32 a param norm at this scale
+# is indistinguishable from an all-zero network anyway
+_EPS = 1e-12
+
+
+def tree_norm(tree) -> jax.Array:
+    """Global L2 norm over every array leaf of ``tree`` (f32 scalar).
+
+    Accumulates per-leaf sums of squares in f32 regardless of leaf dtype
+    so a bf16 mixed-precision run reports the same norm (to rounding) as
+    the f32 run."""
+    leaves = [l for l in jax.tree_util.tree_leaves(tree)
+              if hasattr(l, "dtype")]
+    if not leaves:
+        return jnp.zeros((), jnp.float32)
+    total = sum(jnp.sum(jnp.square(l.astype(jnp.float32))) for l in leaves)
+    return jnp.sqrt(total)
+
+
+def count_nonfinite(tree) -> jax.Array:
+    """Total count of non-finite (NaN or +/-Inf) elements over every
+    array leaf of ``tree`` (int32 scalar)."""
+    leaves = [l for l in jax.tree_util.tree_leaves(tree)
+              if hasattr(l, "dtype")]
+    if not leaves:
+        return jnp.zeros((), jnp.int32)
+    return sum(jnp.sum(~jnp.isfinite(l.astype(jnp.float32)))
+               for l in leaves).astype(jnp.int32)
+
+
+def graph_telemetry(params, new_params, grads, loss) -> Dict[str, jax.Array]:
+    """One trained graph's numerics block, computed from values the step
+    already holds (no extra forward/backward work):
+
+    * ``grad_norm``    — global L2 of the (cross-replica reduced) grads
+    * ``param_norm``   — global L2 of the UPDATED parameters
+    * ``update_ratio`` — ||new - old|| / ||old||, the per-step relative
+      weight movement (the classic LR-sanity signal: healthy training
+      sits around 1e-3, ~1 means the optimizer is overwriting the net)
+    * ``nonfinite``    — NaN/Inf count over grads and the loss
+    """
+    param_norm = tree_norm(new_params)
+    old_norm = tree_norm(params)
+    update = jax.tree_util.tree_map(
+        lambda a, b: a.astype(jnp.float32) - b.astype(jnp.float32),
+        new_params, params)
+    return {
+        "grad_norm": tree_norm(grads),
+        "param_norm": param_norm,
+        "update_ratio": tree_norm(update) / (old_norm + _EPS),
+        "nonfinite": count_nonfinite(grads) + count_nonfinite(loss),
+    }
+
+
+class NanAlarmError(RuntimeError):
+    """Raised by the trainer when a ``NanAlarm`` with action="abort"
+    trips (first training step with a non-finite loss/grad)."""
+
+
+class NanAlarm:
+    """First-bad-step detector over materialized metrics records.
+
+    Registered as the MetricsLogger's ``on_record`` hook, so it observes
+    every record on the async worker thread — detection costs the
+    training thread nothing.  A record is "bad" when its ``nonfinite``
+    counter is positive or any telemetry/loss value is itself
+    non-finite.  The first bad record arms ``tripped``/``step``/
+    ``record`` (thread-safely, latched — later records don't overwrite
+    the first occurrence) and fires the optional ``on_trip`` callback
+    once.  The training loop polls ``tripped`` at its bookkeeping
+    points and applies the configured action (warn/snapshot/abort)."""
+
+    # keys whose own non-finiteness (not just nonfinite>0) means trouble
+    _WATCH_SUFFIXES = ("_loss", "_norm", "_ratio")
+
+    def __init__(self, on_trip: Optional[Callable[[Dict], None]] = None):
+        self._lock = threading.Lock()
+        self._on_trip = on_trip
+        self.tripped = False
+        self.step: Optional[int] = None
+        self.record: Optional[Dict] = None
+
+    @staticmethod
+    def _is_bad(rec: Dict) -> bool:
+        import math
+
+        if rec.get("nonfinite", 0):
+            return True
+        for k, v in rec.items():
+            if isinstance(v, float) and not math.isfinite(v) and (
+                    k.endswith(NanAlarm._WATCH_SUFFIXES)):
+                return True
+        return False
+
+    def observe(self, rec: Dict) -> None:
+        """MetricsLogger ``on_record`` hook (worker thread)."""
+        if self.tripped or not self._is_bad(rec):
+            return
+        with self._lock:
+            if self.tripped:  # lost the race to an earlier bad record
+                return
+            self.step = rec.get("step")
+            self.record = rec
+            self.tripped = True
+        if self._on_trip is not None:
+            self._on_trip(rec)

@@ -23,7 +23,9 @@ from typing import Dict, NamedTuple, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
-from jax import lax, shard_map
+from jax import lax
+
+from gan_deeplearning4j_tpu.compat.jaxver import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
 from gan_deeplearning4j_tpu.optim import ema as ema_lib
@@ -88,10 +90,21 @@ def make_protocol_step(
     data_codec: Optional[str] = None,
     codec_chunk_decode: bool = False,
     chunk_indexed: bool = False,
+    telemetry: bool = False,
 ):
     """Build the fused step:
     (state, real, labels, z_key, rng_key, y_real, y_fake, ones) ->
-    (state', (d_loss, g_loss, clf_loss)).
+    (state', (d_loss, g_loss, clf_loss)) — or, with ``telemetry``,
+    (state', ((d_loss, g_loss, clf_loss), telemetry_block)).
+
+    ``telemetry``: compute the in-graph numerics block per step — global
+    grad-norm / param-norm / update-ratio for each trained graph
+    (``d_``/``g_``/``clf_`` prefixes) plus one total NaN/Inf counter
+    over grads and losses (telemetry/ingraph.py).  A dozen extra scalar
+    outputs riding the SAME dispatch: zero additional dispatches, and
+    nothing reads them back on the training thread (the async
+    MetricsLogger worker materializes them with the losses).  Under
+    ``lax.scan`` they stack to (K,) arrays like the chunked losses.
 
     ``steps_per_call`` > 1 wraps the iteration in ``lax.scan`` so ONE
     dispatch advances K steps and returns K-stacked losses — on a
@@ -226,26 +239,31 @@ def make_protocol_step(
         fake = fake_vals[gen.output_names[0]].reshape(B, num_features)
         x = jnp.concatenate([real, fake])
         y_dis = jnp.concatenate([yr, yf])
-        dis_params, dis_opt, d_loss = dis._train_step(
-            state.dis_params, state.dis_opt, prng.stream(rng, "d"),
-            {dis.input_names[0]: x}, {dis.output_names[0]: y_dis},
-            reduce, axis_name)
+
+        def train(graph, params, opt, stream, inputs, targets):
+            # telemetry is traced out entirely when disabled; when on it
+            # rides as a 4th return (graph.py _train_step)
+            out = graph._train_step(params, opt, stream, inputs, targets,
+                                    reduce, axis_name, telemetry=telemetry)
+            return out if telemetry else (*out, None)
+
+        dis_params, dis_opt, d_loss, d_tel = train(
+            dis, state.dis_params, state.dis_opt, prng.stream(rng, "d"),
+            {dis.input_names[0]: x}, {dis.output_names[0]: y_dis})
         # (2) dis -> gan frozen tail: pure aliasing
         gan_params = _apply_sync(state.gan_params, dis_params, dis_to_gan)
         # (3) G-step through the stacked graph
-        gan_params, gan_opt, g_loss = gan._train_step(
-            gan_params, state.gan_opt, prng.stream(rng, "g"),
-            {gan.input_names[0]: z2}, {gan.output_names[0]: on},
-            reduce, axis_name)
+        gan_params, gan_opt, g_loss, g_tel = train(
+            gan, gan_params, state.gan_opt, prng.stream(rng, "g"),
+            {gan.input_names[0]: z2}, {gan.output_names[0]: on})
         # (4) gan generator -> standalone gen
         gen_params = _apply_sync(state.gen_params, gan_params, gan_to_gen)
         # (5) classifier on the labeled real batch
         clf_params = _apply_sync(state.clf_params, dis_params, dis_to_classifier)
-        clf_params, clf_opt, c_loss = classifier._train_step(
-            clf_params, state.clf_opt, prng.stream(rng, "clf"),
+        clf_params, clf_opt, c_loss, c_tel = train(
+            classifier, clf_params, state.clf_opt, prng.stream(rng, "clf"),
             {classifier.input_names[0]: real},
-            {classifier.output_names[0]: labels},
-            reduce, axis_name)
+            {classifier.output_names[0]: labels})
         if ema_decay:
             # one elementwise pass over gen params (~3% of the step);
             # traced out entirely when disabled (shared rule: optim/ema.py)
@@ -256,7 +274,18 @@ def make_protocol_step(
         new_state = ProtocolState(
             dis_params, dis_opt, gan_params, gan_opt,
             clf_params, clf_opt, gen_params, step_idx + 1, ema_gen)
-        return new_state, (d_loss, g_loss, c_loss)
+        losses = (d_loss, g_loss, c_loss)
+        if not telemetry:
+            return new_state, losses
+        # one flat fixed-shape block: per-graph norms/ratios plus a
+        # single total NaN/Inf counter (per-graph counts add no signal —
+        # the alarm only needs "which step went bad")
+        tel = {f"{pfx}_{k}": v
+               for pfx, blk in (("d", d_tel), ("g", g_tel), ("clf", c_tel))
+               for k, v in blk.items() if k != "nonfinite"}
+        tel["nonfinite"] = (d_tel["nonfinite"] + g_tel["nonfinite"]
+                            + c_tel["nonfinite"])
+        return new_state, (losses, tel)
 
     if steps_per_call > 1:
         if not data_on_device:

@@ -31,7 +31,9 @@ from typing import Dict, Optional
 
 import jax
 import jax.numpy as jnp
-from jax import lax, shard_map
+from jax import lax
+
+from gan_deeplearning4j_tpu.compat.jaxver import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
 from gan_deeplearning4j_tpu.graph.graph import ComputationGraph

@@ -129,6 +129,21 @@ class GANTrainerConfig:
     # worker so the device never idles on the ~70ms tunnel round trip.
     # False = the reference's synchronous behavior.
     async_dumps: bool = True
+    # In-graph numerics telemetry (telemetry/ingraph.py): per-step
+    # grad/param norms, update ratios and NaN/Inf counters computed
+    # INSIDE the fused program and logged as extra metrics columns —
+    # zero additional dispatches, no host syncs on the training thread.
+    # Fused path only (the unfused per-fit path has no single program to
+    # ride).
+    telemetry: bool = False
+    # What the first non-finite step does (requires telemetry):
+    #   None       — nothing (the counters still land in the metrics)
+    #   "warn"     — log loudly, keep training
+    #   "snapshot" — save a forensic checkpoint of the current state to
+    #                res_path/nan_snapshot, keep training
+    #   "abort"    — raise NanAlarmError (train_with_recovery treats it
+    #                like any failure: restart from the last checkpoint)
+    nan_alarm: Optional[str] = None
 
 
 class Workload:
@@ -326,9 +341,30 @@ class GANTrainer:
             self._fit_gan = self.spark_gan.fit
             self._fit_clf = self.spark_clf.fit
 
+        if config.nan_alarm not in (None, "warn", "snapshot", "abort"):
+            raise ValueError(
+                f"nan_alarm must be None/'warn'/'snapshot'/'abort', "
+                f"got {config.nan_alarm!r}")
+        if config.nan_alarm and not config.telemetry:
+            raise ValueError(
+                "nan_alarm needs telemetry=True — without the in-graph "
+                "NaN/Inf counters there is nothing to trip on")
+        if config.telemetry and not self._fused_enabled:
+            raise ValueError(
+                "telemetry=True requires the fused step (fused=True, "
+                "dp_mode='gradient_sync') — only the fused program "
+                "computes the in-graph numerics block")
+        self._nan_alarm = None
+        self._nan_handled = False
+        if config.nan_alarm:
+            from gan_deeplearning4j_tpu.telemetry import NanAlarm
+
+            self._nan_alarm = NanAlarm()
         self.metrics = MetricsLogger(
             os.path.join(config.res_path, f"{config.dataset_name}_metrics.jsonl")
-            if config.metrics else None
+            if config.metrics else None,
+            on_record=(self._nan_alarm.observe if self._nan_alarm
+                       else None),
         )
         self.checkpointer = (
             TrainCheckpointer(
@@ -355,6 +391,8 @@ class GANTrainer:
                 f"ema_decay must be in [0, 1), got {config.ema_decay} "
                 "(1.0 would pin the EMA at initialization forever)")
         self.batch_counter = 0
+        self.goodput = None       # GoodputTimer, created per train() run
+        self.run_manifest = None  # run_manifest.json payload, ditto
         self._test_batches = None
         self._steps_per_call = 1
         self._fused_multi = None
@@ -486,12 +524,26 @@ class GANTrainer:
 
     def train(self, log: Callable[[str], None] = print) -> Dict[str, float]:
         c = self.c
-        train_csv, test_csv = self.w.ensure_data(c.res_path)
-        iter_train = RecordReaderDataSetIterator(
-            train_csv, c.batch_size, c.label_index, c.num_classes)
-        iter_test = RecordReaderDataSetIterator(
-            test_csv, c.batch_size_pred, c.label_index, c.num_classes)
-        self._maybe_resume(iter_train)
+        from gan_deeplearning4j_tpu.telemetry import (
+            GoodputTimer,
+            write_run_manifest,
+        )
+
+        # goodput phase accounting covers the WHOLE run from here; the
+        # manifest pins run id + config + software/topology so metrics
+        # and bench records are attributable to an exact setup
+        self.goodput = GoodputTimer()
+        self.run_manifest = write_run_manifest(
+            c.res_path, config=c, mesh=self._mesh,
+            extra={"workload": self.w.name})
+        with self.goodput.phase("data_wait"):
+            train_csv, test_csv = self.w.ensure_data(c.res_path)
+            iter_train = RecordReaderDataSetIterator(
+                train_csv, c.batch_size, c.label_index, c.num_classes)
+            iter_test = RecordReaderDataSetIterator(
+                test_csv, c.batch_size_pred, c.label_index, c.num_classes)
+        with self.goodput.phase("checkpoint"):
+            self._maybe_resume(iter_train)
 
         ones = self._ones
         y_dis = jnp.concatenate([ones + self.soften_real, self.soften_fake])
@@ -526,6 +578,7 @@ class GANTrainer:
                 kw = dict(
                     z_size=c.z_size, num_features=c.num_features,
                     mesh=self._mesh, ema_decay=c.ema_decay,
+                    telemetry=c.telemetry,
                 )
                 graphs = (self.dis, self.gen, self.gan, self.classifier)
                 maps = (self.w.dis_to_gan, self.w.gan_to_gen,
@@ -712,7 +765,8 @@ class GANTrainer:
         # dispatch, not the device; device_fence documents why
         # block_until_ready is not enough here)
         if self._final_losses is not None:
-            device_fence(self._final_losses)
+            with self.goodput.phase("readback"):
+                device_fence(self._final_losses)
         steady = None
         steps_timed = self.batch_counter - self._steady_start_step
         if self._steady_t0 is not None and steps_timed > 0:
@@ -726,17 +780,40 @@ class GANTrainer:
 
         # end-of-run model zips, exactly the reference's four files (:529-533)
         name = c.dataset_name
-        serialization.write_model(
-            self.dis, os.path.join(c.res_path, f"{name}_dis_model.zip"))
-        serialization.write_model(
-            self.gan, os.path.join(c.res_path, f"{name}_gan_model.zip"))
-        serialization.write_model(
-            self.gen, os.path.join(c.res_path, f"{name}_gen_model.zip"))
-        serialization.write_model(
-            self.classifier,
-            os.path.join(c.res_path,
-                         f"{name}_{self.w.classifier_model_name}_model.zip"))
-        self.metrics.flush(wait=True)
+        with self.goodput.phase("checkpoint"):
+            serialization.write_model(
+                self.dis, os.path.join(c.res_path, f"{name}_dis_model.zip"))
+            serialization.write_model(
+                self.gan, os.path.join(c.res_path, f"{name}_gan_model.zip"))
+            serialization.write_model(
+                self.gen, os.path.join(c.res_path, f"{name}_gen_model.zip"))
+            serialization.write_model(
+                self.classifier,
+                os.path.join(
+                    c.res_path,
+                    f"{name}_{self.w.classifier_model_name}_model.zip"))
+        # drain + close the logger FIRST (the final flush's readback of
+        # up to flush_every stacked records is the run's last big device
+        # wait and must be attributed), THEN close the goodput ledger
+        # and write its record — the closed logger materializes it
+        # synchronously, so nothing unattributed remains but that one
+        # host-side JSON write.  close() also joins the async worker:
+        # records() etc. keep working, just synchronously, and a worker
+        # thread never outlives its trainer's run.
+        with self.goodput.phase("readback"):
+            self.metrics.flush(wait=True)
+            self.metrics.close()
+        # multi-process: phase means across hosts, recorded by process 0
+        # only (parallel/multihost.py)
+        from gan_deeplearning4j_tpu.parallel import multihost
+
+        goodput = multihost.aggregate_goodput(self.goodput.report())
+        run_id = (self.run_manifest or {}).get("run_id")
+        if jax.process_index() == 0:
+            self.metrics.log_record(
+                {"goodput": goodput, "run_id": run_id})
+            self.metrics.flush()
+        self._poll_nan_alarm()  # a trip materialized by the final flush
         return {
             "steps": self.batch_counter,
             "examples_per_sec": (
@@ -745,6 +822,8 @@ class GANTrainer:
                 self._steady_t0 is None or steps_timed <= 0),
             "d_loss": float(self.dis.score),
             "g_loss": float(self.gan.score),
+            "run_id": run_id,
+            "goodput": goodput,
         }
 
     def _z(self, i: int, which: int) -> jax.Array:
@@ -854,6 +933,25 @@ class GANTrainer:
                 run = min(run, cad - self.batch_counter % cad)
         return run
 
+    def _unpack(self, out):
+        """Split a fused-step result into (state, losses, telemetry) —
+        telemetry is None unless the config enables it (the program then
+        returns ((losses), tel) in the second slot, fused_step.py)."""
+        state, rest = out
+        if self.c.telemetry:
+            losses, tel = rest
+            return state, losses, tel
+        return state, rest, None
+
+    def _phase(self, name: str):
+        """Goodput phase context, or a no-op outside train() (tests and
+        notebooks may drive the dump/bookkeeping methods directly)."""
+        if self.goodput is not None:
+            return self.goodput.phase(name)
+        from contextlib import nullcontext
+
+        return nullcontext()
+
     def _resident_loop(self, features, labels, iter_test, fused_state,
                        log) -> None:
         """Hot loop of the device-resident data path: batch slicing,
@@ -870,25 +968,30 @@ class GANTrainer:
                 # dispatches per step plus 3 scalar readbacks per step at
                 # metrics flush, host-side work that scales with steps and
                 # (on a tunneled link) dominates no matter how large K is
-                fused_state, (d, g, cl) = self._fused_multi(
-                    fused_state, features, labels, *self._fused_invariants)
+                with self._phase("dispatch"):
+                    out = self._fused_multi(
+                        fused_state, features, labels,
+                        *self._fused_invariants)
+                fused_state, (d, g, cl), tel = self._unpack(out)
                 self._final_state = fused_state
                 self._final_losses = (d[-1], g[-1], cl[-1])
                 self._mark_steady(self._final_losses, steps=run)
-                self._chunk_bookkeeping(iter_test, d, g, cl, run, log)
+                self._chunk_bookkeeping(iter_test, d, g, cl, run, log, tel)
             else:
                 per_step = []
                 for _ in range(run):
-                    fused_state, losses = self._fused_step(
-                        fused_state, features, labels,
-                        *self._fused_invariants)
-                    per_step.append(losses)
+                    with self._phase("dispatch"):
+                        out = self._fused_step(
+                            fused_state, features, labels,
+                            *self._fused_invariants)
+                    fused_state, losses, tel = self._unpack(out)
+                    per_step.append((losses, tel))
                 self._final_state = fused_state
-                self._mark_steady(per_step[-1], steps=len(per_step))
-                for d_loss, g_loss, c_loss in per_step:
+                self._mark_steady(per_step[-1][0], steps=len(per_step))
+                for (d_loss, g_loss, c_loss), tel in per_step:
                     self._final_losses = (d_loss, g_loss, c_loss)
                     self._step_bookkeeping(iter_test, d_loss, g_loss,
-                                           c_loss, log)
+                                           c_loss, log, tel)
 
     def _chunked_stream_loop(self, chunks, iter_test, fused_state,
                              log) -> None:
@@ -913,15 +1016,18 @@ class GANTrainer:
                 # plain: (features, labels); dedup: (feature table,
                 # label table, row-index schedule) — the chunk_indexed
                 # program takes the extra argument in this position
-                chunk = next(chunks)
+                with self._phase("data_wait"):
+                    chunk = next(chunks)
             except StopIteration:  # dataset empty even after reset
                 break
-            fused_state, (d, g, cl) = self._fused_multi(
-                fused_state, *chunk, *self._fused_invariants)
+            with self._phase("dispatch"):
+                out = self._fused_multi(
+                    fused_state, *chunk, *self._fused_invariants)
+            fused_state, (d, g, cl), tel = self._unpack(out)
             self._final_state = fused_state
             self._final_losses = (d[-1], g[-1], cl[-1])
             self._mark_steady(self._final_losses, steps=run)
-            self._chunk_bookkeeping(iter_test, d, g, cl, run, log)
+            self._chunk_bookkeeping(iter_test, d, g, cl, run, log, tel)
 
     def _mark_steady(self, loss, steps: int = 1) -> None:
         """After the FIRST step/chunk of a run (the one that pays the XLA
@@ -932,7 +1038,10 @@ class GANTrainer:
         window — fencing mid-chunk would credit already-finished steps to
         the window and overstate throughput)."""
         if self._steady_t0 is None:
-            device_fence(loss)
+            # goodput: this first fence waits out the XLA compile plus
+            # the first chunk's compute — the run's one big readback
+            with self._phase("readback"):
+                device_fence(loss)
             self._steady_t0 = time.perf_counter()
             self._steady_start_step = self.batch_counter + steps
 
@@ -943,7 +1052,8 @@ class GANTrainer:
         self._final_state, self._final_losses = fused_state, None
         while self.batch_counter < c.num_iterations:
             try:
-                features, labels = next(prefetch)
+                with self._phase("data_wait"):
+                    features, labels = next(prefetch)
             except StopIteration:   # dataset empty even after reset
                 break
             if features.shape[0] < B:  # partial epoch tail: wrap like :524
@@ -951,44 +1061,57 @@ class GANTrainer:
             real = jnp.asarray(features)
             labels = jnp.asarray(labels)
 
+            tel = None
             if self._fused_step is not None:
                 # the whole iteration — D-step, syncs, G-step, classifier,
                 # latent draws, step-counter bump — is one donated-state
                 # XLA program; the only per-step host work is this dispatch
-                fused_state, (d_loss, g_loss, c_loss) = self._fused_step(
-                    fused_state, real, labels, *self._fused_invariants)
+                with self._phase("dispatch"):
+                    out = self._fused_step(
+                        fused_state, real, labels, *self._fused_invariants)
+                fused_state, (d_loss, g_loss, c_loss), tel = \
+                    self._unpack(out)
                 self._final_state = fused_state
                 self._final_losses = (d_loss, g_loss, c_loss)
                 self._mark_steady(d_loss)
             else:
-                # (1) D-step on [real(1+eps), fake(0+eps)]
-                z = self._z(self.batch_counter, 0)
-                fake = self.gen.output(z)[0].reshape(B, c.num_features)
-                d_loss = self._fit_dis(jnp.concatenate([real, fake]), y_dis)
+                with self._phase("dispatch"):
+                    # (1) D-step on [real(1+eps), fake(0+eps)]
+                    z = self._z(self.batch_counter, 0)
+                    fake = self.gen.output(z)[0].reshape(B, c.num_features)
+                    d_loss = self._fit_dis(
+                        jnp.concatenate([real, fake]), y_dis)
 
-                # (2) dis -> gan frozen tail (weights + BN running stats)
-                sync_params(self.gan, self.dis, self.w.dis_to_gan)
+                    # (2) dis -> gan frozen tail (weights + BN stats)
+                    sync_params(self.gan, self.dis, self.w.dis_to_gan)
 
-                # (3) G-step: fool the frozen discriminator
-                z = self._z(self.batch_counter, 1)
-                g_loss = self._fit_gan(z, ones)
+                    # (3) G-step: fool the frozen discriminator
+                    z = self._z(self.batch_counter, 1)
+                    g_loss = self._fit_gan(z, ones)
 
-                # (4) gan generator -> standalone gen
-                sync_params(self.gen, self.gan, self.w.gan_to_gen)
+                    # (4) gan generator -> standalone gen
+                    sync_params(self.gen, self.gan, self.w.gan_to_gen)
 
-                # (5) classifier: dis features, fit on the real labeled batch
-                sync_params(self.classifier, self.dis, self.w.dis_to_classifier)
-                c_loss = self._fit_clf(real, labels)
+                    # (5) classifier: dis features, fit on the real
+                    # labeled batch
+                    sync_params(self.classifier, self.dis,
+                                self.w.dis_to_classifier)
+                    c_loss = self._fit_clf(real, labels)
                 self._final_losses = (d_loss, g_loss, c_loss)
                 self._mark_steady(c_loss)
 
-            self._step_bookkeeping(iter_test, d_loss, g_loss, c_loss, log)
+            self._step_bookkeeping(iter_test, d_loss, g_loss, c_loss, log,
+                                   tel)
 
-    def _chunk_bookkeeping(self, iter_test, d, g, cl, n, log) -> None:
+    def _chunk_bookkeeping(self, iter_test, d, g, cl, n, log,
+                           tel=None) -> None:
         """Bookkeeping for one multi-step dispatch: ONE chunk metrics
         record holding the stacked (n,) loss arrays, then cadence
         triggers — which by construction (_resolve_steps_per_call /
-        _next_chunk) can only fire at the chunk end."""
+        _next_chunk) can only fire at the chunk end.  ``tel``: the
+        telemetry block of stacked (n,) device arrays, logged as extra
+        columns of the same record (no readback here — the async worker
+        materializes them with the losses)."""
         c = self.c
         start = self.batch_counter
         self.batch_counter += n
@@ -998,18 +1121,21 @@ class GANTrainer:
         # The run-level number comes from the fenced steady window.
         self.metrics.log_chunk(
             start + 1, n, 0,
-            {"d_loss": d, "g_loss": g, "classifier_loss": cl})
+            {"d_loss": d, "g_loss": g, "classifier_loss": cl,
+             **(tel or {})})
         for s in range(start - start % 100 + 100, self.batch_counter + 1,
                        100):
             log(f"Completed Batch {s}!")
         self._boundary_bookkeeping(iter_test)
 
-    def _step_bookkeeping(self, iter_test, d_loss, g_loss, c_loss, log) -> None:
+    def _step_bookkeeping(self, iter_test, d_loss, g_loss, c_loss, log,
+                          tel=None) -> None:
         c = self.c
         self.batch_counter += 1
         self.metrics.log_step(
             self.batch_counter, examples=c.batch_size,
             d_loss=d_loss, g_loss=g_loss, classifier_loss=c_loss,
+            **(tel or {}),
         )
         if self.batch_counter % 100 == 0:
             log(f"Completed Batch {self.batch_counter}!")
@@ -1030,8 +1156,48 @@ class GANTrainer:
                 self.classifier)
 
         if self.batch_counter % c.print_every == 0:
-            self._dump_grid()
+            with self._phase("eval"):
+                self._dump_grid()
         if self.batch_counter % c.save_every == 0:
-            self._dump_predictions(iter_test)
+            with self._phase("eval"):
+                self._dump_predictions(iter_test)
         if c.checkpoint_every:
-            self._maybe_checkpoint()
+            with self._phase("checkpoint"):
+                self._maybe_checkpoint()
+        self._poll_nan_alarm()
+
+    def _poll_nan_alarm(self) -> None:
+        """Apply the configured nan_alarm action once the async worker
+        has observed a bad record.  Detection granularity is the metrics
+        flush cadence (flush_every steps, or one chunk on the chunked
+        paths) — the hot path never reads telemetry back, and no flush
+        is forced here: a per-poll flush would degrade the logger to
+        one-record batches, re-paying the per-step readback cost the
+        batching exists to amortize."""
+        alarm = self._nan_alarm
+        if alarm is None or self._nan_handled or not alarm.tripped:
+            return
+        self._nan_handled = True
+        run_id = (self.run_manifest or {}).get("run_id", "?")
+        msg = (f"NaN alarm: first non-finite telemetry at step "
+               f"{alarm.step} (run {run_id})")
+        if self.c.nan_alarm == "abort":
+            from gan_deeplearning4j_tpu.telemetry import NanAlarmError
+
+            raise NanAlarmError(msg)
+        import logging
+
+        logging.getLogger(__name__).warning("%s", msg)
+        if self.c.nan_alarm == "snapshot" and self._final_state is not None:
+            # forensic snapshot of the state as of the LAST dispatched
+            # step — the weights/optimizer state a post-mortem wants
+            from gan_deeplearning4j_tpu.checkpoint import TrainCheckpointer
+
+            with self._phase("checkpoint"):
+                if self._fused_step is not None:
+                    self._fused_lib.state_to_graphs(
+                        self._final_state, self.dis, self.gen, self.gan,
+                        self.classifier)
+                TrainCheckpointer(
+                    os.path.join(self.c.res_path, "nan_snapshot"),
+                    keep=1).save(self.batch_counter, self._graphs())

@@ -110,6 +110,21 @@ def main(argv=None) -> Dict[str, float]:
     p.add_argument("--live-ui", type=int, default=0, metavar="PORT",
                    help="serve a live loss dashboard over the metrics "
                         "JSONL on this port (the Spark-web-UI analog)")
+    p.add_argument("--telemetry", action="store_true",
+                   help="in-graph numerics telemetry: per-step grad/param "
+                        "norms, update ratios and NaN/Inf counters "
+                        "computed inside the fused program and logged as "
+                        "metrics columns (zero extra dispatches); the run "
+                        "also always writes res-path/run_manifest.json "
+                        "and a goodput phase breakdown")
+    p.add_argument("--nan-alarm", default=None,
+                   choices=["warn", "snapshot", "abort"],
+                   help="action on the first non-finite step (needs "
+                        "--telemetry): warn = log and continue; snapshot "
+                        "= save a forensic checkpoint to "
+                        "res-path/nan_snapshot and continue; abort = "
+                        "raise (combines with --max-restarts for "
+                        "restart-from-last-checkpoint)")
     from gan_deeplearning4j_tpu.runtime import backend
 
     backend.add_bf16_flag(p)
@@ -136,6 +151,8 @@ def main(argv=None) -> Dict[str, float]:
         steps_per_call=args.steps_per_call,
         async_dumps=not args.sync_dumps,
         seed=args.seed,
+        telemetry=args.telemetry,
+        nan_alarm=args.nan_alarm,
     )
     from gan_deeplearning4j_tpu.utils import maybe_trace
 

@@ -139,16 +139,32 @@ def train(family: str, iterations: int, batch_size: int, res_path: str,
           steps_per_call: int = None, lr_decay_steps: int = None,
           ms_weight: float = 0.0,
           fidelity_steps: int = 400, log=print) -> Dict[str, float]:
+    from gan_deeplearning4j_tpu.telemetry import (
+        GoodputTimer,
+        write_run_manifest,
+    )
+
     os.makedirs(res_path, exist_ok=True)
     mesh = None
     if n_devices and n_devices > 1:
         from gan_deeplearning4j_tpu.parallel import data_mesh
 
         mesh = data_mesh(n_devices)
+    # goodput + manifest: same run-attribution ledger as the protocol
+    # trainer (telemetry/goodput.py) — the GANPair loop's wall seconds
+    # land in the same phase vocabulary
+    goodput = GoodputTimer()
+    manifest = write_run_manifest(
+        res_path, config={"family": family, "iterations": iterations,
+                          "batch_size": batch_size, "n_train": n_train,
+                          "ema_decay": ema_decay,
+                          "steps_per_call": steps_per_call},
+        mesh=mesh, extra={"workload": family})
     # data first: a real --data-dir can dictate the class count the
     # conditional model's label input must match
-    x, y = _data(family, n_train, prng.NUMBER_OF_THE_BEAST,
-                 SAMPLE_SHAPES[family], data_dir)
+    with goodput.phase("data_wait"):
+        x, y = _data(family, n_train, prng.NUMBER_OF_THE_BEAST,
+                     SAMPLE_SHAPES[family], data_dir)
     n_train = x.shape[0]
     pair, cfg, sample_shape = _build(
         family, mesh, num_classes=None if y is None else y.shape[1],
@@ -265,9 +281,11 @@ def train(family: str, iterations: int, batch_size: int, res_path: str,
             seed_key=z_key, ema_decay=ema_decay, start_step=start_it)
         it = start_it
         while it < iterations:
-            state, (dl, gl) = step_fn(state)
+            with goodput.phase("dispatch"):
+                state, (dl, gl) = step_fn(state)
             if steady_t0 is None:
-                device_fence((dl, gl))
+                with goodput.phase("readback"):
+                    device_fence((dl, gl))
                 steady_t0 = time.perf_counter()
                 steady_start = it + K
             # per-step LOSSES are real; per-step wall-clock is not (K
@@ -284,12 +302,14 @@ def train(family: str, iterations: int, batch_size: int, res_path: str,
                     f"g={float(g_loss):.4f}")
             if it % print_every == 0 or it >= iterations:
                 pair.adopt_state(state)
-                dump_samples(it)
+                with goodput.phase("eval"):
+                    dump_samples(it)
             if ckpt is not None and checkpoint_every \
                     and it % checkpoint_every == 0:
                 pair.adopt_state(state)
-                dumper.flush()  # pending artifacts land before the ckpt
-                save_ckpt(it)
+                with goodput.phase("checkpoint"):
+                    dumper.flush()  # pending artifacts land first
+                    save_ckpt(it)
         pair.adopt_state(state)
         iterations = it
         if getattr(pair.gen, "ema_params", None) is not None:
@@ -301,10 +321,19 @@ def train(family: str, iterations: int, batch_size: int, res_path: str,
             finally:
                 pair.gen.params = orig
 
-    device_fence((d_loss, g_loss))
+    with goodput.phase("readback"):
+        device_fence((d_loss, g_loss))
     steps_timed = iterations - steady_start if steady_t0 is not None else 0
     wall = (time.perf_counter() - steady_t0) if steady_t0 is not None else 0.0
-    metrics.flush(wait=True)
+    # drain the logger before closing the ledger (the final flush's
+    # readback belongs in the breakdown); the closed logger then writes
+    # the goodput record synchronously
+    with goodput.phase("readback"):
+        metrics.flush(wait=True)
+        metrics.close()
+    gp = goodput.report()
+    metrics.log_record({"goodput": gp, "run_id": manifest["run_id"]})
+    metrics.flush()
     for name, graph in (("gen", pair.gen), ("dis", pair.dis)):
         serialization.write_model(
             graph, os.path.join(res_path, f"{family}_{name}_model.zip"))
@@ -327,6 +356,8 @@ def train(family: str, iterations: int, batch_size: int, res_path: str,
         "examples_per_sec": (
             steps_timed * batch_size * (n_critic + 1) / wall
             if steps_timed > 0 else 0.0),
+        "run_id": manifest["run_id"],
+        "goodput": gp,
     }
     if y is not None and fidelity_steps > 0:
         # conditional fidelity (VERDICT r3 weak-#3's falsifiable gate):

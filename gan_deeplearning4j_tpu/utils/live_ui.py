@@ -31,18 +31,27 @@ _PAGE = """<!doctype html>
 <style>
  body { font-family: system-ui, sans-serif; margin: 24px; }
  #meta { color: #555; margin-bottom: 12px; }
- canvas { border: 1px solid #ccc; width: 100%; height: 360px; }
+ #alarm { display: none; background: #c62828; color: #fff;
+          padding: 8px 12px; margin-bottom: 12px; border-radius: 4px; }
+ canvas { border: 1px solid #ccc; width: 100%; height: 300px; }
+ h3 { margin: 18px 0 4px; }
  .key { display: inline-block; margin-right: 16px; }
  .swatch { display: inline-block; width: 12px; height: 12px;
            margin-right: 4px; vertical-align: middle; }
 </style></head>
 <body>
 <h2>gan4j live metrics</h2>
+<div id="alarm"></div>
 <div id="meta">waiting for data&hellip;</div>
-<div id="legend"></div>
-<canvas id="chart" width="1200" height="360"></canvas>
+<h3>losses</h3>
+<div id="legend-loss"></div>
+<canvas id="chart-loss" width="1200" height="300"></canvas>
+<h3>numerics telemetry (grad/param norms, update ratios — log scale)</h3>
+<div id="legend-tel"></div>
+<canvas id="chart-tel" width="1200" height="300"></canvas>
 <script>
-const COLORS = ["#1f77b4", "#d62728", "#2ca02c", "#9467bd", "#8c564b"];
+const COLORS = ["#1f77b4", "#d62728", "#2ca02c", "#9467bd", "#8c564b",
+                "#e377c2", "#7f7f7f", "#bcbd22", "#17becf", "#ff7f0e"];
 async function tick() {
   try {
     const r = await fetch("/data");
@@ -51,26 +60,26 @@ async function tick() {
   } catch (e) { /* server gone: stop quietly */ }
   setTimeout(tick, 2000);
 }
-function draw(recs) {
-  if (!recs.length) return;
-  const keys = Object.keys(recs[recs.length - 1]).filter(
-    k => typeof recs[recs.length - 1][k] === "number" &&
-         k.endsWith("loss"));
-  const last = recs[recs.length - 1];
-  document.getElementById("meta").textContent =
-    `step ${last.step}` +
-    (last.examples_per_sec ?
-      ` — ${Math.round(last.examples_per_sec)} img/s` : "") +
-    ` — ${recs.length} records`;
-  const c = document.getElementById("chart");
+function drawSeries(canvasId, legendId, recs, keys, logScale) {
+  const c = document.getElementById(canvasId);
   const ctx = c.getContext("2d");
   ctx.clearRect(0, 0, c.width, c.height);
+  if (!keys.length) {  // e.g. a run without --telemetry
+    document.getElementById(legendId).innerHTML =
+      "<span class=\\"key\\" style=\\"color:#999\\">no such columns in " +
+      "this run</span>";
+    return;
+  }
+  const tx = logScale ? (v => v > 0 ? Math.log10(v) : NaN) : (v => v);
   let lo = Infinity, hi = -Infinity;
   for (const r of recs) for (const k of keys) {
-    if (typeof r[k] === "number") { lo = Math.min(lo, r[k]);
-                                    hi = Math.max(hi, r[k]); }
+    const v = tx(r[k]);
+    if (typeof r[k] === "number" && isFinite(v)) {
+      lo = Math.min(lo, v); hi = Math.max(hi, v);
+    }
   }
   if (!(hi > lo)) { hi = lo + 1; }
+  const last = recs[recs.length - 1];
   const x0 = recs[0].step, x1 = last.step || 1;
   const px = s => (s - x0) / Math.max(x1 - x0, 1) * (c.width - 40) + 30;
   const py = v => c.height - 20 -
@@ -81,18 +90,51 @@ function draw(recs) {
     ctx.beginPath();
     let started = false;
     for (const r of recs) {
-      if (typeof r[k] !== "number") continue;
-      const x = px(r.step), y = py(r[k]);
+      const v = tx(r[k]);
+      if (typeof r[k] !== "number" || !isFinite(v)) continue;
+      const x = px(r.step), y = py(v);
       if (started) ctx.lineTo(x, y); else { ctx.moveTo(x, y); started = true; }
     }
     ctx.stroke();
     legend += `<span class="key"><span class="swatch" style=` +
       `"background:${COLORS[i % COLORS.length]}"></span>${k}</span>`;
   });
-  document.getElementById("legend").innerHTML = legend;
+  document.getElementById(legendId).innerHTML = legend;
   ctx.fillStyle = "#333";
-  ctx.fillText(hi.toFixed(3), 2, 14);
-  ctx.fillText(lo.toFixed(3), 2, c.height - 8);
+  const fmt = v => logScale ? "1e" + v.toFixed(1) : v.toFixed(3);
+  ctx.fillText(fmt(hi), 2, 14);
+  ctx.fillText(fmt(lo), 2, c.height - 8);
+}
+function draw(recs) {
+  if (!recs.length) return;
+  const last = recs[recs.length - 1];
+  document.getElementById("meta").textContent =
+    `step ${last.step}` +
+    (last.examples_per_sec ?
+      ` — ${Math.round(last.examples_per_sec)} img/s` : "") +
+    ` — ${recs.length} records`;
+  // NaN panel: the telemetry counter, or a loss the server nulled
+  // because it was non-finite, paints the banner with the first bad step
+  let bad = null;
+  for (const r of recs) {
+    if ((typeof r.nonfinite === "number" && r.nonfinite > 0) ||
+        ["d_loss", "g_loss", "classifier_loss"].some(
+          k => k in r && r[k] === null)) { bad = r; break; }
+  }
+  const alarm = document.getElementById("alarm");
+  if (bad) {
+    alarm.style.display = "block";
+    alarm.textContent = `NaN/Inf detected — first bad step ${bad.step}` +
+      (typeof bad.nonfinite === "number" ?
+        ` (${bad.nonfinite} non-finite values)` : "");
+  } else { alarm.style.display = "none"; }
+  const numKeys = Object.keys(last).filter(
+    k => typeof last[k] === "number");
+  drawSeries("chart-loss", "legend-loss", recs,
+             numKeys.filter(k => k.endsWith("loss")), false);
+  drawSeries("chart-tel", "legend-tel", recs,
+             numKeys.filter(k => k.endsWith("_norm") ||
+                                 k.endsWith("_ratio")), true);
 }
 tick();
 </script></body></html>
@@ -134,6 +176,10 @@ class _TailCache:
                     rec = json.loads(line)
                 except ValueError:
                     continue  # malformed line: skip
+                if "step" not in rec:
+                    # step-less run-level records (the goodput summary)
+                    # have no x coordinate on a step chart
+                    continue
                 # a diverged run writes NaN/Infinity, which json.dumps
                 # would emit as INVALID JSON and permanently blank the
                 # browser's fetch().json() — null them at parse time

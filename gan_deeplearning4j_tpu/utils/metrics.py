@@ -11,13 +11,30 @@ and only materialized when flushed.
 
 from __future__ import annotations
 
+import atexit
 import json
 import os
 import queue
 import threading
 import time
+import weakref
 from collections import deque
-from typing import Dict, List, Optional
+from typing import Callable, Dict, List, Optional
+
+# Path-backed loggers register here so ONE atexit hook can flush them:
+# the async worker is a daemon thread, and without this the final batch
+# of records handed to it could be dropped at interpreter exit.  A
+# WeakSet so short-lived loggers (tests) don't accumulate forever.
+_OPEN_LOGGERS: "weakref.WeakSet" = weakref.WeakSet()
+_ATEXIT_REGISTERED = False
+
+
+def _flush_open_loggers() -> None:
+    for logger in list(_OPEN_LOGGERS):
+        try:
+            logger.close()
+        except Exception:
+            pass  # interpreter exit: never raise from the atexit hook
 
 
 class MetricsLogger:
@@ -33,7 +50,8 @@ class MetricsLogger:
 
     def __init__(self, path: Optional[str] = None, flush_every: int = 100,
                  ring_size: int = 10000, append: bool = False,
-                 async_io: bool = True):
+                 async_io: bool = True,
+                 on_record: Optional[Callable[[Dict], None]] = None):
         self.path = path
         self.flush_every = flush_every
         self._pending: List[Dict] = []
@@ -42,8 +60,14 @@ class MetricsLogger:
         self._t0 = time.perf_counter()
         self._last_step_t = self._t0
         self._q: Optional[queue.Queue] = None
+        self._worker: Optional[threading.Thread] = None
         self._worker_error: Optional[BaseException] = None
         self._failed: List[List[Dict]] = []
+        self._closed = False
+        # observer of every MATERIALIZED record, called on the worker
+        # thread (async mode) so e.g. the NaN alarm costs the training
+        # thread nothing (telemetry/ingraph.py NanAlarm.observe)
+        self._on_record = on_record
         if async_io:
             self._q = queue.Queue()
             self._worker = threading.Thread(target=self._drain, daemon=True)
@@ -54,10 +78,19 @@ class MetricsLogger:
                 # truncate: one file per run (``append=True`` = a resumed
                 # run continuing its own history)
                 open(path, "w").close()
+            global _ATEXIT_REGISTERED
+            _OPEN_LOGGERS.add(self)
+            if not _ATEXIT_REGISTERED:
+                atexit.register(_flush_open_loggers)
+                _ATEXIT_REGISTERED = True
 
     def _drain(self) -> None:
-        while True:
-            batch = self._q.get()
+        q = self._q  # local ref: close() nulls the attribute while the
+        while True:  # worker may still be draining the sentinel
+            batch = q.get()
+            if batch is None:  # close() sentinel
+                q.task_done()
+                return
             try:
                 self._materialize(batch)
             except BaseException as e:
@@ -69,7 +102,7 @@ class MetricsLogger:
                     self._worker_error = e
                 self._failed.append(batch)
             finally:
-                self._q.task_done()
+                q.task_done()
 
     def log_step(self, step: int, examples: int = 0, **metrics) -> None:
         """Record one step.  ``metrics`` values may be jax.Arrays — they are
@@ -149,6 +182,9 @@ class MetricsLogger:
                 for rec in materialized:
                     f.write(json.dumps(rec) + "\n")
         self._records.extend(materialized)
+        if self._on_record is not None:
+            for rec in materialized:
+                self._on_record(rec)
 
     def flush(self, wait: Optional[bool] = None) -> None:
         """Hand pending records off for materialization.  ``wait`` forces
@@ -170,6 +206,44 @@ class MetricsLogger:
         if self._worker_error is not None:
             e, self._worker_error = self._worker_error, None
             raise e
+
+    def log_record(self, rec: Dict) -> None:
+        """Append one raw, step-less record — run-level summaries like
+        the goodput breakdown or the run-manifest pointer.  Values may be
+        jax.Arrays (kept lazy until flush, like log_step's)."""
+        self._pending.append(dict(rec))
+
+    def close(self) -> None:
+        """Flush every pending record and join the async worker.  The
+        logger stays usable afterwards (flush falls back to synchronous
+        materialization); idempotent, and registered with atexit for
+        path-backed loggers so a daemon-thread worker can never drop the
+        final batch at interpreter exit."""
+        if self._closed:
+            return
+        try:
+            self.flush(wait=True)
+        finally:
+            self._closed = True
+            q, self._q = self._q, None
+            if q is not None:
+                q.put(None)  # sentinel: worker exits after draining
+                self._worker.join(timeout=10.0)
+            _OPEN_LOGGERS.discard(self)
+
+    def __enter__(self) -> "MetricsLogger":
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        if exc_type is None:
+            self.close()
+        else:
+            # error path: still stop the worker, but don't let a flush
+            # error (e.g. the readback of a poisoned loss) mask ``exc``
+            try:
+                self.close()
+            except Exception:
+                pass
 
     def records(self) -> List[Dict]:
         self.flush(wait=True)

@@ -44,9 +44,11 @@ def plot_losses(metrics_jsonl: str, out_png: Optional[str] = None,
     import matplotlib.pyplot as plt
     import numpy as np
 
-    records = read_metrics(metrics_jsonl)
+    # step records only: the feed also carries step-less run-level
+    # records (the goodput breakdown, utils/metrics.py log_record)
+    records = [r for r in read_metrics(metrics_jsonl) if "step" in r]
     if not records:
-        raise ValueError(f"no records in {metrics_jsonl}")
+        raise ValueError(f"no step records in {metrics_jsonl}")
     if keys is None:
         keys = [k for k in records[0] if k.endswith("_loss")]
     steps = np.array([r["step"] for r in records])
@@ -82,6 +84,80 @@ def plot_losses(metrics_jsonl: str, out_png: Optional[str] = None,
     return out_png
 
 
+def plot_telemetry(metrics_jsonl: str, out_png: Optional[str] = None,
+                   smooth: int = 1) -> str:
+    """Render the in-graph numerics telemetry of one run (grad/param
+    norms on a log axis, update ratios below, NaN steps rubricated) to
+    ``out_png`` (default: ``*_telemetry.png`` next to the JSONL).  The
+    post-hoc view of the columns ``--telemetry`` adds to the metrics
+    feed (telemetry/ingraph.py)."""
+    import matplotlib
+
+    matplotlib.use("Agg")
+    import matplotlib.pyplot as plt
+    import numpy as np
+
+    records = [r for r in read_metrics(metrics_jsonl) if "step" in r]
+    norm_keys = sorted({k for r in records for k in r
+                        if k.endswith("_norm")})
+    ratio_keys = sorted({k for r in records for k in r
+                         if k.endswith("_ratio")})
+    if not records or not (norm_keys or ratio_keys):
+        raise ValueError(
+            f"no telemetry columns in {metrics_jsonl} — was the run "
+            "trained with --telemetry?")
+    steps = np.array([r["step"] for r in records])
+
+    import itertools
+
+    fig, (ax_n, ax_r) = plt.subplots(
+        2, 1, figsize=(8, 7), dpi=120, sharex=True)
+    fallback = itertools.cycle(_FALLBACK_COLORS + list(
+        _SERIES_COLORS.values()))
+
+    def series(ax, keys, log):
+        for key in keys:
+            vals = np.array([r.get(key, np.nan) for r in records],
+                            dtype=float)
+            w = max(1, min(smooth, len(vals)))
+            if w > 1:
+                kernel = np.ones(w)
+                vals = (np.convolve(vals, kernel, mode="same")
+                        / np.convolve(np.ones_like(vals), kernel,
+                                      mode="same"))
+            ax.plot(steps, vals, color=next(fallback), linewidth=1.4,
+                    label=key)
+        if log:
+            ax.set_yscale("log")
+        ax.grid(True, color="#dddddd", linewidth=0.6, alpha=0.6)
+        for side in ("top", "right"):
+            ax.spines[side].set_visible(False)
+        ax.legend(frameon=False, fontsize=8)
+
+    series(ax_n, norm_keys, log=True)
+    ax_n.set_ylabel("global L2 norm")
+    series(ax_r, ratio_keys, log=True)
+    ax_r.set_ylabel("update ratio")
+    ax_r.set_xlabel("step")
+    # rubricate steps whose NaN/Inf counter fired (or whose norms went
+    # non-finite) — the first-bad-step marker a post-mortem reads first
+    bad = [r["step"] for r in records
+           if r.get("nonfinite") or any(
+               r.get(k) is not None and not np.isfinite(r.get(k, 0.0))
+               for k in norm_keys if isinstance(r.get(k), float))]
+    for ax in (ax_n, ax_r):
+        for s in bad[:50]:  # cap: a fully-diverged run marks every step
+            ax.axvline(s, color="#e34948", alpha=0.35, linewidth=0.8)
+    ax_n.set_title(os.path.basename(metrics_jsonl)
+                   + (f" — first NaN at step {bad[0]}" if bad else ""))
+    fig.tight_layout()
+    out_png = out_png or (
+        os.path.splitext(metrics_jsonl)[0] + "_telemetry.png")
+    fig.savefig(out_png)
+    plt.close(fig)
+    return out_png
+
+
 def main(argv=None) -> str:
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("metrics_jsonl")
@@ -90,8 +166,16 @@ def main(argv=None) -> str:
                    help="series to draw (default: every *_loss)")
     p.add_argument("--smooth", type=int, default=1,
                    help="moving-average window in steps")
+    p.add_argument("--telemetry", action="store_true",
+                   help="render the numerics-telemetry panel (grad/param "
+                        "norms, update ratios, NaN markers) instead of "
+                        "the loss curves")
     args = p.parse_args(argv)
-    out = plot_losses(args.metrics_jsonl, args.out, args.keys, args.smooth)
+    if args.telemetry:
+        out = plot_telemetry(args.metrics_jsonl, args.out, args.smooth)
+    else:
+        out = plot_losses(args.metrics_jsonl, args.out, args.keys,
+                          args.smooth)
     print(out)
     return out
 

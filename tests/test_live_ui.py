@@ -1,0 +1,78 @@
+"""Live dashboard server (utils/live_ui.py): serve a temp JSONL, GET the
+endpoints over a real socket, assert payload shape and a clean stop()."""
+
+import json
+import urllib.request
+
+from gan_deeplearning4j_tpu.utils.live_ui import serve_metrics
+
+
+def _get(port, path):
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}{path}", timeout=10) as r:
+        return r.status, r.headers.get("Content-Type"), r.read()
+
+
+def test_serve_metrics_data_and_page(tmp_path):
+    jsonl = tmp_path / "m.jsonl"
+    records = [{"step": i + 1, "d_loss": 0.5 - 0.01 * i, "g_loss": 0.7,
+                "d_grad_norm": 1.0 + i, "nonfinite": 0}
+               for i in range(5)]
+    jsonl.write_text("".join(json.dumps(r) + "\n" for r in records))
+
+    stop = serve_metrics(str(jsonl), port=0)  # ephemeral port
+    try:
+        status, ctype, body = _get(stop.port, "/data")
+        assert status == 200 and ctype == "application/json"
+        payload = json.loads(body)
+        assert [r["step"] for r in payload] == [1, 2, 3, 4, 5]
+        assert payload[-1]["d_grad_norm"] == 5.0
+
+        status, ctype, body = _get(stop.port, "/")
+        assert status == 200 and ctype.startswith("text/html")
+        html = body.decode()
+        # both panels + the NaN banner are served
+        assert "chart-loss" in html and "chart-tel" in html
+        assert "alarm" in html
+
+        # appended records show up on the next poll (incremental tail)
+        with open(jsonl, "a") as f:
+            f.write(json.dumps({"step": 6, "d_loss": 0.4}) + "\n")
+        _, _, body = _get(stop.port, "/data")
+        assert json.loads(body)[-1]["step"] == 6
+    finally:
+        stop()
+    # stopped: a fresh connection must fail fast
+    import pytest
+
+    with pytest.raises(OSError):
+        urllib.request.urlopen(
+            f"http://127.0.0.1:{stop.port}/data", timeout=2)
+
+
+def test_serve_metrics_nulls_nonfinite(tmp_path):
+    """A diverged run's NaN losses must reach the browser as null, not
+    break the JSON payload."""
+    jsonl = tmp_path / "m.jsonl"
+    jsonl.write_text('{"step": 1, "d_loss": NaN, "nonfinite": 3}\n')
+    stop = serve_metrics(str(jsonl), port=0)
+    try:
+        _, _, body = _get(stop.port, "/data")
+        payload = json.loads(body)  # would raise if NaN leaked through
+        assert payload[0]["d_loss"] is None
+        assert payload[0]["nonfinite"] == 3
+    finally:
+        stop()
+
+
+def test_serve_metrics_missing_file_then_created(tmp_path):
+    jsonl = tmp_path / "late.jsonl"
+    stop = serve_metrics(str(jsonl), port=0)
+    try:
+        _, _, body = _get(stop.port, "/data")
+        assert json.loads(body) == []
+        jsonl.write_text('{"step": 1, "d_loss": 0.1}\n')
+        _, _, body = _get(stop.port, "/data")
+        assert json.loads(body)[0]["step"] == 1
+    finally:
+        stop()

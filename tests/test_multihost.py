@@ -32,7 +32,7 @@ _WORKER = textwrap.dedent("""
 
     import jax.numpy as jnp
     import numpy as np
-    from jax import shard_map
+    from gan_deeplearning4j_tpu.compat.jaxver import shard_map
     from jax.sharding import NamedSharding, PartitionSpec as P
 
     from gan_deeplearning4j_tpu.parallel.multihost import global_mesh
@@ -95,6 +95,15 @@ def test_two_process_gradient_sync_matches_single_host(tmp_path):
     try:
         for p in procs:
             out, err = p.communicate(timeout=220)
+            if p.returncode != 0 and \
+                    "aren't implemented on the CPU backend" in err:
+                # older jaxlib: the CPU backend has no multiprocess
+                # collectives at all — the capability under test does
+                # not exist here, which is a platform gap, not a bug
+                import pytest
+
+                pytest.skip("this jaxlib's CPU backend lacks "
+                            "multiprocess collectives")
             assert p.returncode == 0, err[-2000:]
             for line in out.splitlines():
                 if line.startswith("RESULT"):

@@ -88,7 +88,7 @@ def test_fused_bn_act_gradients():
 def test_fused_bn_act_spmd_matches_global():
     """SPMD path (moments kernel -> pmean -> apply kernel) == the
     single-device global computation: sync-BN exactness over the mesh."""
-    from jax import shard_map
+    from gan_deeplearning4j_tpu.compat.jaxver import shard_map
     from jax.sharding import PartitionSpec as P
 
     from gan_deeplearning4j_tpu.parallel import data_mesh
@@ -122,7 +122,7 @@ def test_fused_bn_act_spmd_matches_global():
 def test_fused_bn_act_spmd_gradients():
     """Backward through the SPMD custom-vjp (pmean in the reference
     recomputation) == grads of the global single-device reference."""
-    from jax import shard_map
+    from gan_deeplearning4j_tpu.compat.jaxver import shard_map
     from jax.sharding import PartitionSpec as P
 
     from gan_deeplearning4j_tpu.parallel import data_mesh

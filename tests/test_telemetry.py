@@ -1,0 +1,397 @@
+"""In-graph numerics telemetry + goodput accounting.
+
+Proofs the observability layer rests on:
+  - tree_norm / count_nonfinite match a plain NumPy computation exactly
+    (finite case), and an injected NaN batch trips the in-graph counter
+    AND the host-side alarm hook through the real MetricsLogger path.
+  - the fused step's telemetry block describes the step it rode on: its
+    grad norm equals a norm recomputed from jax.grad of the same loss.
+  - GoodputTimer phase seconds sum to measured wall exactly (``other``
+    is the complement by construction) and nested phases never
+    double-count a second.
+  - MetricsLogger.close() flushes everything the async worker holds and
+    the logger keeps working synchronously afterwards.
+"""
+
+import json
+import math
+import os
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from gan_deeplearning4j_tpu.telemetry import (
+    GoodputTimer,
+    NanAlarm,
+    NanAlarmError,
+    count_nonfinite,
+    graph_telemetry,
+    tree_norm,
+    write_run_manifest,
+)
+from gan_deeplearning4j_tpu.utils import MetricsLogger
+
+
+# -- numerics vs numpy oracle ------------------------------------------------
+
+
+def test_tree_norm_matches_numpy_oracle():
+    rng = np.random.RandomState(0)
+    tree = {"a": {"W": rng.randn(5, 3).astype(np.float32),
+                  "b": rng.randn(3).astype(np.float32)},
+            "c": rng.randn(7).astype(np.float32),
+            "meta": "not-an-array"}
+    jtree = jax.tree_util.tree_map(
+        lambda v: jnp.asarray(v) if isinstance(v, np.ndarray) else v, tree)
+    expect = np.sqrt(sum(float((v ** 2).sum())
+                         for v in (tree["a"]["W"], tree["a"]["b"],
+                                   tree["c"])))
+    np.testing.assert_allclose(float(tree_norm(jtree)), expect, rtol=1e-6)
+    assert float(tree_norm({})) == 0.0
+
+
+def test_count_nonfinite_matches_numpy_oracle():
+    a = np.array([1.0, np.nan, np.inf, -np.inf, 2.0], np.float32)
+    b = np.array([[0.0, 1.0], [np.nan, 3.0]], np.float32)
+    tree = {"a": jnp.asarray(a), "b": jnp.asarray(b)}
+    expect = int((~np.isfinite(a)).sum() + (~np.isfinite(b)).sum())
+    assert int(count_nonfinite(tree)) == expect == 4
+    assert int(count_nonfinite({"x": jnp.ones(3)})) == 0
+
+
+def test_graph_telemetry_update_ratio():
+    old = {"l": {"W": jnp.ones((4,)) * 2.0}}
+    new = {"l": {"W": jnp.ones((4,)) * 2.1}}
+    tel = graph_telemetry(old, new, {"l": {"W": jnp.ones((4,))}},
+                          jnp.asarray(1.0))
+    np.testing.assert_allclose(float(tel["param_norm"]), 2.1 * 2.0,
+                               rtol=1e-6)
+    np.testing.assert_allclose(float(tel["grad_norm"]), 2.0, rtol=1e-6)
+    # ||new-old|| / ||old|| = (0.1*2) / (2*2)
+    np.testing.assert_allclose(float(tel["update_ratio"]), 0.05,
+                               rtol=1e-5)
+    assert int(tel["nonfinite"]) == 0
+
+
+# -- the fused protocol step's telemetry block -------------------------------
+
+
+def _insurance_setup(telemetry=True, **kw):
+    from gan_deeplearning4j_tpu.models import mlpgan_insurance as M
+    from gan_deeplearning4j_tpu.train import fused_step as fused
+
+    dis = M.build_discriminator()
+    gen = M.build_generator()
+    gan = M.build_gan()
+    clf = M.build_classifier(dis)
+    step = fused.make_protocol_step(
+        dis, gen, gan, clf,
+        M.DIS_TO_GAN, M.GAN_TO_GEN, M.DIS_TO_CLASSIFIER,
+        z_size=2, num_features=12, donate=False, telemetry=telemetry,
+        **kw)
+    state = fused.state_from_graphs(dis, gen, gan, clf)
+    return step, state, (dis, gen, gan, clf)
+
+
+def _step_args(B=10, seed=0, nan=False):
+    rng = np.random.RandomState(seed)
+    real = rng.rand(B, 12).astype(np.float32)
+    if nan:
+        real[0, 0] = np.nan
+    labels = (rng.rand(B, 1) > 0.5).astype(np.float32)
+    ones = jnp.ones((B, 1), jnp.float32)
+    key = jax.random.key(0)
+    inv = (key, jax.random.fold_in(key, 1), ones + 0.02, ones * 0 - 0.01,
+           ones)
+    return jnp.asarray(real), jnp.asarray(labels), inv
+
+
+def test_fused_telemetry_finite_case():
+    step, state, _ = _insurance_setup()
+    real, labels, inv = _step_args()
+    state, (losses, tel) = step(state, real, labels, *inv)
+    expect_keys = {f"{p}_{k}" for p in ("d", "g", "clf")
+                   for k in ("grad_norm", "param_norm", "update_ratio")}
+    expect_keys.add("nonfinite")
+    assert set(tel) == expect_keys
+    assert int(tel["nonfinite"]) == 0
+    for k, v in tel.items():
+        assert v.shape == (), k
+        assert math.isfinite(float(v)), k
+    # param_norm describes the UPDATED dis params exactly (numpy oracle)
+    expect = np.sqrt(sum(
+        float((np.asarray(leaf, np.float32) ** 2).sum())
+        for leaf in jax.tree_util.tree_leaves(state.dis_params)))
+    np.testing.assert_allclose(float(tel["d_param_norm"]), expect,
+                               rtol=1e-5)
+
+
+def test_fused_telemetry_grad_norm_matches_jax_grad():
+    """The d_grad_norm reported from inside the program == the norm of
+    grads recomputed OUTSIDE via jax.grad of the same D-step loss on the
+    same inputs (same z stream, same softening)."""
+    from gan_deeplearning4j_tpu.runtime import prng
+
+    step, state, (dis, gen, gan, clf) = _insurance_setup()
+    real, labels, inv = _step_args()
+    z_key, rng_key, y_real, y_fake, ones = inv
+    B = real.shape[0]
+    new_state, (losses, tel) = step(state, real, labels, *inv)
+
+    # replay the D-step's forward/backward by hand (fused_step.py step())
+    step_idx = int(state.it)
+    rng = jax.random.fold_in(rng_key, step_idx + 1)
+    z1 = jax.random.uniform(jax.random.fold_in(z_key, 2 * step_idx),
+                            (B, 2), minval=-1.0, maxval=1.0)
+    fake_vals, _ = gen._forward(
+        state.gen_params, {gen.input_names[0]: z1}, False, None)
+    fake = fake_vals[gen.output_names[0]].reshape(B, 12)
+    x = jnp.concatenate([real, fake])
+    y_dis = jnp.concatenate([y_real, y_fake])
+    d_rng = prng.stream(rng, "d")
+
+    def loss_fn(p):
+        values, su = dis._forward(
+            p, {dis.input_names[0]: x}, True, d_rng, None)
+        return dis._loss({dis.output_names[0]:
+                          values[dis.output_names[0]]},
+                         {dis.output_names[0]: y_dis}), su
+
+    (_, _), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+        state.dis_params)
+    expect = np.sqrt(sum(float((np.asarray(g, np.float32) ** 2).sum())
+                         for g in jax.tree_util.tree_leaves(grads)))
+    np.testing.assert_allclose(float(tel["d_grad_norm"]), expect,
+                               rtol=1e-5)
+
+
+def test_fused_telemetry_nan_trips_counter_and_alarm(tmp_path):
+    """An injected NaN feature propagates to the in-graph counter, and
+    the record — flowing through the REAL MetricsLogger async path —
+    trips the NanAlarm hook with the right step."""
+    step, state, _ = _insurance_setup()
+    real, labels, inv = _step_args(nan=True)
+    state, (losses, tel) = step(state, real, labels, *inv)
+    assert int(tel["nonfinite"]) > 0
+
+    alarm = NanAlarm()
+    logger = MetricsLogger(str(tmp_path / "m.jsonl"),
+                           on_record=alarm.observe)
+    logger.log_step(7, d_loss=losses[0], **tel)
+    logger.flush(wait=True)
+    assert alarm.tripped
+    assert alarm.step == 7
+    assert alarm.record["nonfinite"] > 0
+    logger.close()
+
+
+def test_fused_telemetry_multistep_stacks():
+    step, state, _ = _insurance_setup(data_on_device=True,
+                                      steps_per_call=3)
+    real, labels, inv = _step_args(B=10, seed=1)
+    table = jnp.tile(real, (3, 1))
+    tlabels = jnp.tile(labels, (3, 1))
+    state, (losses, tel) = step(state, table, tlabels, *inv)
+    for k, v in tel.items():
+        assert v.shape == (3,), k
+    assert losses[0].shape == (3,)
+
+
+def test_telemetry_off_output_shape_unchanged():
+    """telemetry=False returns exactly the pre-telemetry structure —
+    the zero-cost default every existing consumer relies on."""
+    step, state, _ = _insurance_setup(telemetry=False)
+    real, labels, inv = _step_args()
+    state, losses = step(state, real, labels, *inv)
+    assert isinstance(losses, tuple) and len(losses) == 3
+    assert all(l.shape == () for l in losses)
+
+
+# -- NaN alarm ---------------------------------------------------------------
+
+
+def test_nan_alarm_is_bad_on_nonfinite_loss_value():
+    assert NanAlarm._is_bad({"step": 1, "d_loss": float("nan")})
+    assert NanAlarm._is_bad({"step": 1, "nonfinite": 2.0})
+    assert not NanAlarm._is_bad({"step": 1, "d_loss": 0.5,
+                                 "nonfinite": 0.0})
+    # non-watched keys may legitimately be non-finite-free text etc.
+    assert not NanAlarm._is_bad({"step": 1, "note": "fine"})
+
+
+def test_nan_alarm_latches_first_trip():
+    trips = []
+    alarm = NanAlarm(on_trip=trips.append)
+    alarm.observe({"step": 3, "nonfinite": 1.0})
+    alarm.observe({"step": 9, "nonfinite": 5.0})
+    assert alarm.tripped and alarm.step == 3
+    assert len(trips) == 1
+
+
+def test_trainer_nan_alarm_config_validation(tmp_path):
+    from gan_deeplearning4j_tpu.train.gan_trainer import GANTrainer
+    from gan_deeplearning4j_tpu.train.insurance_main import (
+        InsuranceWorkload,
+        default_config,
+    )
+
+    with pytest.raises(ValueError, match="needs telemetry"):
+        GANTrainer(InsuranceWorkload(), default_config(
+            res_path=str(tmp_path), nan_alarm="abort"))
+    with pytest.raises(ValueError, match="fused"):
+        GANTrainer(InsuranceWorkload(), default_config(
+            res_path=str(tmp_path), telemetry=True, fused=False))
+    with pytest.raises(ValueError, match="nan_alarm"):
+        GANTrainer(InsuranceWorkload(), default_config(
+            res_path=str(tmp_path), telemetry=True, nan_alarm="explode"))
+
+
+def test_trainer_poll_raises_on_abort(tmp_path):
+    """The trainer's alarm wiring end-to-end minus the divergence: a bad
+    record through the REAL logger trips the alarm; the next bookkeeping
+    poll raises NanAlarmError."""
+    from gan_deeplearning4j_tpu.train.gan_trainer import GANTrainer
+    from gan_deeplearning4j_tpu.train.insurance_main import (
+        InsuranceWorkload,
+        default_config,
+    )
+
+    t = GANTrainer(InsuranceWorkload(), default_config(
+        res_path=str(tmp_path), n_devices=1, telemetry=True,
+        nan_alarm="abort"))
+    t.metrics.log_step(11, d_loss=float("nan"), nonfinite=1.0)
+    t.metrics.flush(wait=True)
+    with pytest.raises(NanAlarmError, match="step 11"):
+        t._poll_nan_alarm()
+
+
+# -- goodput timer + run manifest --------------------------------------------
+
+
+def test_goodput_phases_sum_to_wall():
+    gp = GoodputTimer()
+    with gp.phase("dispatch"):
+        time.sleep(0.05)
+    with gp.phase("data_wait"):
+        time.sleep(0.02)
+    time.sleep(0.02)  # unattributed -> other
+    rep = gp.report()
+    total = sum(rep[k] for k in ("data_wait", "dispatch", "readback",
+                                 "checkpoint", "eval", "other"))
+    assert abs(total - rep["wall_s"]) <= 0.05 * rep["wall_s"] + 1e-6
+    assert rep["dispatch"] >= 0.05
+    assert rep["other"] >= 0.02
+    assert 0.0 <= rep["compute_fraction"] <= 1.0
+
+
+def test_goodput_nested_phases_no_double_count():
+    gp = GoodputTimer()
+    with gp.phase("eval"):
+        time.sleep(0.02)
+        with gp.phase("checkpoint"):
+            time.sleep(0.03)
+    rep = gp.report()
+    # inner time belongs to checkpoint only; eval keeps the remainder
+    assert rep["checkpoint"] >= 0.03
+    assert rep["eval"] >= 0.02
+    assert rep["eval"] + rep["checkpoint"] <= rep["wall_s"] + 1e-6
+    with pytest.raises(ValueError):
+        with gp.phase("nonsense"):
+            pass
+
+
+def test_run_manifest_written(tmp_path):
+    man = write_run_manifest(str(tmp_path),
+                             config={"batch_size": 50, "drop": object()},
+                             extra={"workload": "t"})
+    path = tmp_path / "run_manifest.json"
+    assert path.exists()
+    loaded = json.loads(path.read_text())
+    assert loaded["run_id"] == man["run_id"]
+    assert loaded["config"]["batch_size"] == 50
+    assert "drop" not in loaded["config"]  # non-JSON values filtered
+    assert loaded["versions"]["jax"]
+    assert loaded["workload"] == "t"
+    assert loaded["devices"]["count"] >= 1
+
+
+def test_aggregate_goodput_single_process_passthrough():
+    from gan_deeplearning4j_tpu.parallel import multihost
+
+    rep = {"dispatch": 1.0, "wall_s": 2.0}
+    assert multihost.aggregate_goodput(rep) == rep
+
+
+# -- MetricsLogger lifecycle -------------------------------------------------
+
+
+def test_metrics_logger_close_flushes_pending(tmp_path):
+    path = str(tmp_path / "m.jsonl")
+    logger = MetricsLogger(path, flush_every=10 ** 9)  # never auto-flush
+    for i in range(5):
+        logger.log_step(i + 1, d_loss=float(i))
+    logger.close()
+    lines = [json.loads(l) for l in open(path) if l.strip()]
+    assert [r["step"] for r in lines] == [1, 2, 3, 4, 5]
+    # idempotent, and the logger still works (synchronously) after close
+    logger.close()
+    logger.log_step(6, d_loss=6.0)
+    logger.flush()
+    lines = [json.loads(l) for l in open(path) if l.strip()]
+    assert lines[-1]["step"] == 6
+
+
+def test_metrics_logger_context_manager(tmp_path):
+    path = str(tmp_path / "m.jsonl")
+    with MetricsLogger(path, flush_every=10 ** 9) as logger:
+        logger.log_record({"goodput": {"dispatch": 1.0}, "run_id": "x"})
+    lines = [json.loads(l) for l in open(path) if l.strip()]
+    assert lines == [{"goodput": {"dispatch": 1.0}, "run_id": "x"}]
+
+
+# -- trainer end to end ------------------------------------------------------
+
+
+def test_trainer_telemetry_and_goodput_end_to_end(tmp_path):
+    """One small fused run with telemetry on: the metrics JSONL carries
+    the telemetry columns and the goodput record, the manifest exists,
+    and the phase breakdown sums to wall within the 5%% acceptance bar
+    (exact by construction — ``other`` is the complement)."""
+    from gan_deeplearning4j_tpu.train.gan_trainer import GANTrainer
+    from gan_deeplearning4j_tpu.train.insurance_main import (
+        InsuranceWorkload,
+        default_config,
+    )
+
+    res = str(tmp_path / "run")
+    t = GANTrainer(InsuranceWorkload(), default_config(
+        num_iterations=4, print_every=100, save_every=100,
+        res_path=res, n_devices=1, telemetry=True, nan_alarm="warn"))
+    result = t.train(log=lambda s: None)
+
+    assert result["steps"] == 4
+    gp = result["goodput"]
+    total = sum(gp[k] for k in ("data_wait", "dispatch", "readback",
+                                "checkpoint", "eval", "other"))
+    assert abs(total - gp["wall_s"]) <= 0.05 * gp["wall_s"] + 1e-6
+
+    manifest = json.load(open(os.path.join(res, "run_manifest.json")))
+    assert manifest["run_id"] == result["run_id"]
+    assert manifest["config"]["telemetry"] is True
+
+    recs = [json.loads(l)
+            for l in open(os.path.join(res, "insurance_metrics.jsonl"))
+            if l.strip()]
+    step_recs = [r for r in recs if "d_grad_norm" in r]
+    assert len(step_recs) == 4
+    for r in step_recs:
+        assert r["nonfinite"] == 0
+        for k in ("d_grad_norm", "g_grad_norm", "clf_grad_norm",
+                  "d_update_ratio"):
+            assert math.isfinite(r[k]) and r[k] >= 0
+    goodput_recs = [r for r in recs if "goodput" in r]
+    assert len(goodput_recs) == 1
+    assert goodput_recs[0]["run_id"] == result["run_id"]
