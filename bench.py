@@ -43,6 +43,10 @@ pins this behavior with a guaranteed-dead backend.
 Exception to the exit-0 contract: ``--dryrun`` (the CI smoke lane) runs
 the inner benchmark's CPU build-and-execute smoke with NO probe and
 exits nonzero when it fails — CI wants the red X, not a structured skip.
+The smoke also runs the async-vs-sync checkpoint A/B (``ok`` requires
+the async save's training-thread blocking time <= 25% of the
+synchronous save AND byte-identical manifests — see
+docs/FAULT_TOLERANCE.md).
 """
 
 from __future__ import annotations

@@ -382,13 +382,70 @@ def e2e_img_per_sec(res_path: str, data_on_device=None,
     return value
 
 
+def checkpoint_dryrun() -> dict:
+    """Async-vs-sync checkpoint A/B on the real four-graph model set:
+    the training-thread BLOCKING time of an ``AsyncCheckpointer.save``
+    (host snapshot only) against a full synchronous
+    ``TrainCheckpointer.save`` (snapshot + zip/DEFLATE + fsync + rename),
+    plus a manifest-hash comparison proving the two paths commit
+    IDENTICAL bytes.  Best-of-2 each (fsync and scheduler noise are
+    one-sided, and each sync save costs ~10s of DEFLATE on a CI host).
+    The acceptance bar: blocking_ratio <= 0.25."""
+    import tempfile
+
+    from gan_deeplearning4j_tpu.checkpoint import (
+        AsyncCheckpointer,
+        TrainCheckpointer,
+    )
+    from gan_deeplearning4j_tpu.checkpoint.checkpointer import MANIFEST_NAME
+    from gan_deeplearning4j_tpu.models import dcgan_mnist as M
+
+    dis, gen, gan = (
+        M.build_discriminator(), M.build_generator(), M.build_gan())
+    graphs = {"dis": dis, "gen": gen, "gan": gan,
+              "classifier": M.build_classifier(dis)}
+    steps = (1, 2)  # best-of-2: each sync save is ~10s of DEFLATE on CPU
+    with tempfile.TemporaryDirectory() as d:
+        sync = TrainCheckpointer(os.path.join(d, "sync"), keep=len(steps))
+        t_sync = float("inf")
+        for s in steps:
+            t0 = time.perf_counter()
+            sync.save(s, graphs)
+            t_sync = min(t_sync, time.perf_counter() - t0)
+        ack = AsyncCheckpointer(
+            TrainCheckpointer(os.path.join(d, "async"), keep=len(steps)))
+        t_async = float("inf")
+        for s in steps:
+            ack.wait()  # isolate THIS save's blocking portion
+            t0 = time.perf_counter()
+            ack.save(s, graphs)
+            t_async = min(t_async, time.perf_counter() - t0)
+        ack.close()
+
+        def manifest(root, s):
+            with open(os.path.join(d, root, f"ckpt_{s}",
+                                   MANIFEST_NAME)) as f:
+                return json.load(f)["files"]
+
+        match = all(manifest("sync", s) == manifest("async", s)
+                    for s in steps)
+    return {
+        "sync_save_ms": round(t_sync * 1e3, 3),
+        "async_blocking_ms": round(t_async * 1e3, 3),
+        "blocking_ratio": round(t_async / t_sync, 4) if t_sync else None,
+        "manifest_match": bool(match),
+    }
+
+
 def dryrun(telemetry: bool = True) -> dict:
     """CI smoke: build and execute the fused protocol program — single
     step AND a 2-step scanned multistep, telemetry on — at a toy batch
     on whatever the default platform is (CPU in CI).  Catches exactly
     the class of regression that has bitten before: an import/trace
     error that breaks every consumer of the fused step without any
-    benchmark running.  No probe, no baseline, seconds not minutes."""
+    benchmark running.  No probe, no baseline, seconds not minutes.
+    Also runs the checkpoint A/B (``checkpoint_dryrun``): ok requires
+    async blocking <= 25% of the sync save AND identical manifests."""
     global BATCH
     prev_batch, BATCH = BATCH, 8
     try:
@@ -402,10 +459,15 @@ def dryrun(telemetry: bool = True) -> dict:
         ok = all(math.isfinite(float(l)) for l in losses)
         t = protocol_multistep_time(device, k=2, repeats=1,
                                     telemetry=telemetry)
+        ckpt = checkpoint_dryrun()
+        ckpt_ok = (ckpt["manifest_match"]
+                   and ckpt["blocking_ratio"] is not None
+                   and ckpt["blocking_ratio"] <= 0.25)
         return {"metric": "dcgan_mnist_img_per_sec", "dryrun": True,
-                "ok": bool(ok and math.isfinite(t)),
+                "ok": bool(ok and math.isfinite(t) and ckpt_ok),
                 "platform": device.platform,
-                "telemetry": telemetry}
+                "telemetry": telemetry,
+                "checkpoint": ckpt}
     finally:
         BATCH = prev_batch
 

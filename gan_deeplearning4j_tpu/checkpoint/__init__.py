@@ -10,10 +10,25 @@ checkpoints with pruning and resume: all graphs' params + updater state
 because the reference samples it once — SURVEY.md appendix).
 
 Layout: ``{dir}/ckpt_{step}/`` with one model zip per graph plus
-``state.json`` / ``state.npz``; written to a temp dir and atomically
-renamed, so a killed run never leaves a half checkpoint.
+``state.json`` / ``state.npz`` and a ``MANIFEST.json`` (per-file SHA-256
++ sizes, written and fsynced last); everything is written to a temp dir,
+fsynced, and atomically renamed, so a kill at ANY byte leaves either no
+checkpoint entry or one whose manifest verifies.  ``restore()`` verifies
+before loading and falls back to the newest checkpoint that passes.
+``AsyncCheckpointer`` moves the serialize/fsync half onto a background
+worker (the training thread pays only the host snapshot) with barriers
+at the next save, at every read, and at exit.  Failure model and format:
+docs/FAULT_TOLERANCE.md.
 """
 
-from gan_deeplearning4j_tpu.checkpoint.checkpointer import TrainCheckpointer
+from gan_deeplearning4j_tpu.checkpoint.async_checkpointer import (
+    AsyncCheckpointer,
+)
+from gan_deeplearning4j_tpu.checkpoint.checkpointer import (
+    CheckpointCorruptError,
+    NoVerifiedCheckpointError,
+    TrainCheckpointer,
+)
 
-__all__ = ["TrainCheckpointer"]
+__all__ = ["AsyncCheckpointer", "CheckpointCorruptError",
+           "NoVerifiedCheckpointError", "TrainCheckpointer"]

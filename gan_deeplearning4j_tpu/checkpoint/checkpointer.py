@@ -1,17 +1,138 @@
-"""Multi-graph training-state checkpointer (see package docstring)."""
+"""Multi-graph training-state checkpointer (see package docstring).
+
+Crash-safety contract (the failure model docs/FAULT_TOLERANCE.md spells
+out):
+
+* ``save()`` is split into a **snapshot** half (``snapshot_state`` —
+  host copies of every device value, run on the training thread) and a
+  **serialize** half (``write_snapshot`` — bytes, fsync, atomic rename;
+  safe to run on a background worker, see ``AsyncCheckpointer``).
+* Every file is fsynced, then ``MANIFEST.json`` (per-file SHA-256 +
+  size) is written and fsynced LAST, then the temp dir is renamed into
+  place and the parent directory fsynced — a kill at ANY byte leaves
+  either no ``ckpt_{step}`` entry at all or one whose manifest verifies.
+* Re-saving an existing step swaps via rename/rename/rmtree (never
+  rmtree-then-rename): at no instant is the step's data unlinked before
+  its replacement is in place, so a kill between the two renames demotes
+  that step to "absent" (recoverable from an older verified checkpoint)
+  instead of destroying it with nothing written yet.
+* ``restore()`` verifies the manifest of the chosen checkpoint and, when
+  no explicit step was requested, **falls back to the newest checkpoint
+  that verifies and loads** — a torn or corrupt latest checkpoint makes
+  the restart start slightly earlier, it does not crash the restart.
+* ``__init__`` purges stale ``.ckpt_tmp_*`` / ``.ckpt_del_*`` debris a
+  hard kill mid-save leaves behind (they would otherwise accumulate
+  forever under ``--max-restarts``).
+
+``_chaos_hook`` is the fault-injection seam: ``testing/chaos.py``
+installs a callable that raises at an enumerated write/rename point to
+prove the contract above (tests/test_chaos.py walks every point).
+"""
 
 from __future__ import annotations
 
+import hashlib
 import json
+import logging
 import os
 import re
 import shutil
 import tempfile
-from typing import Dict, Optional, Tuple
+from typing import Callable, Dict, Optional, Tuple
 
 import numpy as np
 
 from gan_deeplearning4j_tpu.graph import serialization
+
+MANIFEST_NAME = "MANIFEST.json"
+
+# fault-injection seam (testing/chaos.py): called as _chaos_hook(event)
+# at each named point of write_snapshot; a raised exception with
+# ``simulates_kill = True`` is treated as a hard kill (no graceful temp
+# cleanup — exactly what SIGKILL leaves behind)
+_chaos_hook: Optional[Callable[[str], None]] = None
+
+_log = logging.getLogger(__name__)
+
+
+class CheckpointCorruptError(RuntimeError):
+    """An explicitly requested checkpoint failed manifest verification."""
+
+
+class NoVerifiedCheckpointError(FileNotFoundError):
+    """No checkpoint in the directory verifies and loads.  Callers that
+    can fall back to a from-scratch run (deterministic replay) should
+    catch this; it is NOT raised when a fallback checkpoint exists."""
+
+
+def _chaos(event: str) -> None:
+    if _chaos_hook is not None:
+        _chaos_hook(event)
+
+
+def _fsync_file(path: str) -> None:
+    fd = os.open(path, os.O_RDONLY)
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+
+
+def _fsync_dir(path: str) -> None:
+    try:
+        fd = os.open(path, os.O_RDONLY)
+    except OSError:
+        return  # platform without directory fds: rename is still atomic
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)
+
+
+def _sha256(path: str) -> str:
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        for block in iter(lambda: f.read(1 << 20), b""):
+            h.update(block)
+    return h.hexdigest()
+
+
+def snapshot_state(graphs: Dict[str, object], step: int,
+                   extra: Optional[Dict] = None) -> Dict:
+    """The training-thread half of a save: capture config dicts and HOST
+    copies of every param/updater/extra array.  After this returns, the
+    live graphs may keep training — serialization reads only the
+    snapshot.  Device->host copies are overlapped (one round trip on a
+    tunneled link, not one per leaf)."""
+    from gan_deeplearning4j_tpu.utils.device import start_host_copy
+
+    # start every device->host transfer before materializing any
+    start_host_copy([g.params for g in graphs.values()]
+                    + [g.opt_state for g in graphs.values()]
+                    + [v for v in (extra or {}).values()])
+    graph_parts = {
+        name: serialization.snapshot_model_parts(g, save_updater=True)
+        for name, g in graphs.items()
+    }
+    arrays: Dict[str, np.ndarray] = {}
+    scalars: Dict = {"step": step, "graphs": sorted(graphs.keys())}
+    pytrees = []
+    for k, v in (extra or {}).items():
+        if isinstance(v, (int, float, str, bool)) or v is None:
+            scalars[k] = v
+        elif isinstance(v, dict):
+            # nested param-tree extra (e.g. a generator EMA):
+            # flattened under its key, rebuilt on restore
+            pytrees.append(k)
+            arrays.update({kk: np.asarray(vv) for kk, vv in
+                           serialization._flatten(v, f"{k}/").items()})
+        else:
+            arrays[k] = np.asarray(v)
+    if pytrees:
+        scalars["pytree_extras"] = sorted(pytrees)
+    return {"graphs": graph_parts, "scalars": scalars, "arrays": arrays}
 
 
 class TrainCheckpointer:
@@ -19,43 +140,136 @@ class TrainCheckpointer:
         self.directory = directory
         self.keep = keep
         os.makedirs(directory, exist_ok=True)
+        self._purge_debris()
+
+    def _purge_debris(self) -> None:
+        """Reclaim temp/swap dirs a hard kill mid-save left behind —
+        without this they leak forever and accumulate one per crash
+        under ``--max-restarts``.
+
+        An orphan whose manifest VERIFIES is a complete checkpoint that
+        only missed its rename (kill after the last fsync, or between
+        the two renames of a re-save): if its step has no committed
+        ``ckpt_{step}`` entry, ADOPT it — rename it into place instead
+        of deleting it.  This closes the last availability gap: with at
+        least one fully-written save ever, no kill point leaves the
+        directory unrestorable (tests/test_chaos.py enumerates them)."""
+        debris = [n for n in sorted(os.listdir(self.directory))
+                  if n.startswith((".ckpt_tmp_", ".ckpt_del_"))]
+        changed = False
+        # adoption preference: a .ckpt_tmp_ orphan holds the NEWER bytes
+        # of an interrupted re-save swap (.ckpt_del_ is the superseded
+        # copy) — when both verify for the same missing step, the
+        # replacement that was fully fsynced must win, not the stale one
+        adopted = set()
+        for prefix in (".ckpt_tmp_", ".ckpt_del_"):
+            for name in debris:
+                if not name.startswith(prefix):
+                    continue
+                path = os.path.join(self.directory, name)
+                step = self._orphan_step(path)
+                if step is None:
+                    continue
+                final = os.path.join(self.directory, f"ckpt_{step}")
+                if not os.path.exists(final):
+                    _log.warning(
+                        "adopting orphaned complete checkpoint %s as "
+                        "ckpt_%d (killed before its rename)", name, step)
+                    os.rename(path, final)
+                    adopted.add(name)
+                    changed = True
+        for name in debris:
+            if name in adopted:
+                continue
+            shutil.rmtree(os.path.join(self.directory, name),
+                          ignore_errors=True)
+            changed = True
+        if changed:
+            _fsync_dir(self.directory)
+
+    def _orphan_step(self, path: str) -> Optional[int]:
+        """The step of a debris dir IF it verifies as a complete
+        checkpoint (manifest present, every file intact); else None."""
+        if not self._verify_dir(path):
+            return None
+        try:
+            with open(os.path.join(path, MANIFEST_NAME)) as f:
+                return int(json.load(f)["step"])
+        except (OSError, ValueError, KeyError, TypeError):
+            return None
 
     # -- save ----------------------------------------------------------------
 
     def save(self, step: int, graphs: Dict[str, object],
              extra: Optional[Dict] = None) -> str:
-        """Write ``ckpt_{step}`` atomically; prune beyond ``keep``."""
+        """Write ``ckpt_{step}`` atomically (manifest-verified, fsynced);
+        prune beyond ``keep``.  Snapshot + serialize on this thread; the
+        async wrapper calls the two halves on different threads."""
+        return self.write_snapshot(snapshot_state(graphs, step, extra))
+
+    def write_snapshot(self, snap: Dict) -> str:
+        """Serialize a ``snapshot_state`` result to ``ckpt_{step}`` —
+        pure file work, no device or graph access (background-thread
+        safe).  Every file is fsynced; the manifest is written last; the
+        final rename is the commit point."""
+        step = snap["scalars"]["step"]
         final = os.path.join(self.directory, f"ckpt_{step}")
         tmp = tempfile.mkdtemp(prefix=".ckpt_tmp_", dir=self.directory)
         try:
-            for name, graph in graphs.items():
-                serialization.write_model(
-                    graph, os.path.join(tmp, f"{name}_model.zip"), save_updater=True
-                )
-            arrays = {}
-            scalars = {"step": step, "graphs": sorted(graphs.keys())}
-            pytrees = []
-            for k, v in (extra or {}).items():
-                if isinstance(v, (int, float, str, bool)) or v is None:
-                    scalars[k] = v
-                elif isinstance(v, dict):
-                    # nested param-tree extra (e.g. a generator EMA):
-                    # flattened under its key, rebuilt on restore
-                    pytrees.append(k)
-                    arrays.update(serialization._flatten(v, f"{k}/"))
-                else:
-                    arrays[k] = np.asarray(v)
-            if pytrees:
-                scalars["pytree_extras"] = sorted(pytrees)
-            with open(os.path.join(tmp, "state.json"), "w") as f:
-                json.dump(scalars, f, indent=1)
-            if arrays:
-                np.savez(os.path.join(tmp, "state.npz"), **arrays)
+            entries: Dict[str, Dict] = {}
+
+            def put(name: str, data: bytes) -> None:
+                path = os.path.join(tmp, name)
+                with open(path, "wb") as f:
+                    f.write(data)
+                _fsync_file(path)
+                # hash the in-memory bytes (a re-read would only go
+                # through the page cache — same hash, double the IO)
+                entries[name] = {"bytes": len(data),
+                                 "sha256": hashlib.sha256(data)
+                                 .hexdigest()}
+                _chaos(f"wrote:{name}")
+
+            for name, (cfg, flat_params, flat_updater) in \
+                    sorted(snap["graphs"].items()):
+                put(f"{name}_model.zip", serialization.model_zip_bytes(
+                    cfg, flat_params, flat_updater))
+            put("state.json",
+                json.dumps(snap["scalars"], indent=1).encode())
+            if snap["arrays"]:
+                put("state.npz", serialization.npz_bytes(snap["arrays"]))
+            # the manifest commits the file set: written + fsynced LAST,
+            # so a manifest that parses implies every listed byte hit
+            # the disk before it
+            mpath = os.path.join(tmp, MANIFEST_NAME)
+            with open(mpath, "w") as f:
+                json.dump({"step": step, "files": entries}, f, indent=1)
+            _fsync_file(mpath)
+            _fsync_dir(tmp)
+            _chaos("manifest")
             if os.path.exists(final):
-                shutil.rmtree(final)
-            os.rename(tmp, final)
-        except BaseException:
-            shutil.rmtree(tmp, ignore_errors=True)
+                # swap, never rmtree-then-rename: a kill between the
+                # renames loses the step's DIRECTORY ENTRY (restore falls
+                # back one checkpoint) but never both copies of the data
+                trash = tempfile.mkdtemp(prefix=".ckpt_del_",
+                                         dir=self.directory)
+                os.rmdir(trash)
+                _chaos("pre_swap")
+                os.rename(final, trash)
+                _chaos("mid_swap")
+                os.rename(tmp, final)
+                _chaos("post_swap")
+                shutil.rmtree(trash, ignore_errors=True)
+            else:
+                _chaos("pre_swap")
+                os.rename(tmp, final)
+                _chaos("post_swap")
+            _fsync_dir(self.directory)
+        except BaseException as e:
+            # a SIMULATED hard kill must leave the directory exactly as
+            # a real one would — debris and all (purged at next init)
+            if not getattr(e, "simulates_kill", False):
+                shutil.rmtree(tmp, ignore_errors=True)
             raise
         self._prune()
         return final
@@ -64,6 +278,51 @@ class TrainCheckpointer:
         steps = self.steps()
         for s in steps[: max(0, len(steps) - self.keep)]:
             shutil.rmtree(os.path.join(self.directory, f"ckpt_{s}"), ignore_errors=True)
+
+    # -- verification --------------------------------------------------------
+
+    def verify(self, step: int) -> bool:
+        """True iff ``ckpt_{step}``'s manifest parses and every listed
+        file exists with matching size and SHA-256 (and no file the
+        checkpoint needs is missing from the manifest's view)."""
+        return self._verify_dir(os.path.join(self.directory,
+                                             f"ckpt_{step}"))
+
+    @staticmethod
+    def _verify_dir(path: str) -> bool:
+        try:
+            with open(os.path.join(path, MANIFEST_NAME)) as f:
+                manifest = json.load(f)
+            files = manifest["files"]
+            if "state.json" not in files:
+                return False
+            for name, meta in files.items():
+                fp = os.path.join(path, name)
+                if (not os.path.isfile(fp)
+                        or os.path.getsize(fp) != meta["bytes"]
+                        or _sha256(fp) != meta["sha256"]):
+                    return False
+            return True
+        except (OSError, ValueError, KeyError, TypeError):
+            return False  # torn manifest / pre-manifest layout: unverified
+
+    @staticmethod
+    def _is_legacy_dir(path: str) -> bool:
+        """A COMMITTED checkpoint written before the manifest existed:
+        no MANIFEST.json but a state.json.  Distinguishable from a torn
+        save because a kill before the manifest write leaves only a
+        temp dir, never a committed ``ckpt_{step}`` entry — so a
+        committed dir without a manifest can only be the old layout.
+        Unverifiable but not corrupt: restore accepts it (loudly) so an
+        upgrade does not silently discard a long run's progress."""
+        return (not os.path.exists(os.path.join(path, MANIFEST_NAME))
+                and os.path.isfile(os.path.join(path, "state.json")))
+
+    def latest_verified_step(self) -> Optional[int]:
+        for s in reversed(self.steps()):
+            if self.verify(s):
+                return s
+        return None
 
     # -- restore -------------------------------------------------------------
 
@@ -82,12 +341,83 @@ class TrainCheckpointer:
     def restore(
         self, graphs: Dict[str, object], step: Optional[int] = None
     ) -> Tuple[int, Dict]:
-        """Load params + updater state into the given graphs (in place) from
-        ``ckpt_{step}`` (default: latest).  Returns (step, extra)."""
-        if step is None:
-            step = self.latest_step()
-            if step is None:
-                raise FileNotFoundError(f"no checkpoints in {self.directory}")
+        """Load params + updater state into the given graphs (in place).
+
+        ``step=None`` (the resume path): newest-first over the directory,
+        skipping — with a loud warning — any checkpoint that fails
+        manifest verification or whose files turn out unreadable, so a
+        checkpoint torn by a mid-write kill degrades the restart to the
+        previous save instead of crashing it.  Raises
+        ``NoVerifiedCheckpointError`` when nothing survives.
+
+        An EXPLICIT ``step`` is a user decision: verification failure
+        raises ``CheckpointCorruptError`` (no silent substitution).
+
+        Structure mismatches (graph set / params / opt_state trees) are
+        NOT corruption — they mean the caller resumed with different
+        flags and always raise ``ValueError`` (the recovery wrapper
+        classifies that as fatal, not retryable)."""
+        if step is not None:
+            path = os.path.join(self.directory, f"ckpt_{step}")
+            if not os.path.isdir(path):
+                # absent is absent — calling it "corrupt" would both
+                # mislead the user and misclassify in the recovery
+                # wrapper (corruption is fatal; a mistyped step is not
+                # a statement about the data)
+                raise FileNotFoundError(
+                    f"no checkpoint ckpt_{step} in {self.directory}")
+            if not self.verify(step):
+                if self._is_legacy_dir(path):
+                    _log.warning(
+                        "checkpoint ckpt_%d predates the manifest "
+                        "format (unverifiable, accepted)", step)
+                else:
+                    raise CheckpointCorruptError(
+                        f"checkpoint ckpt_{step} in {self.directory} "
+                        "fails manifest verification (torn or corrupt)")
+            return self._load(step, graphs)
+        candidates = self.steps()
+        if not candidates:
+            raise NoVerifiedCheckpointError(
+                f"no checkpoints in {self.directory}")
+        legacy = []
+        for s in reversed(candidates):
+            if not self.verify(s):
+                if self._is_legacy_dir(
+                        os.path.join(self.directory, f"ckpt_{s}")):
+                    legacy.append(s)  # second-choice tier, tried below
+                    continue
+                _log.warning(
+                    "checkpoint ckpt_%d fails verification (torn or "
+                    "corrupt); falling back to the previous one", s)
+                continue
+            try:
+                return self._load(s, graphs)
+            except ValueError:
+                raise  # structure mismatch: fatal, not corruption
+            except Exception as e:  # unreadable despite the manifest
+                _log.warning(
+                    "checkpoint ckpt_%d failed to load (%r); falling "
+                    "back to the previous one", s, e)
+        # pre-manifest checkpoints: unverifiable but not corrupt — a
+        # silent restart-from-0 after an upgrade would throw away a long
+        # run's progress, so try them (loudly) before giving up
+        for s in legacy:
+            _log.warning(
+                "checkpoint ckpt_%d predates the manifest format "
+                "(unverifiable); attempting restore", s)
+            try:
+                return self._load(s, graphs)
+            except ValueError:
+                raise
+            except Exception as e:
+                _log.warning("legacy checkpoint ckpt_%d failed to load "
+                             "(%r)", s, e)
+        raise NoVerifiedCheckpointError(
+            f"no VERIFIED checkpoint in {self.directory} "
+            f"(all of {candidates} torn or corrupt)")
+
+    def _load(self, step: int, graphs: Dict[str, object]) -> Tuple[int, Dict]:
         path = os.path.join(self.directory, f"ckpt_{step}")
         with open(os.path.join(path, "state.json")) as f:
             scalars = json.load(f)

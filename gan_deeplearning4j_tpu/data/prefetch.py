@@ -42,6 +42,11 @@ class PrefetchIterator:
         self.sharding = sharding
         self.loop = loop
         self.min_rows = min_rows
+        # first worker exception, kept OUT of band as well as enqueued:
+        # close() may drain the queue while the worker is still putting,
+        # and a decode error must survive that drain (retrievable via
+        # ``error`` / raised by a post-close __next__), never be dropped
+        self.error: Optional[BaseException] = None
         self._q: queue.Queue = queue.Queue(maxsize=prefetch_depth)
         self._stop = threading.Event()
         self._thread = threading.Thread(target=self._worker, daemon=True)
@@ -75,6 +80,8 @@ class PrefetchIterator:
                 emitted_this_pass += 1
             self._put_stop_aware(None)  # sentinel: exhausted
         except BaseException as e:  # surface decode errors to the consumer
+            if self.error is None:
+                self.error = e
             self._put_stop_aware(e)
 
     def _put_stop_aware(self, item) -> bool:
@@ -95,17 +102,34 @@ class PrefetchIterator:
     def __next__(self):
         item = self._q.get()
         if item is None:
+            if self.error is not None:
+                # the worker died; its enqueued exception may have been
+                # drained by close() — deliver it, don't end cleanly
+                err, self.error = self.error, None
+                raise err
             raise StopIteration
         if isinstance(item, BaseException):
+            if item is self.error:
+                self.error = None  # delivered; don't re-raise at close
             raise item
         return item
 
-    def close(self):
+    def close(self, timeout: float = 5.0):
+        """Stop the worker and release both sides.  Safe to call while
+        the worker is mid-``put`` (the stop flag breaks its bounded put
+        loop) or wedged inside ``source.next()`` (the join gives up
+        after ``timeout`` rather than deadlocking the caller — the
+        daemon worker then dies with the process).  A worker exception
+        that was still queued is preserved on ``error``, never dropped
+        (tests/test_chaos.py pins both properties)."""
         self._stop.set()
-        # drain so the worker's blocked put can finish
+        # drain so the worker's blocked put can finish — preserving, not
+        # discarding, any queued worker exception
         try:
             while True:
-                self._q.get_nowait()
+                item = self._q.get_nowait()
+                if isinstance(item, BaseException) and self.error is None:
+                    self.error = item
         except queue.Empty:
             pass
         # release any reader blocked in __next__ (the stopped worker will
@@ -114,7 +138,7 @@ class PrefetchIterator:
             self._q.put_nowait(None)
         except queue.Full:
             pass
-        self._thread.join(timeout=5)
+        self._thread.join(timeout=timeout)
 
     def __enter__(self):
         return self
@@ -217,6 +241,8 @@ class ChunkPrefetchIterator(PrefetchIterator):
                     return
             self._put_stop_aware(None)
         except BaseException as e:  # surface decode errors to the consumer
+            if self.error is None:
+                self.error = e
             self._put_stop_aware(e)
 
     def _worker_dedup(self):
@@ -291,4 +317,6 @@ class ChunkPrefetchIterator(PrefetchIterator):
                     return
             self._put_stop_aware(None)
         except BaseException as e:  # surface errors to the consumer
+            if self.error is None:
+                self.error = e
             self._put_stop_aware(e)

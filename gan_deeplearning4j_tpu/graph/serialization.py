@@ -16,7 +16,7 @@ import dataclasses
 import io
 import json
 import zipfile
-from typing import Dict
+from typing import Dict, Optional
 
 import jax.numpy as jnp
 import numpy as np
@@ -192,16 +192,73 @@ def _unflatten(flat) -> Dict:
     return tree
 
 
+# Fixed zip member timestamp: model/checkpoint bytes are a pure function
+# of the state they encode, so two serializations of the same state hash
+# identically — the property the checkpoint MANIFEST.json (per-file
+# SHA-256) and the async-vs-sync save equivalence check rely on.
+# (zipfile and np.savez both stamp wall-clock time otherwise.)
+_ZIP_EPOCH = (1980, 1, 1, 0, 0, 0)
+
+
+def _zip_writestr(zf: zipfile.ZipFile, name: str, data,
+                  compress_type: Optional[int] = None) -> None:
+    info = zipfile.ZipInfo(name, date_time=_ZIP_EPOCH)
+    info.compress_type = (zf.compression if compress_type is None
+                          else compress_type)
+    info.external_attr = 0o600 << 16
+    zf.writestr(info, data)
+
+
+def npz_bytes(flat: Dict[str, np.ndarray]) -> bytes:
+    """Deterministic ``.npz`` bytes for a flat {key: array} mapping
+    (np.load-compatible; unlike ``np.savez`` the member timestamps are
+    fixed, so equal arrays give equal bytes)."""
+    buf = io.BytesIO()
+    with zipfile.ZipFile(buf, "w", zipfile.ZIP_DEFLATED) as zf:
+        for key, arr in flat.items():
+            member = io.BytesIO()
+            np.lib.format.write_array(member, np.asarray(arr),
+                                      allow_pickle=False)
+            _zip_writestr(zf, key + ".npy", member.getvalue())
+    return buf.getvalue()
+
+
+def model_zip_bytes(config: dict, flat_params: Dict[str, np.ndarray],
+                    flat_updater: Optional[Dict[str, np.ndarray]]) -> bytes:
+    """The model-zip format from already-flattened host arrays — the
+    worker-thread half of an async checkpoint save (no graph access, no
+    device contact; ``snapshot_model_parts`` produces the inputs)."""
+    buf = io.BytesIO()
+    with zipfile.ZipFile(buf, "w", zipfile.ZIP_DEFLATED) as zf:
+        _zip_writestr(zf, "config.json", json.dumps(config, indent=1))
+        # the .npz members are ALREADY deflated (npz_bytes); store them
+        # raw — a second DEFLATE pass over incompressible bytes would
+        # double the dominant serialization cost for no size gain
+        _zip_writestr(zf, "params.npz", npz_bytes(flat_params),
+                      compress_type=zipfile.ZIP_STORED)
+        if flat_updater is not None:
+            _zip_writestr(zf, "updater.npz", npz_bytes(flat_updater),
+                          compress_type=zipfile.ZIP_STORED)
+    return buf.getvalue()
+
+
+def snapshot_model_parts(graph: ComputationGraph, save_updater: bool = True):
+    """Capture everything ``model_zip_bytes`` needs as host-side values:
+    (config_dict, flat_params, flat_updater_or_None).  The flat arrays
+    are numpy copies — safe to hand to a background serializer while the
+    training thread keeps mutating the live graph."""
+    flat_params = {k: np.asarray(v)
+                   for k, v in _flatten(graph.params).items()}
+    flat_updater = None
+    if save_updater:
+        flat_updater = {k: np.asarray(v)
+                        for k, v in _flatten(graph.opt_state).items()}
+    return graph_config_to_dict(graph), flat_params, flat_updater
+
+
 def write_model(graph: ComputationGraph, path: str, save_updater: bool = True) -> None:
-    with zipfile.ZipFile(path, "w", zipfile.ZIP_DEFLATED) as zf:
-        zf.writestr("config.json", json.dumps(graph_config_to_dict(graph), indent=1))
-        buf = io.BytesIO()
-        np.savez(buf, **_flatten(graph.params))
-        zf.writestr("params.npz", buf.getvalue())
-        if save_updater:
-            buf = io.BytesIO()
-            np.savez(buf, **_flatten(graph.opt_state))
-            zf.writestr("updater.npz", buf.getvalue())
+    with open(path, "wb") as f:
+        f.write(model_zip_bytes(*snapshot_model_parts(graph, save_updater)))
 
 
 def read_model(path: str) -> ComputationGraph:

@@ -63,6 +63,32 @@ def aggregate_goodput(report: Dict[str, float]) -> Dict[str, float]:
     return out
 
 
+def agree_preemption(triggered: bool, step: int) -> tuple:
+    """Fleet preemption consensus: allgather every host's (triggered,
+    step) and return ``(any_triggered, min_step)``.
+
+    This is a COLLECTIVE, so on a multi-process fleet it must be entered
+    by EVERY host at the same boundary — the caller polls it
+    unconditionally once preemption is armed, never only on the host
+    that happened to receive the signal (a conditionally-entered
+    collective deadlocks a partially-signaled fleet against the training
+    step's own collectives).  ``any_triggered`` then preempts the WHOLE
+    fleet together: one evicted host takes the others down cleanly, each
+    with an emergency checkpoint at the agreed (min; equal under SPMD
+    lockstep) step.  Single process: passthrough, no device contact —
+    the same no-op discipline as ``initialize``/``aggregate_goodput``.
+    Cost when it does gather: one small DCN allgather per boundary, paid
+    only while a preemption guard is armed."""
+    if jax.process_count() == 1:
+        return bool(triggered), int(step)
+    from jax.experimental import multihost_utils
+
+    gathered = multihost_utils.process_allgather(
+        np.asarray([int(bool(triggered)), int(step)], np.int64))
+    arr = np.asarray(gathered).reshape(-1, 2)
+    return bool(arr[:, 0].any()), int(arr[:, 1].min())
+
+
 def hybrid_mesh(ici_shape: Dict[str, int], dcn_axis: str,
                 num_slices: Optional[int] = None) -> Mesh:
     """Mesh for multi-slice TPU jobs: ``dcn_axis`` spans slices (hosts),

@@ -53,6 +53,7 @@ class GoodputTimer:
     def __init__(self):
         self._t0 = time.perf_counter()
         self._acc: Dict[str, float] = {p: 0.0 for p in PHASES}
+        self._n: Dict[str, int] = {p: 0 for p in PHASES}
         self._stack = []  # (phase_name, start, inner_time) frames
 
     @contextmanager
@@ -68,13 +69,17 @@ class GoodputTimer:
             _, _, inner = self._stack.pop()
             elapsed = time.perf_counter() - start
             self._acc[name] += elapsed - inner
+            self._n[name] += 1
             if self._stack:  # credit the whole span to the outer frame's
                 self._stack[-1][2] += elapsed  # inner-time ledger
 
     def report(self) -> Dict[str, float]:
         """Breakdown so far: per-phase seconds, ``other`` (unattributed),
         ``wall_s`` (their exact sum), and ``compute_fraction`` —
-        dispatch share of wall, the headline goodput number."""
+        dispatch share of wall, the headline goodput number.  The nested
+        ``phase_n`` entry-count map turns phase totals into per-event
+        numbers — ``checkpoint / phase_n["checkpoint"]`` is the blocking
+        seconds PER SAVE, the async-checkpointing before/after metric."""
         wall = time.perf_counter() - self._t0
         phases = {p: round(t, 6) for p, t in self._acc.items()}
         attributed = sum(phases.values())
@@ -84,6 +89,7 @@ class GoodputTimer:
             "wall_s": round(wall, 6),
             "compute_fraction": round(
                 phases["dispatch"] / wall if wall > 0 else 0.0, 4),
+            "phase_n": {p: n for p, n in self._n.items() if n},
         }
 
 

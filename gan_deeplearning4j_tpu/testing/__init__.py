@@ -1,0 +1,11 @@
+"""Fault-injection / chaos tooling (tests and drills only — nothing in
+the training path imports this package)."""
+
+from gan_deeplearning4j_tpu.testing.chaos import (
+    ChaosInjector,
+    InjectedCrash,
+    NanSource,
+    StallingSource,
+)
+
+__all__ = ["ChaosInjector", "InjectedCrash", "NanSource", "StallingSource"]

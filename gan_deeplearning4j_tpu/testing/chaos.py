@@ -1,0 +1,226 @@
+"""Fault injection — prove the fault-tolerance contract, don't assert it.
+
+The robustness claims of checkpoint/ and train/ (``restart equals never
+failed``, ``no kill point leaves the directory unrestorable``) are only
+claims until something actually kills the process mid-write, corrupts a
+file, stalls the data source, or poisons the gradients.  This module is
+the seeded, deterministic injector that does all four, driven by
+``tests/test_chaos.py``:
+
+* **kill-during-save** — ``ChaosInjector.kill_at_save_event`` hooks the
+  checkpointer's enumerated write/rename points (``checkpointer.
+  _chaos_hook``) and raises ``InjectedCrash`` at the chosen one; the
+  exception carries ``simulates_kill = True`` so the checkpointer skips
+  its graceful temp cleanup and the directory is left exactly as SIGKILL
+  would leave it.  ``count_save_events`` enumerates the points so a test
+  can walk every one.  The subprocess variant (actual ``SIGKILL`` at a
+  seeded moment — no python frames unwound at all) lives in the test.
+* **corrupt-one-file** — flip one seeded byte of one seeded file of a
+  committed checkpoint (silent media corruption); **truncate-file** cuts
+  a seeded tail off (a torn write that survived a crash).  Both must be
+  caught by manifest verification, never loaded.
+* **stall-the-data-source** — ``StallingSource`` wraps any DataSet
+  iterator and blocks inside ``next()`` at a seeded call until released
+  (a hung storage layer); pins that ``PrefetchIterator.close`` neither
+  deadlocks nor loses worker errors.
+* **NaN-into-grads** — ``NanSource`` poisons the features of a seeded
+  batch (the classic bad-record path to non-finite grads), driving the
+  telemetry NaN alarm end to end.
+
+Everything is parameterized by an explicit seed: a chaos failure must
+replay exactly.
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+from typing import List, Optional
+
+import numpy as np
+
+from gan_deeplearning4j_tpu.checkpoint import checkpointer as _ckpt_mod
+
+
+class InjectedCrash(RuntimeError):
+    """A simulated hard kill.  ``simulates_kill`` tells the checkpointer
+    to leave the directory un-cleaned (debris and all), exactly as a
+    real SIGKILL would; the recovery wrapper still classifies it as a
+    retryable failure (it is a RuntimeError, not a config error)."""
+
+    simulates_kill = True
+
+
+class ChaosInjector:
+    """Seeded injector; one instance per test scenario."""
+
+    def __init__(self, seed: int):
+        self.seed = seed
+        self.rng = random.Random(seed)
+
+    # -- kill-during-save ------------------------------------------------------
+
+    def count_save_events(self, save_fn) -> List[str]:
+        """Run ``save_fn()`` with a recording hook; return the ordered
+        list of chaos events it passed (the enumerable kill points)."""
+        events: List[str] = []
+        prev = _ckpt_mod._chaos_hook
+        _ckpt_mod._chaos_hook = events.append
+        try:
+            save_fn()
+        finally:
+            _ckpt_mod._chaos_hook = prev
+        return events
+
+    def kill_at_save_event(self, index: int,
+                           after_times: int = 0) -> "_KillPoint":
+        """Context manager: the ``index``-th chaos event of the
+        (``after_times``+1)-th save inside the block raises
+        ``InjectedCrash``.  ``after_times`` lets a test crash the Nth
+        save of a run while earlier ones succeed."""
+        return _KillPoint(index, after_times)
+
+    # -- corruption ------------------------------------------------------------
+
+    def corrupt_one_file(self, ckpt_dir: str,
+                         exclude_manifest: bool = False) -> tuple:
+        """Flip one seeded byte of one seeded file under ``ckpt_dir``
+        (committed checkpoint).  Returns (path, offset).  With
+        ``exclude_manifest`` the manifest itself stays intact — the
+        harder case: the corruption is only discoverable by hashing."""
+        import os
+
+        files = sorted(
+            f for f in os.listdir(ckpt_dir)
+            if os.path.isfile(os.path.join(ckpt_dir, f))
+            and not (exclude_manifest and f == _ckpt_mod.MANIFEST_NAME))
+        name = self.rng.choice(files)
+        path = os.path.join(ckpt_dir, name)
+        data = bytearray(open(path, "rb").read())
+        off = self.rng.randrange(len(data))
+        data[off] ^= 0xFF
+        with open(path, "wb") as f:
+            f.write(bytes(data))
+        return path, off
+
+    def truncate_file(self, ckpt_dir: str) -> tuple:
+        """Cut a seeded non-empty tail off one seeded data file (torn
+        write).  Returns (path, new_size)."""
+        import os
+
+        files = sorted(
+            f for f in os.listdir(ckpt_dir)
+            if os.path.isfile(os.path.join(ckpt_dir, f))
+            and f != _ckpt_mod.MANIFEST_NAME)
+        name = self.rng.choice(files)
+        path = os.path.join(ckpt_dir, name)
+        size = os.path.getsize(path)
+        new_size = self.rng.randrange(max(1, size))  # strictly shorter
+        with open(path, "rb+") as f:
+            f.truncate(new_size)
+        return path, new_size
+
+    def delete_file(self, ckpt_dir: str, name: str) -> str:
+        """Remove one named file of a committed checkpoint (e.g.
+        ``state.npz`` lost to a filesystem fault)."""
+        import os
+
+        path = os.path.join(ckpt_dir, name)
+        os.remove(path)
+        return path
+
+
+class _KillPoint:
+    def __init__(self, index: int, after_times: int):
+        self.index = index
+        self.after_times = after_times
+        self.fired = False  # one-shot: a killed process stays dead once
+        self._events = 0
+        self._saves_seen = 0
+        self._prev = None
+
+    def _hook(self, event: str) -> None:
+        if self.fired:
+            return  # the "process" already died; later saves (the
+            # restarted run's) proceed normally
+        if self._saves_seen < self.after_times:
+            if event == "post_swap":  # one per completed save
+                self._saves_seen += 1
+            return
+        if self._events == self.index:
+            self.fired = True
+            raise InjectedCrash(
+                f"injected kill at save event #{self.index} ({event!r})")
+        self._events += 1
+
+    def __enter__(self) -> "_KillPoint":
+        self._prev = _ckpt_mod._chaos_hook
+        _ckpt_mod._chaos_hook = self._hook
+        return self
+
+    def __exit__(self, *exc) -> None:
+        _ckpt_mod._chaos_hook = self._prev
+
+
+class StallingSource:
+    """DataSet-iterator wrapper whose ``next()`` blocks at the
+    ``stall_at``-th call until ``release()`` (or forever) — a wedged
+    storage layer under the prefetch worker."""
+
+    def __init__(self, source, stall_at: int):
+        self.source = source
+        self.stall_at = stall_at
+        self.calls = 0
+        self.stalled = threading.Event()   # observable: worker is stuck
+        self._release = threading.Event()
+
+    def release(self) -> None:
+        self._release.set()
+
+    def has_next(self):
+        return self.source.has_next()
+
+    def reset(self):
+        return self.source.reset()
+
+    def next(self):
+        self.calls += 1
+        if self.calls - 1 == self.stall_at:
+            self.stalled.set()
+            self._release.wait()  # block until the test releases us
+        return self.source.next()
+
+    def __getattr__(self, name):
+        return getattr(self.source, name)
+
+
+class NanSource:
+    """DataSet-iterator wrapper that poisons the features of the
+    ``nan_at``-th emitted batch with NaNs (a bad record reaching the
+    gradient path)."""
+
+    def __init__(self, source, nan_at: int,
+                 rng: Optional[random.Random] = None):
+        self.source = source
+        self.nan_at = nan_at
+        self.emitted = 0
+        self.rng = rng or random.Random(0)
+
+    def has_next(self):
+        return self.source.has_next()
+
+    def reset(self):
+        return self.source.reset()
+
+    def next(self):
+        ds = self.source.next()
+        if self.emitted == self.nan_at:
+            feats = np.array(ds.features, copy=True)
+            flat = feats.reshape(-1)
+            flat[self.rng.randrange(flat.size)] = np.nan
+            ds.features = feats
+        self.emitted += 1
+        return ds
+
+    def __getattr__(self, name):
+        return getattr(self.source, name)

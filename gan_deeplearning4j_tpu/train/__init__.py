@@ -12,7 +12,11 @@ from gan_deeplearning4j_tpu.train.gan_trainer import (
     GANTrainerConfig,
     Workload,
 )
+from gan_deeplearning4j_tpu.train.preemption import (
+    PreemptionError,
+    PreemptionGuard,
+)
 
 __all__ = ["EarlyStoppingConfig", "EarlyStoppingGraphTrainer",
            "EarlyStoppingResult", "GANTrainer", "GANTrainerConfig",
-           "Workload"]
+           "PreemptionError", "PreemptionGuard", "Workload"]

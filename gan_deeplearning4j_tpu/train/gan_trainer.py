@@ -39,7 +39,11 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from gan_deeplearning4j_tpu.checkpoint import TrainCheckpointer
+from gan_deeplearning4j_tpu.checkpoint import (
+    AsyncCheckpointer,
+    NoVerifiedCheckpointError,
+    TrainCheckpointer,
+)
 from gan_deeplearning4j_tpu.data import (
     RecordReaderDataSetIterator,
     write_csv_matrix,
@@ -118,6 +122,18 @@ class GANTrainerConfig:
     checkpoint_every: int = 0         # 0 = end-of-run models only
     checkpoint_keep: int = 3
     resume: bool = False
+    # Crash-safe async checkpointing (checkpoint/async_checkpointer.py):
+    # serialize/fsync on a background worker, the training thread pays
+    # only the host snapshot — the goodput ``checkpoint`` phase then
+    # measures the blocking portion alone.  On-disk bytes (manifest
+    # hashes included) are identical to a synchronous save.
+    async_checkpoint: bool = False
+    # Comma-separated signal names ("SIGTERM" / "SIGTERM,SIGUSR1") that
+    # arm the preemption path (train/preemption.py): let the in-flight
+    # call finish, take an emergency checkpoint, write a resumable
+    # PREEMPTED.json marker, raise PreemptionError (mains exit 75).
+    # None = signals keep their inherited behavior.
+    preempt_signals: Optional[str] = None
     metrics: bool = True
     # Generator EMA decay (0 = off).  >0 maintains an exponential moving
     # average of the generator weights inside the fused step; sampling/FID
@@ -141,8 +157,10 @@ class GANTrainerConfig:
     #   "warn"     — log loudly, keep training
     #   "snapshot" — save a forensic checkpoint of the current state to
     #                res_path/nan_snapshot, keep training
-    #   "abort"    — raise NanAlarmError (train_with_recovery treats it
-    #                like any failure: restart from the last checkpoint)
+    #   "abort"    — raise NanAlarmError; train_with_recovery classifies
+    #                it FATAL (deterministic replay from the last
+    #                checkpoint would march straight into the same NaN —
+    #                restarting only burns the budget)
     nan_alarm: Optional[str] = None
 
 
@@ -183,29 +201,87 @@ def _largest_batch_divisor(batch_size: int, limit: int) -> int:
 
 def train_with_recovery(make_trainer: Callable[[bool], "GANTrainer"],
                         max_restarts: int = 2,
-                        log: Callable[[str], None] = print) -> Dict[str, float]:
-    """Failure detection / recovery (SURVEY.md §5): run the trainer; on an
-    exception, rebuild it and resume from the latest checkpoint, up to
-    ``max_restarts`` times.  ``make_trainer(resume)`` constructs a fresh
-    trainer (its config must set ``checkpoint_every`` — without
-    checkpoints a restart replays from step 0, which the deterministic
-    data/PRNG order makes correct but wasteful).  The reference has no
-    recovery story beyond Spark task retries (SURVEY §5); deterministic
-    resume (proven in tests/test_train.py) makes restart-equals-never-
-    failed exact here."""
+                        log: Callable[[str], None] = print,
+                        backoff_base_s: float = 1.0,
+                        backoff_max_s: float = 30.0) -> Dict[str, float]:
+    """Failure detection / recovery (SURVEY.md §5): run the trainer; on a
+    RETRYABLE exception, rebuild it and resume from the latest checkpoint.
+    ``make_trainer(resume)`` constructs a fresh trainer (its config must
+    set ``checkpoint_every`` — without checkpoints a restart replays from
+    step 0, which the deterministic data/PRNG order makes correct but
+    wasteful).  Deterministic resume (tests/test_train.py, chaos suite)
+    makes restart-equals-never-failed exact.
+
+    Error classification — not every failure deserves a restart:
+
+    * FATAL, re-raised immediately: ``ValueError``/``TypeError`` (config
+      errors and checkpoint structure mismatches — a restart replays the
+      identical mistake), ``CheckpointCorruptError`` (an explicitly
+      requested checkpoint is torn; retrying cannot un-tear it) and
+      ``NanAlarmError`` (deterministic replay from the last checkpoint
+      marches into the same NaN — restarting only burns the budget).
+    * ``PreemptionError``: re-raised — the emergency checkpoint is on
+      disk and the host is being evicted; the SCHEDULER restarts the
+      job (mains exit 75 / EX_TEMPFAIL).
+    * Everything else is retryable, with exponential backoff plus
+      jitter (``backoff_base_s * 2^attempt``, capped, x[0.5, 1.5) —
+      a fleet of evicted hosts must not hammer storage in lockstep).
+
+    The restart budget is PROGRESS-AWARE: whenever a failure lands at a
+    later step than the previous one, the run has advanced past the old
+    failure point and the attempt counter resets — one flaky host taxes
+    the run per incident, while a genuine crash-loop (failing at the
+    same step every time) still exhausts ``max_restarts``."""
+    import random as _random
+
+    from gan_deeplearning4j_tpu.checkpoint import CheckpointCorruptError
+    from gan_deeplearning4j_tpu.telemetry import NanAlarmError
+    from gan_deeplearning4j_tpu.train.preemption import PreemptionError
+
     attempt = 0
+    last_failure_step: Optional[int] = None
     while True:
         trainer = make_trainer(attempt > 0)
         try:
             return trainer.train(log=log)
-        except KeyboardInterrupt:
-            raise
-        except Exception as e:  # noqa: BLE001 — any failure is retryable
+        except (KeyboardInterrupt, PreemptionError):
+            raise  # preemption: checkpointed; the scheduler requeues
+        except (ValueError, TypeError, CheckpointCorruptError,
+                NanAlarmError):
+            raise  # fatal class: a restart replays the identical failure
+        except Exception as e:  # noqa: BLE001 — retryable class
+            # quiesce the failed incarnation's checkpoint writer BEFORE
+            # rebuilding: an async save still in flight must become
+            # durable (or surface its error in the log) before the new
+            # trainer's init reclaims temp dirs out from under the old
+            # worker — and close() also reaps the worker thread, which
+            # would otherwise leak one per restart
+            ck_close = getattr(getattr(trainer, "checkpointer", None),
+                               "close", None)
+            if ck_close is not None:
+                try:
+                    ck_close()
+                except Exception as ce:
+                    log(f"checkpoint writer failed during restart "
+                        f"quiesce ({ce!r}); the restart will fall back "
+                        "to the previous verified checkpoint")
+            step = int(getattr(trainer, "batch_counter", 0) or 0)
+            if last_failure_step is not None and step > last_failure_step:
+                attempt = 0  # progress since the last failure: reset budget
+            last_failure_step = step
             attempt += 1
             if attempt > max_restarts:
                 raise
-            log(f"training failed ({e!r}); restart {attempt}/{max_restarts} "
-                "from the latest checkpoint")
+            delay = 0.0
+            if backoff_base_s > 0:
+                delay = min(backoff_max_s,
+                            backoff_base_s * (2 ** (attempt - 1)))
+                delay *= 0.5 + _random.random()  # jitter: x[0.5, 1.5)
+            log(f"training failed ({e!r}) at step {step}; restart "
+                f"{attempt}/{max_restarts} from the latest checkpoint"
+                + (f" after {delay:.1f}s backoff" if delay else ""))
+            if delay:
+                time.sleep(delay)
 
 
 def check_recovery_args(parser, args) -> None:
@@ -256,6 +332,15 @@ class GANTrainer:
                 f"batch_size {config.batch_size} is not divisible by "
                 f"--n-devices {config.n_devices}; shards are exact "
                 f"(largest usable mesh for this batch: {usable})")
+        # validate preemption signals EAGERLY (same fail-before-side-
+        # effects discipline: an unknown name must not surface inside a
+        # preemption grace window)
+        self._preempt_signal_nums = ()
+        self._preempt_guard = None
+        if config.preempt_signals:
+            from gan_deeplearning4j_tpu.train.preemption import parse_signals
+
+            self._preempt_signal_nums = parse_signals(config.preempt_signals)
         os.makedirs(config.res_path, exist_ok=True)
 
         graphs = workload.build_graphs()
@@ -366,13 +451,19 @@ class GANTrainer:
             on_record=(self._nan_alarm.observe if self._nan_alarm
                        else None),
         )
-        self.checkpointer = (
-            TrainCheckpointer(
+        # a checkpointer also exists for resume-only runs and preemption-
+        # armed runs (the emergency save needs somewhere durable to land
+        # even when no periodic cadence was configured)
+        self.checkpointer = None
+        if (config.checkpoint_every or config.resume
+                or self._preempt_signal_nums):
+            ck = TrainCheckpointer(
                 os.path.join(config.res_path, "checkpoints"),
                 keep=config.checkpoint_keep,
             )
-            if config.checkpoint_every else None
-        )
+            if config.async_checkpoint:
+                ck = AsyncCheckpointer(ck)
+            self.checkpointer = ck
 
         # latent evaluation grid: the cartesian product of linspace(-1,1,n)
         # per latent dim, row-major with the first dim outermost — reference
@@ -391,6 +482,8 @@ class GANTrainer:
                 f"ema_decay must be in [0, 1), got {config.ema_decay} "
                 "(1.0 would pin the EMA at initialization forever)")
         self.batch_counter = 0
+        self._final_state = None   # fused-state as of the last dispatch
+        self._final_losses = None
         self.goodput = None       # GoodputTimer, created per train() run
         self.run_manifest = None  # run_manifest.json payload, ditto
         self._test_batches = None
@@ -463,32 +556,126 @@ class GANTrainer:
         return {"dis": self.dis, "gen": self.gen, "gan": self.gan,
                 "classifier": self.classifier}
 
+    def _checkpoint_extra(self) -> Dict:
+        """Run state the graphs' params don't carry.  No RNG state
+        needed: the z-stream is counter-based, derived from
+        batch_counter (the checkpoint step) alone."""
+        extra = {"soften_real": self.soften_real,
+                 "soften_fake": self.soften_fake}
+        # the generator EMA is state the graphs' params don't carry;
+        # without it a crash-resume would silently restart the
+        # trajectory average from the current weights
+        ema = getattr(self.gen, "ema_params", None)
+        if ema is not None:
+            for layer, lp in ema.items():
+                for n, v in lp.items():
+                    extra[f"ema:{layer}:{n}"] = v
+        return extra
+
     def _maybe_checkpoint(self) -> None:
-        if self.checkpointer and self.batch_counter % self.c.checkpoint_every == 0:
+        if (self.checkpointer and self.c.checkpoint_every
+                and self.batch_counter % self.c.checkpoint_every == 0):
             # drain queued artifact writes first: once this checkpoint
             # exists, a crash-resume continues past this step and would
             # never re-create artifacts that were still in the queue
             self._dumper.flush()
-            # no RNG state needed: the z-stream is counter-based, derived
-            # from batch_counter (the checkpoint step) alone
-            extra = {"soften_real": self.soften_real,
-                     "soften_fake": self.soften_fake}
-            # the generator EMA is state the graphs' params don't carry;
-            # without it a crash-resume would silently restart the
-            # trajectory average from the current weights
-            ema = getattr(self.gen, "ema_params", None)
-            if ema is not None:
-                for layer, lp in ema.items():
-                    for n, v in lp.items():
-                        extra[f"ema:{layer}:{n}"] = v
             self.checkpointer.save(
-                self.batch_counter, self._graphs(), extra=extra)
+                self.batch_counter, self._graphs(),
+                extra=self._checkpoint_extra())
+
+    def _emergency_checkpoint(self, directory: Optional[str] = None,
+                              keep: int = 1) -> str:
+        """The ONE "state to disk NOW" mechanism — preemption saves and
+        NaN forensic snapshots both exit through here (a second ad-hoc
+        save path would inevitably drift from the real one).  Captures
+        the state as of the last dispatched step, saves through the run
+        checkpointer (or a dedicated directory, e.g. ``nan_snapshot``)
+        and BARRIERS on async serialization: an emergency save that is
+        not durable when the process exits saved nothing."""
+        if self._fused_step is not None and self._final_state is not None:
+            self._fused_lib.state_to_graphs(
+                self._final_state, self.dis, self.gen, self.gan,
+                self.classifier)
+        if directory is None:
+            ck = self.checkpointer
+            if ck is None:  # no cadence configured: land in the usual spot
+                ck = TrainCheckpointer(
+                    os.path.join(self.c.res_path, "checkpoints"),
+                    keep=self.c.checkpoint_keep)
+                self.checkpointer = ck
+        else:
+            ck = TrainCheckpointer(directory, keep=keep)
+        path = ck.save(self.batch_counter, self._graphs(),
+                       extra=self._checkpoint_extra())
+        wait = getattr(ck, "wait", None)
+        if wait is not None:
+            wait()
+        return path
+
+    def _maybe_preempt(self) -> None:
+        """Boundary poll of the preemption guard: the in-flight fused
+        call has returned, so take the emergency checkpoint, write the
+        resumable marker and leave through ``PreemptionError`` (the
+        recovery wrapper re-raises it; mains exit 75).
+
+        Multi-host: the consensus allgather is entered by EVERY host at
+        every boundary while the guard is armed — ``any_triggered``
+        preempts the whole fleet together, so a single evicted host
+        (partial signal delivery) cannot strand its peers inside a
+        mismatched collective."""
+        guard = self._preempt_guard
+        if guard is None:
+            return
+        if jax.process_count() > 1:
+            from gan_deeplearning4j_tpu.parallel import multihost
+
+            any_trig, agreed = multihost.agree_preemption(
+                guard.triggered, self.batch_counter)
+        else:
+            any_trig, agreed = guard.triggered, self.batch_counter
+        if not any_trig:
+            return
+        if agreed != self.batch_counter:
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "preemption: fleet-agreed step %d != local step %d "
+                "(straggler host)", agreed, self.batch_counter)
+        from gan_deeplearning4j_tpu.train.preemption import preempt_exit
+
+        with self._phase("checkpoint"):
+            path = self._emergency_checkpoint()
+        preempt_exit(self.c.res_path, guard,
+                     local_step=self.batch_counter, fleet_min_step=agreed,
+                     checkpoint=path,
+                     run_id=(self.run_manifest or {}).get("run_id"))
 
     def _maybe_resume(self, iter_train: RecordReaderDataSetIterator) -> None:
-        if not (self.c.resume and self.checkpointer
-                and self.checkpointer.latest_step() is not None):
+        if not (self.c.resume and self.checkpointer):
             return
-        step, extra = self.checkpointer.restore(self._graphs())
+        # a PREEMPTED.json marker from the evicted incarnation is
+        # consumed here — this restart IS the resume it asked for
+        from gan_deeplearning4j_tpu.train.preemption import MARKER_NAME
+
+        marker = os.path.join(self.c.res_path, MARKER_NAME)
+        if os.path.exists(marker):
+            import logging
+
+            logging.getLogger(__name__).info(
+                "resuming a preempted run (consuming %s)", marker)
+            os.remove(marker)
+        try:
+            step, extra = self.checkpointer.restore(self._graphs())
+        except NoVerifiedCheckpointError as e:
+            # restore() already fell back as far as it could; an empty or
+            # fully-torn directory means: start from step 0 (the
+            # deterministic data/PRNG order makes that correct) rather
+            # than crash the restart the checkpoints were meant to enable
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "resume requested but %s; starting from step 0", e)
+            return
         self.batch_counter = step
         self.soften_real = jnp.asarray(extra["soften_real"])
         self.soften_fake = jnp.asarray(extra["soften_fake"])
@@ -523,6 +710,36 @@ class GANTrainer:
     # -- the loop ------------------------------------------------------------
 
     def train(self, log: Callable[[str], None] = print) -> Dict[str, float]:
+        """Run the training loop; with ``preempt_signals`` configured,
+        the whole run is bracketed by the preemption guard (handlers
+        restored on every exit path)."""
+        guard = None
+        if self._preempt_signal_nums:
+            from gan_deeplearning4j_tpu.train.preemption import (
+                PreemptionGuard,
+            )
+
+            guard = PreemptionGuard(self._preempt_signal_nums)
+            try:
+                guard.install()
+            except ValueError:
+                # signal handlers are a main-thread privilege; a trainer
+                # driven from a worker thread trains unguarded, loudly
+                import logging
+
+                logging.getLogger(__name__).warning(
+                    "preempt_signals configured but not on the main "
+                    "thread; preemption guard NOT armed")
+                guard = None
+        self._preempt_guard = guard
+        try:
+            return self._train_impl(log)
+        finally:
+            if guard is not None:
+                guard.uninstall()
+            self._preempt_guard = None
+
+    def _train_impl(self, log: Callable[[str], None]) -> Dict[str, float]:
         c = self.c
         from gan_deeplearning4j_tpu.telemetry import (
             GoodputTimer,
@@ -792,6 +1009,12 @@ class GANTrainer:
                 os.path.join(
                     c.res_path,
                     f"{name}_{self.w.classifier_model_name}_model.zip"))
+            # exit barrier: an async checkpointer's queued save must be
+            # durable before the run reports success (the wait lands in
+            # the checkpoint phase — it IS checkpoint time)
+            ck_wait = getattr(self.checkpointer, "wait", None)
+            if ck_wait is not None:
+                ck_wait()
         # drain + close the logger FIRST (the final flush's readback of
         # up to flush_every stacked records is the run's last big device
         # wait and must be attributed), THEN close the goodput ledger
@@ -1164,6 +1387,7 @@ class GANTrainer:
         if c.checkpoint_every:
             with self._phase("checkpoint"):
                 self._maybe_checkpoint()
+        self._maybe_preempt()
         self._poll_nan_alarm()
 
     def _poll_nan_alarm(self) -> None:
@@ -1188,16 +1412,14 @@ class GANTrainer:
         import logging
 
         logging.getLogger(__name__).warning("%s", msg)
-        if self.c.nan_alarm == "snapshot" and self._final_state is not None:
+        if self.c.nan_alarm == "snapshot":
             # forensic snapshot of the state as of the LAST dispatched
-            # step — the weights/optimizer state a post-mortem wants
-            from gan_deeplearning4j_tpu.checkpoint import TrainCheckpointer
-
+            # step — through the shared emergency-checkpoint mechanism
+            # (one save path, manifest-verified like any checkpoint),
+            # into its own directory so it never collides with the run's
+            # resumable checkpoints
             with self._phase("checkpoint"):
-                if self._fused_step is not None:
-                    self._fused_lib.state_to_graphs(
-                        self._final_state, self.dis, self.gen, self.gan,
-                        self.classifier)
-                TrainCheckpointer(
-                    os.path.join(self.c.res_path, "nan_snapshot"),
-                    keep=1).save(self.batch_counter, self._graphs())
+                self._emergency_checkpoint(
+                    directory=os.path.join(self.c.res_path,
+                                           "nan_snapshot"),
+                    keep=1)

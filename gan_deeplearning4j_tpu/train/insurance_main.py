@@ -98,7 +98,21 @@ def main(argv=None) -> Dict[str, float]:
                         "background artifact writer")
     p.add_argument("--max-restarts", type=int, default=0,
                    help="auto-resume from the latest checkpoint on failure, "
-                        "up to N times (needs --checkpoint-every)")
+                        "up to N times (needs --checkpoint-every); the "
+                        "budget is progress-aware and fatal errors "
+                        "(config/structure mismatch, NaN abort) are not "
+                        "retried (docs/FAULT_TOLERANCE.md)")
+    p.add_argument("--async-checkpoint", action="store_true",
+                   help="serialize/fsync checkpoints on a background "
+                        "worker — the training thread pays only the host "
+                        "snapshot; on-disk bytes (manifest hashes "
+                        "included) identical to the synchronous save")
+    p.add_argument("--preempt-signal", action="append", default=None,
+                   metavar="SIG",
+                   help="signal name (e.g. SIGTERM; repeatable) that "
+                        "triggers an emergency checkpoint + resumable "
+                        "PREEMPTED.json marker, then exit code 75 "
+                        "(EX_TEMPFAIL) — requeue and resume with --resume")
     p.add_argument("--profile", default=None, metavar="DIR",
                    help="capture a jax.profiler trace of the run into DIR")
     from gan_deeplearning4j_tpu.runtime import prng as _prng
@@ -122,9 +136,11 @@ def main(argv=None) -> Dict[str, float]:
                    help="action on the first non-finite step (needs "
                         "--telemetry): warn = log and continue; snapshot "
                         "= save a forensic checkpoint to "
-                        "res-path/nan_snapshot and continue; abort = "
-                        "raise (combines with --max-restarts for "
-                        "restart-from-last-checkpoint)")
+                        "res-path/nan_snapshot (through the emergency-"
+                        "checkpoint path) and continue; abort = raise; "
+                        "the recovery wrapper classifies the abort as "
+                        "FATAL — a deterministic replay would hit the "
+                        "same NaN, so --max-restarts is not burned on it)")
     from gan_deeplearning4j_tpu.runtime import backend
 
     backend.add_bf16_flag(p)
@@ -148,6 +164,9 @@ def main(argv=None) -> Dict[str, float]:
         averaging_frequency=args.averaging_frequency,
         checkpoint_every=args.checkpoint_every,
         resume=args.resume,
+        async_checkpoint=args.async_checkpoint,
+        preempt_signals=(",".join(args.preempt_signal)
+                         if args.preempt_signal else None),
         steps_per_call=args.steps_per_call,
         async_dumps=not args.sync_dumps,
         seed=args.seed,
@@ -161,6 +180,8 @@ def main(argv=None) -> Dict[str, float]:
         from gan_deeplearning4j_tpu.utils.live_ui import serve_for_config
 
         stop_ui = serve_for_config(config, args.live_ui)
+    from gan_deeplearning4j_tpu.train.preemption import PreemptionError
+
     try:
         with maybe_trace(args.profile):
             trainer, result = run_with_recovery(
@@ -169,6 +190,11 @@ def main(argv=None) -> Dict[str, float]:
                     cfg=M.InsuranceConfig(seed=args.seed)),
                 max_restarts=args.max_restarts)
         result.update(evaluate(trainer))
+    except PreemptionError as e:
+        # the emergency checkpoint is durable; report the resumable state
+        # instead of a traceback (cli() exits 75 so the scheduler requeues)
+        result = {"preempted": True, "step": e.step,
+                  "checkpoint": e.checkpoint, "res_path": args.res_path}
     finally:
         if stop_ui is not None:
             stop_ui()  # release the port before the JSON line
@@ -228,11 +254,17 @@ def cli(argv=None) -> None:
     so the setuptools wrapper's sys.exit() sees None (exit status 0),
     and honor JAX_PLATFORMS — a fresh process by definition, so this
     cannot clobber an in-process override (unlike main(), which tests
-    import and call under a conftest-forced CPU platform)."""
+    import and call under a conftest-forced CPU platform).  A preempted
+    run exits 75 (EX_TEMPFAIL): "requeue me", not success or crash."""
+    import sys
+
     from gan_deeplearning4j_tpu.runtime import backend as _backend
+    from gan_deeplearning4j_tpu.train.preemption import EXIT_PREEMPTED
 
     _backend.apply_env_platform()
-    main(argv)
+    result = main(argv)
+    if result.get("preempted"):
+        sys.exit(EXIT_PREEMPTED)
 
 
 if __name__ == "__main__":

@@ -1,0 +1,180 @@
+"""Preemption handling — turn SIGTERM into a checkpoint, not a lost epoch.
+
+Preemptible TPU fleets deliver an eviction warning as a signal (SIGTERM
+on GCE/GKE; some schedulers use SIGUSR1) with a grace window measured in
+seconds.  The reference's answer was Spark task retries — the whole epoch
+replays.  Here a ``PreemptionGuard`` converts the signal into a latched
+flag; the training loop polls it at step/chunk boundaries (i.e. after
+the in-flight fused call has been dispatched and its state captured),
+takes an EMERGENCY checkpoint through the one shared save mechanism
+(``GANTrainer._emergency_checkpoint``), writes a resumable
+``PREEMPTED.json`` marker, and raises ``PreemptionError`` — which the
+recovery wrapper deliberately re-raises (the host is going away;
+restarting in-process would just be killed harder) and the mains turn
+into exit code 75 (EX_TEMPFAIL: "try again", the conventional
+requeue-me status).
+
+The handler itself only sets the flag: no I/O, no locks, nothing
+async-signal-unsafe.  Multi-host jobs run the consensus poll
+(``parallel/multihost.agree_preemption``) on EVERY host at each armed
+boundary — any one signaled host preempts the whole fleet together, and
+the markers record the fleet-agreed (min) step alongside each host's
+local one.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import threading
+import time
+from typing import Dict, Iterable, Optional, Union
+
+# the conventional "temporary failure, requeue me" exit status
+EXIT_PREEMPTED = 75
+
+MARKER_NAME = "PREEMPTED.json"
+
+
+class PreemptionError(RuntimeError):
+    """Training was interrupted by a preemption signal AFTER an emergency
+    checkpoint was committed; the run is resumable (``--resume`` /
+    ``train_with_recovery`` restart) on a replacement host."""
+
+    def __init__(self, msg: str, step: Optional[int] = None,
+                 checkpoint: Optional[str] = None):
+        super().__init__(msg)
+        self.step = step
+        self.checkpoint = checkpoint
+
+
+def _resolve(sig: Union[int, str]) -> int:
+    if isinstance(sig, int):
+        return sig
+    name = sig.strip().upper()
+    if not name.startswith("SIG"):
+        name = "SIG" + name
+    try:
+        return getattr(signal, name)
+    except AttributeError:
+        raise ValueError(
+            f"unknown signal {sig!r} (expected e.g. 'SIGTERM', 'SIGUSR1')"
+        ) from None
+
+
+def parse_signals(spec: Union[str, Iterable[Union[int, str]]]) -> tuple:
+    """``"SIGTERM,SIGUSR1"`` / ``["TERM", signal.SIGUSR1]`` -> signal
+    numbers, validated eagerly (an unknown or uncatchable name must
+    fail at config time, not inside the grace window)."""
+    if isinstance(spec, str):
+        spec = [s for s in spec.split(",") if s.strip()]
+    nums = tuple(_resolve(s) for s in spec)
+    uncatchable = {getattr(signal, n) for n in ("SIGKILL", "SIGSTOP")
+                   if hasattr(signal, n)}
+    for n in nums:
+        if n in uncatchable:
+            raise ValueError(
+                f"unknown signal (uncatchable): "
+                f"{signal.Signals(n).name} cannot have a handler — "
+                "a hard kill is what the checkpoint write protocol "
+                "survives, not what a guard can intercept")
+    return nums
+
+
+def preempt_exit(res_path: str, guard: "PreemptionGuard", *,
+                 local_step: int, fleet_min_step: int,
+                 checkpoint: Optional[str], run_id: Optional[str] = None):
+    """The one exit protocol every preempted trainer shares: write the
+    resumable ``PREEMPTED.json`` marker (fsynced) and raise
+    ``PreemptionError``.  ``step`` in both is the LOCAL step — the step
+    this host's emergency checkpoint actually holds; ``fleet_min_step``
+    records the allreduce consensus (equal under SPMD lockstep), so a
+    straggler mismatch is observable in the marker instead of silently
+    mislabeling the checkpoint."""
+    marker = {
+        "step": local_step,
+        "fleet_min_step": fleet_min_step,
+        "signal": guard.signal_name(),
+        "received_at": guard.received_at,
+        "checkpoint": checkpoint,
+        "run_id": run_id,
+    }
+    mpath = os.path.join(res_path, MARKER_NAME)
+    with open(mpath, "w") as f:
+        json.dump(marker, f, indent=1)
+        f.flush()
+        os.fsync(f.fileno())
+    raise PreemptionError(
+        f"preempted by {guard.signal_name()} at step {local_step}; "
+        f"emergency checkpoint at {checkpoint} (resume with --resume / "
+        "the scheduler's requeue)",
+        step=local_step, checkpoint=checkpoint)
+
+
+class PreemptionGuard:
+    """Latched signal flag with handler install/uninstall.
+
+    ``install()`` replaces the handlers (main thread only — a worker
+    thread cannot install handlers, and ``install`` says so rather than
+    silently not arming).  The previous handlers are restored by
+    ``uninstall()``/context exit; they are NOT chained on delivery —
+    for SIGTERM the inherited handler is usually "terminate", which is
+    exactly what the guard exists to prevent.
+    """
+
+    def __init__(self, signals: Union[str, Iterable] = ("SIGTERM",)):
+        self.signals = parse_signals(signals)
+        self._event = threading.Event()
+        self._prev: Dict[int, object] = {}
+        self.signum: Optional[int] = None
+        self.received_at: Optional[float] = None
+
+    # -- the handler (async-signal-safe: flag only) ---------------------------
+
+    def _handler(self, signum, frame) -> None:
+        if self.signum is None:
+            self.signum = signum
+            self.received_at = time.time()
+        self._event.set()
+
+    @property
+    def triggered(self) -> bool:
+        return self._event.is_set()
+
+    def signal_name(self) -> Optional[str]:
+        if self.signum is None:
+            return None
+        try:
+            return signal.Signals(self.signum).name
+        except ValueError:
+            return str(self.signum)
+
+    # -- lifecycle ------------------------------------------------------------
+
+    def install(self) -> "PreemptionGuard":
+        """Install handlers for every configured signal.  Exception-safe:
+        a failure part-way (e.g. not on the main thread) restores the
+        handlers already swapped before re-raising — a guard that nobody
+        will ever poll must not keep eating SIGTERM."""
+        try:
+            for s in self.signals:
+                self._prev[s] = signal.signal(s, self._handler)
+        except BaseException:
+            self.uninstall()
+            raise
+        return self
+
+    def uninstall(self) -> None:
+        for s, prev in self._prev.items():
+            try:
+                signal.signal(s, prev)
+            except (ValueError, TypeError, OSError):
+                pass  # interpreter teardown / non-main thread
+        self._prev.clear()
+
+    def __enter__(self) -> "PreemptionGuard":
+        return self.install()
+
+    def __exit__(self, *exc) -> None:
+        self.uninstall()

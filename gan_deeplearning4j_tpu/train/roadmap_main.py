@@ -137,8 +137,44 @@ def train(family: str, iterations: int, batch_size: int, res_path: str,
           checkpoint_every: int = 0, checkpoint_keep: int = 3,
           resume: bool = False,
           steps_per_call: int = None, lr_decay_steps: int = None,
-          ms_weight: float = 0.0,
-          fidelity_steps: int = 400, log=print) -> Dict[str, float]:
+          ms_weight: float = 0.0, fidelity_steps: int = 400,
+          async_checkpoint: bool = False, preempt_signals: str = None,
+          log=print) -> Dict[str, float]:
+    """Train one roadmap family end to end.  ``async_checkpoint`` /
+    ``preempt_signals`` carry the protocol trainer's fault-tolerance
+    semantics (docs/FAULT_TOLERANCE.md): background-serialized
+    manifest-verified checkpoints, and signal-triggered emergency save +
+    resumable marker + ``PreemptionError``."""
+    guard = None
+    if preempt_signals:
+        from gan_deeplearning4j_tpu.train.preemption import PreemptionGuard
+
+        guard = PreemptionGuard(preempt_signals)
+        try:
+            guard.install()
+        except ValueError:
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "preempt_signals configured but not on the main thread; "
+                "preemption guard NOT armed")
+            guard = None
+    try:
+        return _train_impl(
+            family, iterations, batch_size, res_path, n_train, print_every,
+            n_devices, data_dir, ema_decay, checkpoint_every,
+            checkpoint_keep, resume, steps_per_call, lr_decay_steps,
+            ms_weight, fidelity_steps, async_checkpoint, guard, log)
+    finally:
+        if guard is not None:
+            guard.uninstall()
+
+
+def _train_impl(family, iterations, batch_size, res_path, n_train,
+                print_every, n_devices, data_dir, ema_decay,
+                checkpoint_every, checkpoint_keep, resume, steps_per_call,
+                lr_decay_steps, ms_weight, fidelity_steps,
+                async_checkpoint, guard, log) -> Dict[str, float]:
     from gan_deeplearning4j_tpu.telemetry import (
         GoodputTimer,
         write_run_manifest,
@@ -228,15 +264,35 @@ def train(family: str, iterations: int, batch_size: int, res_path: str,
 
         ckpt = None
         start_it = 0
-        if checkpoint_every or resume:
-            from gan_deeplearning4j_tpu.checkpoint import TrainCheckpointer
+        if checkpoint_every or resume or guard is not None:
+            from gan_deeplearning4j_tpu.checkpoint import (
+                AsyncCheckpointer,
+                NoVerifiedCheckpointError,
+                TrainCheckpointer,
+            )
 
             ckpt = TrainCheckpointer(os.path.join(res_path,
                                                   f"{family}_ckpt"),
                                      keep=checkpoint_keep)
-            if resume and ckpt.latest_step() is not None:
-                start_it, extra = ckpt.restore(
-                    {"gen": pair.gen, "dis": pair.dis})
+            if async_checkpoint:
+                ckpt = AsyncCheckpointer(ckpt)
+            if resume:
+                from gan_deeplearning4j_tpu.train.preemption import (
+                    MARKER_NAME,
+                )
+
+                marker = os.path.join(res_path, MARKER_NAME)
+                if os.path.exists(marker):
+                    log(f"[{family}] resuming a preempted run "
+                        f"(consuming {marker})")
+                    os.remove(marker)
+                try:
+                    start_it, extra = ckpt.restore(
+                        {"gen": pair.gen, "dis": pair.dis})
+                except NoVerifiedCheckpointError:
+                    start_it, extra = 0, {}
+                    log(f"[{family}] resume requested but no verified "
+                        "checkpoint; starting from iteration 0")
                 if "ema" in extra:
                     if not ema_decay:
                         raise ValueError(
@@ -245,8 +301,9 @@ def train(family: str, iterations: int, batch_size: int, res_path: str,
                             "(resuming without it would freeze the EMA "
                             "and mislabel the final gen_ema artifacts)")
                     pair.gen.ema_params = extra["ema"]
-                log(f"[{family}] resumed from checkpoint at "
-                    f"iteration {start_it}")
+                if start_it:
+                    log(f"[{family}] resumed from checkpoint at "
+                        f"iteration {start_it}")
 
         # the resumed run APPENDS to its own metrics history rather than
         # truncating the pre-crash records
@@ -264,7 +321,7 @@ def train(family: str, iterations: int, batch_size: int, res_path: str,
         cap = min(MAX_STEPS_PER_CALL, steps_per_call or MAX_STEPS_PER_CALL)
         K = max(d for d in range(1, min(cap, g) + 1) if g % d == 0)
 
-        def save_ckpt(it: int) -> None:
+        def save_ckpt(it: int) -> str:
             # EMA rides as a pytree extra (write_model only carries
             # params+updater); the counter-based z stream makes saved-RNG
             # state unnecessary (start_step seeds the draws)
@@ -272,7 +329,8 @@ def train(family: str, iterations: int, batch_size: int, res_path: str,
             ema = getattr(pair.gen, "ema_params", None)
             if ema is not None:
                 extra["ema"] = ema
-            ckpt.save(it, {"gen": pair.gen, "dis": pair.dis}, extra=extra)
+            return ckpt.save(it, {"gen": pair.gen, "dis": pair.dis},
+                             extra=extra)
 
         step_fn, state = pair.make_multistep(
             jnp.asarray(x), None if y is None else jnp.asarray(y),
@@ -310,6 +368,34 @@ def train(family: str, iterations: int, batch_size: int, res_path: str,
                 with goodput.phase("checkpoint"):
                     dumper.flush()  # pending artifacts land first
                     save_ckpt(it)
+            if guard is not None:
+                # chunk finished: the consensus poll runs on EVERY host
+                # each chunk while armed (a conditionally-entered
+                # collective would strand a partially-signaled fleet);
+                # any triggered host preempts the whole fleet through
+                # the shared exit protocol (train/preemption.py)
+                if jax.process_count() > 1:
+                    from gan_deeplearning4j_tpu.parallel import multihost
+
+                    any_trig, agreed = multihost.agree_preemption(
+                        guard.triggered, it)
+                else:
+                    any_trig, agreed = guard.triggered, it
+                if any_trig:
+                    from gan_deeplearning4j_tpu.train.preemption import (
+                        preempt_exit,
+                    )
+
+                    pair.adopt_state(state)
+                    with goodput.phase("checkpoint"):
+                        dumper.flush()
+                        path = save_ckpt(it)
+                        w = getattr(ckpt, "wait", None)
+                        if w is not None:
+                            w()  # emergency saves must be durable
+                    preempt_exit(res_path, guard, local_step=it,
+                                 fleet_min_step=agreed, checkpoint=path,
+                                 run_id=manifest["run_id"])
         pair.adopt_state(state)
         iterations = it
         if getattr(pair.gen, "ema_params", None) is not None:
@@ -331,6 +417,13 @@ def train(family: str, iterations: int, batch_size: int, res_path: str,
     with goodput.phase("readback"):
         metrics.flush(wait=True)
         metrics.close()
+    if ckpt is not None:
+        ckpt_wait = getattr(ckpt, "wait", None)
+        if ckpt_wait is not None:
+            # exit barrier: queued async saves become durable before the
+            # run reports success
+            with goodput.phase("checkpoint"):
+                ckpt_wait()
     gp = goodput.report()
     metrics.log_record({"goodput": gp, "run_id": manifest["run_id"]})
     metrics.flush()
@@ -437,7 +530,18 @@ def main(argv=None) -> Dict[str, float]:
                    help="periodic atomic checkpoints every N iterations "
                         "(aligned to scan chunks)")
     p.add_argument("--resume", action="store_true",
-                   help="resume from the latest checkpoint in res-path")
+                   help="resume from the latest VERIFIED checkpoint in "
+                        "res-path (torn/corrupt ones are skipped)")
+    p.add_argument("--async-checkpoint", action="store_true",
+                   help="serialize/fsync checkpoints on a background "
+                        "worker — the training thread pays only the host "
+                        "snapshot; identical on-disk bytes")
+    p.add_argument("--preempt-signal", action="append", default=None,
+                   metavar="SIG",
+                   help="signal name (e.g. SIGTERM; repeatable) that "
+                        "triggers an emergency checkpoint + resumable "
+                        "PREEMPTED.json marker, then exit code 75 "
+                        "(EX_TEMPFAIL) — requeue and resume with --resume")
     p.add_argument("--lr-decay-steps", type=int, default=None,
                    help="hold-then-decay LR horizon for both networks "
                         "(cgan-cifar10; mitigates but does not fix the "
@@ -464,14 +568,27 @@ def main(argv=None) -> Dict[str, float]:
     if args.mp:
         backend.configure(compute_bf16=True)
     res = args.res_path or os.path.join("outputs", args.family)
-    result = train(args.family, args.iterations, args.batch_size, res,
-                   args.n_train, args.print_every, args.n_devices,
-                   data_dir=args.data_dir, ema_decay=args.ema_decay,
-                   checkpoint_every=args.checkpoint_every,
-                   resume=args.resume, steps_per_call=args.steps_per_call,
-                   lr_decay_steps=args.lr_decay_steps,
-                   ms_weight=args.ms_weight,
-                   fidelity_steps=args.fidelity_steps)
+    from gan_deeplearning4j_tpu.train.preemption import PreemptionError
+
+    try:
+        result = train(args.family, args.iterations, args.batch_size, res,
+                       args.n_train, args.print_every, args.n_devices,
+                       data_dir=args.data_dir, ema_decay=args.ema_decay,
+                       checkpoint_every=args.checkpoint_every,
+                       resume=args.resume,
+                       steps_per_call=args.steps_per_call,
+                       lr_decay_steps=args.lr_decay_steps,
+                       ms_weight=args.ms_weight,
+                       fidelity_steps=args.fidelity_steps,
+                       async_checkpoint=args.async_checkpoint,
+                       preempt_signals=(",".join(args.preempt_signal)
+                                        if args.preempt_signal else None))
+    except PreemptionError as e:
+        # the emergency checkpoint is durable; report the resumable state
+        # instead of a traceback (cli() exits 75 so the scheduler requeues)
+        result = {"family": args.family, "preempted": True,
+                  "step": e.step, "checkpoint": e.checkpoint,
+                  "res_path": res}
     import json
 
     # one JSON line (numpy scalars coerced) — machine-consumable, cf.
@@ -484,11 +601,16 @@ def cli(argv=None) -> None:
     """Console-script / python -m entry: honor JAX_PLATFORMS — a fresh
     process by definition, so this cannot clobber an in-process override
     (unlike main(), which tests import and call under a conftest-forced
-    CPU platform)."""
+    CPU platform).  A preempted run exits 75 (EX_TEMPFAIL)."""
+    import sys
+
     from gan_deeplearning4j_tpu.runtime import backend as _backend
+    from gan_deeplearning4j_tpu.train.preemption import EXIT_PREEMPTED
 
     _backend.apply_env_platform()
-    main(argv)
+    result = main(argv)
+    if result.get("preempted"):
+        sys.exit(EXIT_PREEMPTED)
 
 
 if __name__ == "__main__":

@@ -1,0 +1,865 @@
+"""Fault-injection (chaos) suite — the fault-tolerance contract under
+actual faults, not docstrings (testing/chaos.py; docs/FAULT_TOLERANCE.md).
+
+Fast tier (runs in the CI chaos lane AND tier-1):
+  * every enumerated kill point during ``save()`` leaves a restorable
+    directory (first-save and re-save/swap cases, plus real SIGKILL of a
+    subprocess mid-save);
+  * corruption (byte flip / truncation / missing file) is caught by the
+    manifest and restore falls back to the previous verified checkpoint;
+  * the async checkpointer writes byte-identical artifacts and surfaces
+    worker faults;
+  * ``PrefetchIterator.close`` during an active/stalled worker neither
+    deadlocks nor drops a worker exception;
+  * recovery classification: fatal vs retryable vs preemption, plus the
+    progress-aware restart budget.
+
+Slow tier (full suite): end-to-end restart-equals-never-failed with a
+crash injected MID-CHECKPOINT-WRITE, and SIGTERM-driven emergency
+checkpoint + resume to the bit-identical end state.
+
+Every test is seeded; a watchdog fixture bounds each test so an injected
+deadlock fails instead of hanging the runner (CHAOS_TEST_TIMEOUT, s).
+"""
+
+import json
+import os
+import shutil
+import signal
+import subprocess
+import sys
+import textwrap
+import time
+
+import numpy as np
+import pytest
+
+from gan_deeplearning4j_tpu.checkpoint import (
+    AsyncCheckpointer,
+    CheckpointCorruptError,
+    NoVerifiedCheckpointError,
+    TrainCheckpointer,
+)
+from gan_deeplearning4j_tpu.checkpoint.checkpointer import MANIFEST_NAME
+from gan_deeplearning4j_tpu.testing import (
+    ChaosInjector,
+    InjectedCrash,
+    StallingSource,
+)
+
+SEED = 666
+
+
+@pytest.fixture(autouse=True)
+def _watchdog():
+    """Per-test deadline: an injected deadlock must FAIL the test, not
+    hang the runner (the CI chaos lane sets CHAOS_TEST_TIMEOUT)."""
+    limit = int(os.environ.get("CHAOS_TEST_TIMEOUT", "300"))
+    if not hasattr(signal, "SIGALRM"):  # non-POSIX: rely on lane timeout
+        yield
+        return
+
+    def on_alarm(signum, frame):
+        raise TimeoutError(f"chaos test exceeded {limit}s watchdog")
+
+    prev = signal.signal(signal.SIGALRM, on_alarm)
+    signal.alarm(limit)
+    try:
+        yield
+    finally:
+        signal.alarm(0)
+        signal.signal(signal.SIGALRM, prev)
+
+
+def _graph():
+    from gan_deeplearning4j_tpu.models import mlpgan_insurance as M
+
+    return M.build_discriminator()
+
+
+def _extra():
+    return {"note": "x", "arr": np.arange(8, dtype=np.float32)}
+
+
+def _assert_restorable(directory, expect_steps):
+    """A fresh checkpointer over ``directory`` (init reclaims debris)
+    must restore SOME verified checkpoint, at one of ``expect_steps``."""
+    ck = TrainCheckpointer(directory)
+    g = _graph()
+    step, extra = ck.restore({"dis": g})
+    assert step in expect_steps
+    assert extra["note"] == "x"
+    np.testing.assert_array_equal(extra["arr"],
+                                  np.arange(8, dtype=np.float32))
+    # no debris left behind either way
+    assert not [n for n in os.listdir(directory)
+                if n.startswith((".ckpt_tmp_", ".ckpt_del_"))]
+    return step
+
+
+# -- kill-during-save: every enumerated point -------------------------------
+
+
+def test_every_first_save_kill_point_restorable(tmp_path):
+    """Checkpoint at step 2 committed, then a kill at EVERY enumerated
+    write/rename point of the step-4 save: restore must always succeed
+    (step 4 when the kill hit after the bytes were complete — the
+    adopted-orphan path — else step 2)."""
+    inj = ChaosInjector(SEED)
+    base = tmp_path / "base"
+    ck0 = TrainCheckpointer(str(base), keep=10)
+    g = _graph()
+    ck0.save(2, {"dis": g}, extra=_extra())
+    events = inj.count_save_events(
+        lambda: ck0.save(4, {"dis": g}, extra=_extra()))
+    shutil.rmtree(str(base / "ckpt_4"))  # keep only the step-2 state
+    assert len(events) >= 5  # per-file writes, manifest, swap points
+
+    for k in range(len(events)):
+        d = str(tmp_path / f"kill_{k}")
+        shutil.copytree(str(base), d)
+        ck = TrainCheckpointer(d, keep=10)
+        with inj.kill_at_save_event(k) as kp:
+            with pytest.raises(InjectedCrash):
+                ck.save(4, {"dis": g}, extra=_extra())
+        assert kp.fired
+        step = _assert_restorable(d, {2, 4})
+        if events[k] in ("post_swap",):
+            assert step == 4  # the rename committed before the kill
+
+
+def test_every_resave_kill_point_restorable(tmp_path):
+    """Re-saving an EXISTING step exercises the rename/rename/rmtree
+    swap (the availability window the old rmtree-then-rename code had):
+    a kill at any point must leave step 2 restorable — from the old
+    copy, the new copy, or an adopted orphan of either."""
+    inj = ChaosInjector(SEED + 1)
+    base = tmp_path / "base"
+    ck0 = TrainCheckpointer(str(base), keep=10)
+    g = _graph()
+    ck0.save(2, {"dis": g}, extra=_extra())
+    events = inj.count_save_events(
+        lambda: ck0.save(2, {"dis": g}, extra=_extra()))
+    assert "mid_swap" in events  # the swap path really ran
+
+    for k in range(len(events)):
+        d = str(tmp_path / f"kill_{k}")
+        shutil.copytree(str(base), d)
+        with inj.kill_at_save_event(k):
+            with pytest.raises(InjectedCrash):
+                TrainCheckpointer(d, keep=10).save(
+                    2, {"dis": g}, extra=_extra())
+        _assert_restorable(d, {2})
+
+
+def test_sigkill_subprocess_mid_save_restorable(tmp_path):
+    """The real thing: SIGKILL (no python frames unwound, no cleanup) at
+    a seeded moment while a subprocess loops checkpoint saves.  After at
+    least one committed save, the directory must always restore."""
+    script = textwrap.dedent("""
+        import sys
+
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")  # as tests/conftest.py
+
+        import numpy as np
+
+        from gan_deeplearning4j_tpu.checkpoint import TrainCheckpointer
+        from gan_deeplearning4j_tpu.models import mlpgan_insurance as M
+
+        ck = TrainCheckpointer(sys.argv[1], keep=3)
+        g = M.build_discriminator()
+        extra = {"note": "x", "arr": np.arange(8, dtype=np.float32)}
+        ck.save(1, {"dis": g}, extra=extra)
+        print("READY", flush=True)
+        step = 2
+        while True:
+            ck.save(step, {"dis": g}, extra=extra)
+            step += 1
+    """)
+    inj = ChaosInjector(SEED + 2)
+    for trial in range(2):
+        d = str(tmp_path / f"trial_{trial}")
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        proc = subprocess.Popen(
+            [sys.executable, "-c", script, d], stdout=subprocess.PIPE,
+            text=True, env=env)
+        try:
+            line = proc.stdout.readline()
+            assert line.strip() == "READY"
+            time.sleep(inj.rng.uniform(0.0, 0.25))  # land mid-save
+            proc.kill()
+            proc.wait(timeout=30)
+        finally:
+            if proc.poll() is None:
+                proc.kill()
+        ck = TrainCheckpointer(d)
+        g = _graph()
+        step, extra = ck.restore({"dis": g})
+        assert step >= 1
+        assert extra["note"] == "x"
+
+
+# -- corruption: manifest-verified fallback ---------------------------------
+
+
+def test_corrupt_one_file_falls_back(tmp_path):
+    """One flipped byte in any file of the newest checkpoint (manifest
+    intact — only hashing can catch it): restore falls back to the
+    previous verified step; an EXPLICIT request for the corrupt step
+    raises instead of silently substituting."""
+    for seed in range(4):  # seeded choice covers different victim files
+        d = str(tmp_path / f"s{seed}")
+        ck = TrainCheckpointer(d, keep=10)
+        g = _graph()
+        ck.save(2, {"dis": g}, extra=_extra())
+        ck.save(4, {"dis": g}, extra=_extra())
+        ChaosInjector(seed).corrupt_one_file(
+            os.path.join(d, "ckpt_4"), exclude_manifest=True)
+        assert not ck.verify(4)
+        assert ck.verify(2)
+        assert ck.latest_verified_step() == 2
+        g2 = _graph()
+        step, _ = ck.restore({"dis": g2})
+        assert step == 2
+        with pytest.raises(CheckpointCorruptError):
+            ck.restore({"dis": _graph()}, step=4)
+
+
+def test_truncated_and_missing_state_npz_fall_back(tmp_path):
+    """state.npz TRUNCATED (torn write) vs MISSING (lost file): both are
+    detected by verification and restore falls back — the resume edge
+    cases the manifest exists for."""
+    inj = ChaosInjector(SEED + 3)
+    for fault in ("truncate", "missing"):
+        d = str(tmp_path / fault)
+        ck = TrainCheckpointer(d, keep=10)
+        g = _graph()
+        ck.save(2, {"dis": g}, extra=_extra())
+        ck.save(4, {"dis": g}, extra=_extra())
+        if fault == "truncate":
+            path, _ = inj.truncate_file(os.path.join(d, "ckpt_4"))
+        else:
+            inj.delete_file(os.path.join(d, "ckpt_4"), "state.npz")
+        assert not ck.verify(4)
+        g2 = _graph()
+        step, extra = ck.restore({"dis": g2})
+        assert step == 2
+        np.testing.assert_array_equal(extra["arr"],
+                                      np.arange(8, dtype=np.float32))
+
+
+def test_resave_swap_kill_adopts_the_newer_copy(tmp_path):
+    """Kill between the two swap renames of a re-save: BOTH copies of
+    the step survive as orphans; init must adopt the NEWER (.ckpt_tmp_)
+    bytes, not the superseded .ckpt_del_ copy — if the re-save changed
+    content, resuming from the stale copy would silently undo it."""
+    inj = ChaosInjector(SEED + 6)
+    d = str(tmp_path)
+    ck = TrainCheckpointer(d, keep=10)
+    g = _graph()
+    ck.save(2, {"dis": g}, extra={"note": "old", "arr": np.zeros(2)})
+    events = inj.count_save_events(
+        lambda: ck.save(2, {"dis": g},
+                        extra={"note": "old", "arr": np.zeros(2)}))
+    k = events.index("mid_swap")
+    with inj.kill_at_save_event(k):
+        with pytest.raises(InjectedCrash):
+            ck.save(2, {"dis": g}, extra={"note": "new",
+                                          "arr": np.ones(2)})
+    assert not os.path.exists(os.path.join(d, "ckpt_2"))  # both orphaned
+    step, extra = TrainCheckpointer(d).restore({"dis": _graph()})
+    assert step == 2
+    assert extra["note"] == "new"  # the fully-fsynced replacement won
+
+
+def test_restore_missing_explicit_step_is_not_found_not_corrupt(tmp_path):
+    ck = TrainCheckpointer(str(tmp_path), keep=10)
+    ck.save(2, {"dis": _graph()}, extra=_extra())
+    with pytest.raises(FileNotFoundError):
+        ck.restore({"dis": _graph()}, step=5)  # absent, NOT "corrupt"
+
+
+def test_legacy_pre_manifest_checkpoint_still_restores(tmp_path):
+    """A checkpoint written BEFORE the manifest format (no MANIFEST.json
+    but a committed state.json) is unverifiable, not corrupt: restore
+    accepts it — an upgrade must not silently discard a long run — while
+    a verified checkpoint, when present, still wins."""
+    d = str(tmp_path)
+    ck = TrainCheckpointer(d, keep=10)
+    g = _graph()
+    ck.save(4, {"dis": g}, extra=_extra())
+    os.remove(os.path.join(d, "ckpt_4", MANIFEST_NAME))  # legacy layout
+    assert not ck.verify(4)
+    step, extra = ck.restore({"dis": _graph()})  # fallback tier
+    assert step == 4 and extra["note"] == "x"
+    step, _ = ck.restore({"dis": _graph()}, step=4)  # explicit request
+    assert step == 4
+    # a verified checkpoint outranks a NEWER legacy one
+    ck.save(2, {"dis": g}, extra=_extra())
+    step, _ = ck.restore({"dis": _graph()})
+    assert step == 2
+
+
+def test_all_checkpoints_corrupt_raises_no_verified(tmp_path):
+    d = str(tmp_path)
+    ck = TrainCheckpointer(d, keep=10)
+    g = _graph()
+    ck.save(2, {"dis": g}, extra=_extra())
+    ChaosInjector(SEED).corrupt_one_file(os.path.join(d, "ckpt_2"),
+                                         exclude_manifest=True)
+    with pytest.raises(NoVerifiedCheckpointError):
+        ck.restore({"dis": _graph()})
+
+
+def test_resume_falls_back_to_step_zero_on_torn_only_checkpoint(tmp_path):
+    """Trainer-level: --resume with the ONLY checkpoint torn must start
+    from step 0 (deterministic replay), not crash the restart."""
+    from gan_deeplearning4j_tpu.train.gan_trainer import GANTrainer
+    from gan_deeplearning4j_tpu.train.insurance_main import (
+        InsuranceWorkload,
+        default_config,
+    )
+
+    d = str(tmp_path)
+    t = GANTrainer(InsuranceWorkload(), default_config(
+        num_iterations=2, res_path=d, checkpoint_every=2, metrics=False))
+    ckdir = os.path.join(d, "checkpoints")
+    t.checkpointer.save(2, t._graphs(), extra=t._checkpoint_extra())
+    ChaosInjector(SEED).corrupt_one_file(
+        os.path.join(ckdir, "ckpt_2"), exclude_manifest=True)
+    t2 = GANTrainer(InsuranceWorkload(), default_config(
+        num_iterations=2, res_path=d, checkpoint_every=2, resume=True,
+        metrics=False))
+    t2._maybe_resume(iter_train=None)  # must not touch the iterator
+    assert t2.batch_counter == 0
+
+
+# -- async checkpointer ------------------------------------------------------
+
+
+def test_async_sync_saves_byte_identical(tmp_path):
+    """The async path commits EXACTLY the bytes the sync path commits —
+    same manifest (sizes + SHA-256) for the same state."""
+    g = _graph()
+    sync = TrainCheckpointer(str(tmp_path / "sync"), keep=5)
+    sync.save(3, {"dis": g}, extra=_extra())
+    with AsyncCheckpointer(
+            TrainCheckpointer(str(tmp_path / "async"), keep=5)) as ack:
+        ack.save(3, {"dis": g}, extra=_extra())
+        ack.wait()
+
+    def manifest(root):
+        with open(os.path.join(str(tmp_path), root, "ckpt_3",
+                               MANIFEST_NAME)) as f:
+            return json.load(f)["files"]
+
+    assert manifest("sync") == manifest("async")
+
+
+def test_async_checkpointer_surfaces_worker_fault(tmp_path):
+    """A fault during background serialization re-raises on the training
+    thread at the next barrier — never a silent gap in the history."""
+    inj = ChaosInjector(SEED + 4)
+    g = _graph()
+    ack = AsyncCheckpointer(TrainCheckpointer(str(tmp_path), keep=5))
+    with inj.kill_at_save_event(1):
+        ack.save(2, {"dis": g}, extra=_extra())
+        with pytest.raises(InjectedCrash):
+            ack.wait()
+    # the wrapper stays usable; the NEXT save commits normally
+    ack.save(4, {"dis": g}, extra=_extra())
+    ack.close()
+    assert TrainCheckpointer(str(tmp_path)).latest_verified_step() == 4
+
+
+def test_async_restore_sees_queued_save(tmp_path):
+    """Reads barrier on the writer: latest_step()/restore() immediately
+    after save() observe the queued checkpoint, not a torn directory."""
+    g = _graph()
+    with AsyncCheckpointer(TrainCheckpointer(str(tmp_path))) as ack:
+        ack.save(7, {"dis": g}, extra=_extra())
+        assert ack.latest_step() == 7
+        assert ack.verify(7)
+        step, _ = ack.restore({"dis": _graph()})
+        assert step == 7
+
+
+# -- prefetch close vs active worker (satellite: data/prefetch.py) ----------
+
+
+class _ListSource:
+    """Minimal has_next/next/reset DataSet iterator over arrays."""
+
+    def __init__(self, n=8, rows=4, fail_at=None):
+        self.n = n
+        self.rows = rows
+        self.fail_at = fail_at
+        self.i = 0
+
+    def has_next(self):
+        return self.i < self.n
+
+    def reset(self):
+        self.i = 0
+
+    def next(self):
+        from gan_deeplearning4j_tpu.data.csv import DataSet
+
+        if self.fail_at is not None and self.i == self.fail_at:
+            raise RuntimeError("injected decode failure")
+        self.i += 1
+        return DataSet(np.full((self.rows, 3), self.i, np.float32),
+                       np.zeros((self.rows, 1), np.float32))
+
+
+def test_prefetch_close_during_stalled_worker_no_deadlock(tmp_path):
+    """close() while the worker is wedged INSIDE source.next() (hung
+    storage) must return promptly — the join gives up, the daemon worker
+    dies with the process."""
+    from gan_deeplearning4j_tpu.data.prefetch import PrefetchIterator
+
+    # stall at the SECOND next() call: the first batch fills the depth-1
+    # queue, so the worker is inside source.next() when we close
+    src = StallingSource(_ListSource(n=8), stall_at=1)
+    it = PrefetchIterator(src, prefetch_depth=1)
+    assert src.stalled.wait(timeout=10)  # worker is stuck in next()
+    t0 = time.perf_counter()
+    it.close(timeout=0.5)
+    assert time.perf_counter() - t0 < 5.0  # no deadlock, bounded
+    src.release()  # let the daemon thread exit cleanly
+
+
+def test_prefetch_close_while_worker_putting_no_deadlock(tmp_path):
+    """close() racing a worker blocked in put() on a FULL queue (the
+    consumer never read): the stop flag breaks the worker's put loop and
+    close returns; repeated for many seeds to shake the race."""
+    from gan_deeplearning4j_tpu.data.prefetch import PrefetchIterator
+
+    for trial in range(20):
+        it = PrefetchIterator(_ListSource(n=64), prefetch_depth=1)
+        time.sleep(0.001 * (trial % 3))  # vary the interleaving
+        t0 = time.perf_counter()
+        it.close(timeout=2.0)
+        assert time.perf_counter() - t0 < 5.0
+        assert not it._thread.is_alive()
+
+
+def test_prefetch_close_never_drops_worker_exception(tmp_path):
+    """A decode error raised by the worker survives close()'s queue
+    drain: preserved on ``.error`` (and raised by a late __next__), even
+    when the consumer never read a single item."""
+    from gan_deeplearning4j_tpu.data.prefetch import PrefetchIterator
+
+    src = _ListSource(n=8, fail_at=1)
+    it = PrefetchIterator(src, prefetch_depth=1)
+    it._thread.join(timeout=10)  # worker died on the injected failure
+    it.close()
+    assert isinstance(it.error, RuntimeError)
+
+    # and the consumer-facing path still raises it after close
+    src = _ListSource(n=8, fail_at=0)
+    it = PrefetchIterator(src, prefetch_depth=1)
+    it._thread.join(timeout=10)
+    it.close()
+    with pytest.raises(RuntimeError, match="injected decode failure"):
+        while True:
+            next(it)
+
+
+# -- recovery classification + budget ---------------------------------------
+
+
+class _FakeTrainer:
+    def __init__(self, exc, step):
+        self._exc = exc
+        self.batch_counter = step
+
+    def train(self, log=print):
+        if self._exc is None:
+            return {"steps": self.batch_counter}
+        raise self._exc
+
+
+def test_recovery_fatal_errors_not_retried():
+    from gan_deeplearning4j_tpu.telemetry import NanAlarmError
+    from gan_deeplearning4j_tpu.train.gan_trainer import train_with_recovery
+    from gan_deeplearning4j_tpu.train.preemption import PreemptionError
+
+    for exc in (ValueError("structure mismatch"),
+                TypeError("bad config"),
+                NanAlarmError("nan at step 3"),
+                CheckpointCorruptError("ckpt_4 torn"),
+                PreemptionError("preempted", step=2)):
+        calls = []
+
+        def make(resume, exc=exc):
+            calls.append(resume)
+            return _FakeTrainer(exc, 0)
+
+        with pytest.raises(type(exc)):
+            train_with_recovery(make, max_restarts=5,
+                                log=lambda s: None, backoff_base_s=0)
+        assert calls == [False]  # ONE attempt: no restart burned
+
+
+def test_recovery_progress_aware_budget():
+    """Failures at ADVANCING steps reset the budget (flaky-host tax per
+    incident); failures at the SAME step exhaust it (crash loop)."""
+    from gan_deeplearning4j_tpu.train.gan_trainer import train_with_recovery
+
+    # 4 advancing failures with max_restarts=1: budget keeps resetting
+    seq = [(RuntimeError("f"), 2), (RuntimeError("f"), 4),
+           (RuntimeError("f"), 6), (RuntimeError("f"), 8), (None, 10)]
+    it = iter(seq)
+
+    def make(resume):
+        exc, step = next(it)
+        return _FakeTrainer(exc, step)
+
+    res = train_with_recovery(make, max_restarts=1, log=lambda s: None,
+                              backoff_base_s=0)
+    assert res == {"steps": 10}
+
+    # crash loop at the SAME step: budget exhausts at max_restarts
+    attempts = []
+
+    def make_loop(resume):
+        attempts.append(resume)
+        return _FakeTrainer(RuntimeError("loop"), 5)
+
+    with pytest.raises(RuntimeError, match="loop"):
+        train_with_recovery(make_loop, max_restarts=2,
+                            log=lambda s: None, backoff_base_s=0)
+    assert len(attempts) == 3  # initial + 2 restarts
+
+
+def test_injected_crash_is_retryable(tmp_path):
+    """The chaos InjectedCrash (a RuntimeError) goes through the
+    RETRYABLE path — kill-during-save then restart is the exact scenario
+    the recovery wrapper exists for."""
+    from gan_deeplearning4j_tpu.train.gan_trainer import train_with_recovery
+
+    seq = [(InjectedCrash("kill"), 3), (None, 8)]
+    it = iter(seq)
+    res = train_with_recovery(lambda resume: _FakeTrainer(*next(it)),
+                              max_restarts=1, log=lambda s: None,
+                              backoff_base_s=0)
+    assert res == {"steps": 8}
+
+
+# -- preemption guard (fast, signal plumbing only) --------------------------
+
+
+def test_preemption_guard_latches_and_restores_handler():
+    from gan_deeplearning4j_tpu.train.preemption import (
+        PreemptionGuard,
+        parse_signals,
+    )
+
+    assert parse_signals("SIGUSR1,term") == (signal.SIGUSR1,
+                                             signal.SIGTERM)
+    with pytest.raises(ValueError, match="unknown signal"):
+        parse_signals("SIGBOGUS")
+    with pytest.raises(ValueError, match="uncatchable"):
+        parse_signals("SIGTERM,SIGKILL")  # rejected at config time
+
+    prev = signal.getsignal(signal.SIGUSR1)
+    with PreemptionGuard("SIGUSR1") as guard:
+        assert not guard.triggered
+        os.kill(os.getpid(), signal.SIGUSR1)
+        for _ in range(100):  # delivery is between bytecodes
+            if guard.triggered:
+                break
+            time.sleep(0.01)
+        assert guard.triggered
+        assert guard.signal_name() == "SIGUSR1"
+    assert signal.getsignal(signal.SIGUSR1) is prev
+
+
+def test_trainer_rejects_unknown_preempt_signal(tmp_path):
+    from gan_deeplearning4j_tpu.train.gan_trainer import GANTrainer
+    from gan_deeplearning4j_tpu.train.insurance_main import (
+        InsuranceWorkload,
+        default_config,
+    )
+
+    res = str(tmp_path / "never")
+    with pytest.raises(ValueError, match="unknown signal"):
+        GANTrainer(InsuranceWorkload(), default_config(
+            num_iterations=2, res_path=res, preempt_signals="SIGBOGUS"))
+    assert not os.path.exists(res)  # fail-fast: before any side effect
+
+
+# -- NaN alarm -> emergency checkpoint handoff ------------------------------
+
+
+def test_nan_snapshot_goes_through_emergency_path(tmp_path):
+    """The snapshot action exits through the ONE emergency-checkpoint
+    mechanism: the forensic dump is a full manifest-verified checkpoint
+    (extra state included), not a second ad-hoc save format."""
+    from gan_deeplearning4j_tpu.train.gan_trainer import GANTrainer
+    from gan_deeplearning4j_tpu.train.insurance_main import (
+        InsuranceWorkload,
+        default_config,
+    )
+
+    d = str(tmp_path)
+    t = GANTrainer(InsuranceWorkload(), default_config(
+        res_path=d, n_devices=1, telemetry=True, nan_alarm="snapshot"))
+    t.metrics.log_step(11, d_loss=float("nan"), nonfinite=1.0)
+    t.metrics.flush(wait=True)
+    t._poll_nan_alarm()  # trips -> snapshot, keeps training semantics
+    snap = TrainCheckpointer(os.path.join(d, "nan_snapshot"))
+    assert snap.latest_verified_step() is not None
+    # full checkpoint semantics: restores into a fresh 4-graph set WITH
+    # the run-state extras the old ad-hoc snapshot path dropped
+    step, extra = snap.restore(InsuranceWorkload().build_graphs())
+    assert step == t.batch_counter
+    assert "soften_real" in extra
+
+
+def test_nan_abort_is_fatal_in_recovery(tmp_path):
+    """nan_alarm='abort' + recovery: NO restart is burned replaying into
+    the same NaN (the satellite's classification requirement)."""
+    from gan_deeplearning4j_tpu.telemetry import NanAlarmError
+    from gan_deeplearning4j_tpu.train.gan_trainer import (
+        GANTrainer,
+        train_with_recovery,
+    )
+    from gan_deeplearning4j_tpu.train.insurance_main import (
+        InsuranceWorkload,
+        default_config,
+    )
+
+    calls = []
+
+    def make(resume):
+        calls.append(resume)
+        t = GANTrainer(InsuranceWorkload(), default_config(
+            res_path=str(tmp_path), n_devices=1, telemetry=True,
+            nan_alarm="abort"))
+        t.metrics.log_step(3, d_loss=float("nan"), nonfinite=1.0)
+        t.metrics.flush(wait=True)
+        orig = t.train
+        t.train = lambda log=print: t._poll_nan_alarm() or orig(log=log)
+        return t
+
+    with pytest.raises(NanAlarmError):
+        train_with_recovery(make, max_restarts=5, log=lambda s: None,
+                            backoff_base_s=0)
+    assert calls == [False]
+
+
+# -- slow end-to-end chaos ---------------------------------------------------
+
+
+def _insurance_cfg(res, **kw):
+    from gan_deeplearning4j_tpu.train.insurance_main import default_config
+
+    base = dict(num_iterations=8, batch_size=20, res_path=res,
+                print_every=10 ** 9, save_every=8, metrics=False,
+                n_devices=1, checkpoint_every=2)
+    base.update(kw)
+    return default_config(**base)
+
+
+@pytest.mark.slow
+def test_mid_checkpoint_write_crash_resume_bit_identical(tmp_path):
+    """The tentpole end-to-end proof: a kill injected IN THE MIDDLE of
+    writing the step-4 checkpoint (after step 2's committed), recovery
+    restarts from a verified checkpoint, and the final params are
+    BIT-IDENTICAL to a never-failed run."""
+    from gan_deeplearning4j_tpu.train import insurance_main
+    from gan_deeplearning4j_tpu.train.gan_trainer import (
+        GANTrainer,
+        train_with_recovery,
+    )
+
+    ref_dir = str(tmp_path / "ref")
+    ref = GANTrainer(insurance_main.InsuranceWorkload(),
+                     _insurance_cfg(ref_dir))
+    ref.train(log=lambda s: None)
+
+    inj = ChaosInjector(SEED + 5)
+    chaos_dir = str(tmp_path / "chaos")
+
+    def make_trainer(resume):
+        cfg = _insurance_cfg(chaos_dir, resume=resume)
+        return GANTrainer(insurance_main.InsuranceWorkload(), cfg)
+
+    # crash inside the SECOND save (step 4), at a mid-write event
+    with inj.kill_at_save_event(index=2, after_times=1) as kp:
+        res = train_with_recovery(make_trainer, max_restarts=1,
+                                  log=lambda s: None, backoff_base_s=0)
+    assert kp.fired  # the kill actually happened
+    assert res["steps"] == 8
+    # compare via the artifacts both runs dumped at step 8 (exact bytes
+    # of the predictions = bit-identical classifier params + state)
+    from gan_deeplearning4j_tpu.data import read_csv_matrix
+
+    a = read_csv_matrix(os.path.join(
+        ref_dir, "insurance_test_predictions_8.csv"))
+    b = read_csv_matrix(os.path.join(
+        chaos_dir, "insurance_test_predictions_8.csv"))
+    np.testing.assert_array_equal(a, b)
+
+
+@pytest.mark.slow
+def test_sigterm_emergency_checkpoint_resumes_to_same_state(tmp_path):
+    """SIGTERM mid-run: the in-flight step finishes, an emergency
+    checkpoint lands BETWEEN checkpoint_every boundaries, PREEMPTED.json
+    is written, and a --resume run finishes with the same final state as
+    an uninterrupted run (same prediction artifact, same params)."""
+    from gan_deeplearning4j_tpu.train import insurance_main
+    from gan_deeplearning4j_tpu.train.gan_trainer import GANTrainer
+    from gan_deeplearning4j_tpu.train.preemption import (
+        MARKER_NAME,
+        PreemptionError,
+    )
+
+    ref_dir = str(tmp_path / "ref")
+    ref = GANTrainer(insurance_main.InsuranceWorkload(),
+                     _insurance_cfg(ref_dir, checkpoint_every=4,
+                                    steps_per_call=1))
+    ref.train(log=lambda s: None)
+
+    pre_dir = str(tmp_path / "pre")
+    t = GANTrainer(insurance_main.InsuranceWorkload(),
+                   _insurance_cfg(pre_dir, checkpoint_every=4,
+                                  steps_per_call=1,
+                                  preempt_signals="SIGTERM"))
+    orig = t._step_bookkeeping
+
+    def kick_then_book(*a, **kw):
+        if t.batch_counter == 2:  # signal lands mid-step-3
+            os.kill(os.getpid(), signal.SIGTERM)
+        return orig(*a, **kw)
+
+    t._step_bookkeeping = kick_then_book
+    with pytest.raises(PreemptionError) as ei:
+        t.train(log=lambda s: None)
+    # emergency checkpoint at step 3: BETWEEN the every-4 boundaries
+    assert ei.value.step == 3
+    assert os.path.exists(os.path.join(pre_dir, MARKER_NAME))
+    ck = TrainCheckpointer(os.path.join(pre_dir, "checkpoints"))
+    assert ck.latest_verified_step() == 3
+
+    t2 = GANTrainer(insurance_main.InsuranceWorkload(),
+                    _insurance_cfg(pre_dir, checkpoint_every=4,
+                                   steps_per_call=1, resume=True))
+    res = t2.train(log=lambda s: None)
+    assert res["steps"] == 8
+    assert not os.path.exists(os.path.join(pre_dir, MARKER_NAME))
+    for layer, lp in ref.dis.params.items():
+        for name, v in lp.items():
+            np.testing.assert_array_equal(
+                np.asarray(v), np.asarray(t2.dis.params[layer][name]),
+                err_msg=f"dis/{layer}/{name}")
+
+
+@pytest.mark.slow
+def test_async_checkpoint_run_resumes_identically(tmp_path):
+    """--async-checkpoint end to end: a run checkpointing asynchronously
+    resumes (after an injected crash) to the same final state as a
+    synchronous-checkpoint never-failed run — same artifacts."""
+    from gan_deeplearning4j_tpu.train import insurance_main
+    from gan_deeplearning4j_tpu.train.gan_trainer import (
+        GANTrainer,
+        train_with_recovery,
+    )
+
+    ref_dir = str(tmp_path / "ref")
+    GANTrainer(insurance_main.InsuranceWorkload(),
+               _insurance_cfg(ref_dir)).train(log=lambda s: None)
+
+    async_dir = str(tmp_path / "async")
+    state = {"fails_left": 1}
+
+    def make_trainer(resume):
+        t = GANTrainer(
+            insurance_main.InsuranceWorkload(),
+            _insurance_cfg(async_dir, resume=resume,
+                           async_checkpoint=True))
+        orig_step = t._step_bookkeeping
+        orig_chunk = t._chunk_bookkeeping
+
+        def fail_if_due():
+            if t.batch_counter == 4 and state["fails_left"] > 0:
+                state["fails_left"] -= 1
+                raise RuntimeError("injected crash after step-4 save")
+
+        def step(*a, **kw):
+            fail_if_due()
+            return orig_step(*a, **kw)
+
+        def chunk(*a, **kw):
+            fail_if_due()
+            return orig_chunk(*a, **kw)
+
+        t._step_bookkeeping = step
+        t._chunk_bookkeeping = chunk
+        return t
+
+    res = train_with_recovery(make_trainer, max_restarts=1,
+                              log=lambda s: None, backoff_base_s=0)
+    assert res["steps"] == 8
+    assert state["fails_left"] == 0
+    from gan_deeplearning4j_tpu.data import read_csv_matrix
+
+    a = read_csv_matrix(os.path.join(
+        ref_dir, "insurance_test_predictions_8.csv"))
+    b = read_csv_matrix(os.path.join(
+        async_dir, "insurance_test_predictions_8.csv"))
+    np.testing.assert_array_equal(a, b)
+
+
+@pytest.mark.slow
+def test_maybe_resume_fast_forward_partial_tail_epoch_boundary(tmp_path):
+    """_maybe_resume fast-forward ACROSS an epoch boundary with a
+    partial tail (40 rows, batch 16 -> [16, 16, skip-8]): the iterator
+    position after resume equals the position the uninterrupted
+    consumption pattern reaches — including from an emergency-checkpoint
+    step that no cadence boundary produced."""
+    from gan_deeplearning4j_tpu.data import RecordReaderDataSetIterator
+    from gan_deeplearning4j_tpu.train import cv_main
+    from gan_deeplearning4j_tpu.train.gan_trainer import GANTrainer
+
+    d = str(tmp_path)
+    kw = dict(batch_size=16, print_every=100, save_every=100,
+              metrics=False, checkpoint_every=2)
+    wl = cv_main.CVWorkload(n_train=40, n_test=16)
+    t = GANTrainer(wl, cv_main.default_config(
+        num_iterations=3, res_path=d, **kw))
+    train_csv, _ = wl.ensure_data(d)
+    c = t.c
+    # emergency-style checkpoint at step 3 (odd: between every-2 marks)
+    t.batch_counter = 3
+    t._emergency_checkpoint()
+
+    t2 = GANTrainer(cv_main.CVWorkload(n_train=40, n_test=16),
+                    cv_main.default_config(num_iterations=6, res_path=d,
+                                           resume=True, **kw))
+    it2 = RecordReaderDataSetIterator(
+        train_csv, c.batch_size, c.label_index, c.num_classes)
+    t2._maybe_resume(it2)
+    assert t2.batch_counter == 3
+
+    # manual replay of the training loop's consumption for 3 steps
+    ref_it = RecordReaderDataSetIterator(
+        train_csv, c.batch_size, c.label_index, c.num_classes)
+    steps_done = 0
+    while steps_done < 3:
+        if not ref_it.has_next():
+            ref_it.reset()
+        ds = ref_it.next()
+        if ds.num_examples() < c.batch_size:
+            ref_it.reset()
+            continue
+        steps_done += 1
+        if not ref_it.has_next():
+            ref_it.reset()
+    # the NEXT batch both iterators yield must be identical
+    np.testing.assert_array_equal(it2.next().features,
+                                  ref_it.next().features)

@@ -259,7 +259,9 @@ def test_chunked_metrics_match_per_step(tmp_path):
         main(["--iterations", "4", "--res-path", d, "--print-every", "2",
               "--save-every", "4", "--steps-per-call", k])
         with open(os.path.join(d, "insurance_metrics.jsonl")) as f:
-            recs[k] = [json.loads(line) for line in f]
+            recs[k] = [r for r in map(json.loads, f)
+                       if "step" in r]  # drop run-level records
+                       # (the goodput/run_id summary has no step)
     assert [r["step"] for r in recs["2"]] == [1, 2, 3, 4]
     for a, b in zip(recs["2"], recs["1"]):
         assert a["step"] == b["step"]
@@ -294,7 +296,9 @@ def test_stream_chunked_matches_resident_and_per_step(tmp_path):
         t.train(log=lambda s: None)
         trainers[mode] = t
         with open(os.path.join(d, "insurance_metrics.jsonl")) as f:
-            recs[mode] = [json.loads(line) for line in f]
+            recs[mode] = [r for r in map(json.loads, f)
+                          if "step" in r]  # drop the run-level
+                          # goodput/run_id summary record
     # the chunked run really took the chunked path (K>1 multi program),
     # the per-step run really didn't
     assert trainers["chunked"]._steps_per_call == 2
@@ -345,7 +349,9 @@ def test_stream_chunked_u8_codec_matches_resident(tmp_path):
         t.train(log=lambda s: None)
         trainers[mode] = t
         with open(os.path.join(d, "mnist_metrics.jsonl")) as f:
-            recs[mode] = [json.loads(line) for line in f]
+            recs[mode] = [r for r in map(json.loads, f)
+                          if "step" in r]  # drop the run-level
+                          # goodput/run_id summary record
     assert trainers["stream"]._stream_codec == "u8x100"  # codec engaged
     assert trainers["stream"]._steps_per_call == 2
     assert trainers["resident"]._stream_codec is None
@@ -397,7 +403,9 @@ def test_stream_dedup_tier_matches_resident(tmp_path):
         t.train(log=lambda s: None)
         trainers[mode] = t
         with open(os.path.join(d, "mnist_metrics.jsonl")) as f:
-            recs[mode] = [json.loads(line) for line in f]
+            recs[mode] = [r for r in map(json.loads, f)
+                          if "step" in r]  # drop the run-level
+                          # goodput/run_id summary record
     assert trainers["dedup"]._stream_dedup            # tier engaged
     assert trainers["dedup"]._steps_per_call == 4
     assert not trainers["perstep"]._stream_dedup
@@ -509,7 +517,9 @@ def test_stream_chunked_mesh_matches_single_device(tmp_path):
         t.train(log=lambda s: None)
         trainers[mode] = t
         with open(os.path.join(d, "mnist_metrics.jsonl")) as f:
-            recs[mode] = [json.loads(line) for line in f]
+            recs[mode] = [r for r in map(json.loads, f)
+                          if "step" in r]  # drop the run-level
+                          # goodput/run_id summary record
     # the mesh runs really meshed, the chunked run really chunked
     assert trainers["resident4"]._mesh is not None
     assert trainers["chunked4"]._mesh is not None
