@@ -353,13 +353,19 @@ def celeba_multistep_time(device, batch: int = 128, k: int = 20,
 
 
 def e2e_img_per_sec(res_path: str, data_on_device=None,
-                    telemetry: bool = False, detail: bool = False):
+                    telemetry: bool = False, detail: bool = False,
+                    events_enabled: bool = True,
+                    metrics_port: Optional[int] = None):
     """Protocol throughput through the REAL trainer loop on the default
     device (steady-state wall clock, excluding the compile step).
     ``data_on_device`` None = the trainer's default (device-resident
     dataset); False = force the streaming CSV/prefetch/transfer path.
     ``res_path`` holds the dataset CSVs, shared between measurements.
     ``telemetry``: run the trainer with the in-graph numerics block on.
+    ``events_enabled``: record the event timeline (the default the
+    published number ships with; ``--no-events`` is the A/B baseline
+    for the recorder's overhead budget).  ``metrics_port``: serve the
+    /metrics + /healthz exporter for the run's duration.
     ``detail``: return ``(img_per_sec, {"goodput": ..., "run_id": ...})``
     — the run's phase breakdown and manifest id — instead of the bare
     float."""
@@ -371,6 +377,7 @@ def e2e_img_per_sec(res_path: str, data_on_device=None,
         num_iterations=E2E_STEPS, batch_size=BATCH, res_path=res_path,
         print_every=10 ** 9, save_every=10 ** 9, metrics=False,
         data_on_device=data_on_device, telemetry=telemetry,
+        events=events_enabled, metrics_port=metrics_port,
     )
     trainer = GANTrainer(
         cv_main.CVWorkload(n_train=n_train, n_test=BATCH), config)
@@ -437,7 +444,8 @@ def checkpoint_dryrun() -> dict:
     }
 
 
-def dryrun(telemetry: bool = True) -> dict:
+def dryrun(telemetry: bool = True,
+           metrics_port: Optional[int] = None) -> dict:
     """CI smoke: build and execute the fused protocol program — single
     step AND a 2-step scanned multistep, telemetry on — at a toy batch
     on whatever the default platform is (CPU in CI).  Catches exactly
@@ -445,29 +453,100 @@ def dryrun(telemetry: bool = True) -> dict:
     error that breaks every consumer of the fused step without any
     benchmark running.  No probe, no baseline, seconds not minutes.
     Also runs the checkpoint A/B (``checkpoint_dryrun``): ok requires
-    async blocking <= 25% of the sync save AND identical manifests."""
+    async blocking <= 25% of the sync save AND identical manifests.
+
+    The smoke also exercises the EVENT layer end to end: the work runs
+    under a file-backed event recorder (``events_ok`` requires a
+    non-empty ``events.jsonl``) and the /metrics + /healthz exporter is
+    served and scraped over a real socket (``exporter_ok`` requires 200s
+    and the step/goodput/NaN series in the payload).  ``metrics_port``
+    picks the port (default: ephemeral)."""
     global BATCH
     prev_batch, BATCH = BATCH, 8
     try:
         import math
+        import tempfile
+        import urllib.request
 
         import jax
 
-        device = jax.devices()[0]
-        step, state, real, labels, inv = _build_step_and_args(device)
-        state, losses = step(state, real, labels, *inv)
-        ok = all(math.isfinite(float(l)) for l in losses)
-        t = protocol_multistep_time(device, k=2, repeats=1,
-                                    telemetry=telemetry)
-        ckpt = checkpoint_dryrun()
-        ckpt_ok = (ckpt["manifest_match"]
-                   and ckpt["blocking_ratio"] is not None
-                   and ckpt["blocking_ratio"] <= 0.25)
+        from gan_deeplearning4j_tpu.telemetry import (
+            GoodputTimer,
+            MetricsRegistry,
+            events as events_mod,
+            serve_exporter,
+        )
+
+        with tempfile.TemporaryDirectory() as tmp:
+            events_path = os.path.join(tmp, events_mod.EVENTS_NAME)
+            recorder = events_mod.EventRecorder(path=events_path)
+            prev_rec = events_mod.install(recorder)
+            registry = MetricsRegistry()
+            goodput = GoodputTimer()
+            registry.observe_goodput(goodput.report)
+            stop = serve_exporter(registry,
+                                  0 if metrics_port is None
+                                  else metrics_port)
+            try:
+                device = jax.devices()[0]
+                with goodput.phase("dispatch"), \
+                        events_mod.span("bench.single_step"):
+                    step, state, real, labels, inv = \
+                        _build_step_and_args(device)
+                    state, losses = step(state, real, labels, *inv)
+                ok = all(math.isfinite(float(l)) for l in losses)
+                with events_mod.span("bench.multistep"):
+                    t = protocol_multistep_time(device, k=2, repeats=1,
+                                                telemetry=telemetry)
+                with events_mod.span("bench.checkpoint_ab"):
+                    ckpt = checkpoint_dryrun()
+                ckpt_ok = (ckpt["manifest_match"]
+                           and ckpt["blocking_ratio"] is not None
+                           and ckpt["blocking_ratio"] <= 0.25)
+                # one record through the registry feed, then a REAL
+                # scrape over the socket: the CI assertion that the
+                # exporter answers with the step/goodput/NaN series
+                registry.observe_record(
+                    {"step": 1, "d_loss": float(losses[0]),
+                     "nonfinite": 0})
+
+                def get(path):
+                    url = f"http://127.0.0.1:{stop.port}{path}"
+                    with urllib.request.urlopen(url, timeout=10) as r:
+                        return r.status, r.read().decode()
+
+                try:
+                    m_status, m_body = get("/metrics")
+                    h_status, _ = get("/healthz")
+                except OSError:
+                    m_status = h_status = 0
+                    m_body = ""
+                exporter_ok = (
+                    m_status == 200 and h_status == 200
+                    # trailing space: "gan4j_step" alone would be a
+                    # vacuous substring of gan4j_steps_total
+                    and "gan4j_step " in m_body
+                    and "gan4j_steps_total " in m_body
+                    and "gan4j_nonfinite_total " in m_body
+                    and "gan4j_goodput_seconds" in m_body)
+                recorder.flush()
+                try:
+                    events_ok = len(events_mod.read_events(
+                        events_path)) >= 4  # header + three spans
+                except OSError:
+                    events_ok = False
+            finally:
+                stop()
+                events_mod.install(prev_rec)
+                recorder.close()
         return {"metric": "dcgan_mnist_img_per_sec", "dryrun": True,
-                "ok": bool(ok and math.isfinite(t) and ckpt_ok),
+                "ok": bool(ok and math.isfinite(t) and ckpt_ok
+                           and exporter_ok and events_ok),
                 "platform": device.platform,
                 "telemetry": telemetry,
-                "checkpoint": ckpt}
+                "checkpoint": ckpt,
+                "exporter_ok": bool(exporter_ok),
+                "events_ok": bool(events_ok)}
     finally:
         BATCH = prev_batch
 
@@ -493,6 +572,17 @@ def main(argv=None) -> None:
                       action="store_false",
                       help="measure without the telemetry block (the "
                            "A/B baseline for its cost)")
+    p.add_argument("--no-events", dest="events", action="store_false",
+                   default=True,
+                   help="run the e2e trainer WITHOUT the event recorder "
+                        "(telemetry/events.py) — the A/B baseline for "
+                        "its <2%% overhead budget; default: on, like "
+                        "real runs")
+    p.add_argument("--metrics-port", type=int, default=None,
+                   metavar="PORT",
+                   help="serve /metrics + /healthz during the e2e "
+                        "trainer run (and the --dryrun smoke's "
+                        "self-scrape); 0 = ephemeral")
     p.add_argument("--batch", type=int, default=200,
                    help="global batch (default: the reference's 200; the "
                         "CPU-baseline ratio is only reported at 200, "
@@ -529,7 +619,8 @@ def main(argv=None) -> None:
     args = p.parse_args(argv)
 
     if args.dryrun:
-        print(json.dumps(dryrun(telemetry=args.telemetry)))
+        print(json.dumps(dryrun(telemetry=args.telemetry,
+                                metrics_port=args.metrics_port)))
         return
 
     # idempotent (not latch-on): repeated in-process main() calls — the
@@ -685,10 +776,13 @@ def main(argv=None) -> None:
                 backend.configure(
                     matmul_bf16=prev.matmul_bf16,
                     compute_bf16=prev.compute_bf16)
+    out["events"] = bool(args.events)
     if not args.skip_e2e:
         with tempfile.TemporaryDirectory() as tmp:
             e2e, e2e_detail = e2e_img_per_sec(
-                tmp, telemetry=args.telemetry, detail=True)
+                tmp, telemetry=args.telemetry, detail=True,
+                events_enabled=args.events,
+                metrics_port=args.metrics_port)
             out["e2e_img_per_sec"] = round(e2e, 2)
             # the run's goodput ledger + manifest id: every second of
             # the e2e window attributed, and the number traceable to the
@@ -697,7 +791,8 @@ def main(argv=None) -> None:
             out["e2e_run_id"] = e2e_detail["run_id"]
             out["e2e_stream_img_per_sec"] = round(
                 e2e_img_per_sec(tmp, data_on_device=False,
-                                telemetry=args.telemetry), 2)
+                                telemetry=args.telemetry,
+                                events_enabled=args.events), 2)
         if default.platform != "cpu":
             # host->device link bandwidth at measurement time: the
             # streaming path's sensitivity axis.  With the r5 dedup tier

@@ -90,12 +90,20 @@ class AsyncCheckpointer:
     # -- worker --------------------------------------------------------------
 
     def _worker(self) -> None:
+        from gan_deeplearning4j_tpu.telemetry import events
+
         while True:
             snap = self._q.get()
             try:
                 if snap is None:
                     return
-                self.inner.write_snapshot(snap)
+                # the span (and write_snapshot's serialize/commit
+                # sub-spans) land in the run's event log from THIS
+                # thread — a crash mid-save shows up in the flight
+                # record as the in-flight/errored checkpoint.write
+                with events.span("checkpoint.write",
+                                 step=snap["scalars"]["step"]):
+                    self.inner.write_snapshot(snap)
             except BaseException as e:  # re-raised at the next barrier
                 if self._error is None:
                     self._error = e
@@ -114,8 +122,11 @@ class AsyncCheckpointer:
         """Barrier on the previous save, snapshot on THIS thread, enqueue
         serialization.  Returns the final checkpoint path (valid once the
         worker commits it — call ``wait()`` for durability)."""
+        from gan_deeplearning4j_tpu.telemetry import events
+
         self.wait()  # barrier at the next save; surfaces worker errors
-        snap = snapshot_state(graphs, step, extra)
+        with events.span("checkpoint.snapshot", step=step):
+            snap = snapshot_state(graphs, step, extra)
         if self._closed:  # post-close (atexit ordering): degrade to sync
             return self.inner.write_snapshot(snap)
         self._q.put(snap)

@@ -211,7 +211,11 @@ class TrainCheckpointer:
         """Serialize a ``snapshot_state`` result to ``ckpt_{step}`` —
         pure file work, no device or graph access (background-thread
         safe).  Every file is fsynced; the manifest is written last; the
-        final rename is the commit point."""
+        final rename is the commit point.  The serialize/fsync and
+        commit (rename) stages are event spans (telemetry/events.py) so
+        the flight recorder names the stage a kill landed in."""
+        from gan_deeplearning4j_tpu.telemetry import events
+
         step = snap["scalars"]["step"]
         final = os.path.join(self.directory, f"ckpt_{step}")
         tmp = tempfile.mkdtemp(prefix=".ckpt_tmp_", dir=self.directory)
@@ -230,41 +234,46 @@ class TrainCheckpointer:
                                  .hexdigest()}
                 _chaos(f"wrote:{name}")
 
-            for name, (cfg, flat_params, flat_updater) in \
-                    sorted(snap["graphs"].items()):
-                put(f"{name}_model.zip", serialization.model_zip_bytes(
-                    cfg, flat_params, flat_updater))
-            put("state.json",
-                json.dumps(snap["scalars"], indent=1).encode())
-            if snap["arrays"]:
-                put("state.npz", serialization.npz_bytes(snap["arrays"]))
-            # the manifest commits the file set: written + fsynced LAST,
-            # so a manifest that parses implies every listed byte hit
-            # the disk before it
-            mpath = os.path.join(tmp, MANIFEST_NAME)
-            with open(mpath, "w") as f:
-                json.dump({"step": step, "files": entries}, f, indent=1)
-            _fsync_file(mpath)
-            _fsync_dir(tmp)
-            _chaos("manifest")
-            if os.path.exists(final):
-                # swap, never rmtree-then-rename: a kill between the
-                # renames loses the step's DIRECTORY ENTRY (restore falls
-                # back one checkpoint) but never both copies of the data
-                trash = tempfile.mkdtemp(prefix=".ckpt_del_",
-                                         dir=self.directory)
-                os.rmdir(trash)
-                _chaos("pre_swap")
-                os.rename(final, trash)
-                _chaos("mid_swap")
-                os.rename(tmp, final)
-                _chaos("post_swap")
-                shutil.rmtree(trash, ignore_errors=True)
-            else:
-                _chaos("pre_swap")
-                os.rename(tmp, final)
-                _chaos("post_swap")
-            _fsync_dir(self.directory)
+            with events.span("checkpoint.serialize", step=step):
+                for name, (cfg, flat_params, flat_updater) in \
+                        sorted(snap["graphs"].items()):
+                    put(f"{name}_model.zip", serialization.model_zip_bytes(
+                        cfg, flat_params, flat_updater))
+                put("state.json",
+                    json.dumps(snap["scalars"], indent=1).encode())
+                if snap["arrays"]:
+                    put("state.npz",
+                        serialization.npz_bytes(snap["arrays"]))
+                # the manifest commits the file set: written + fsynced
+                # LAST, so a manifest that parses implies every listed
+                # byte hit the disk before it
+                mpath = os.path.join(tmp, MANIFEST_NAME)
+                with open(mpath, "w") as f:
+                    json.dump({"step": step, "files": entries}, f,
+                              indent=1)
+                _fsync_file(mpath)
+                _fsync_dir(tmp)
+                _chaos("manifest")
+            with events.span("checkpoint.commit", step=step):
+                if os.path.exists(final):
+                    # swap, never rmtree-then-rename: a kill between the
+                    # renames loses the step's DIRECTORY ENTRY (restore
+                    # falls back one checkpoint) but never both copies
+                    # of the data
+                    trash = tempfile.mkdtemp(prefix=".ckpt_del_",
+                                             dir=self.directory)
+                    os.rmdir(trash)
+                    _chaos("pre_swap")
+                    os.rename(final, trash)
+                    _chaos("mid_swap")
+                    os.rename(tmp, final)
+                    _chaos("post_swap")
+                    shutil.rmtree(trash, ignore_errors=True)
+                else:
+                    _chaos("pre_swap")
+                    os.rename(tmp, final)
+                    _chaos("post_swap")
+                _fsync_dir(self.directory)
         except BaseException as e:
             # a SIMULATED hard kill must leave the directory exactly as
             # a real one would — debris and all (purged at next init)

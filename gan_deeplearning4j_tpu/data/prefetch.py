@@ -16,9 +16,17 @@ from __future__ import annotations
 
 import queue
 import threading
+import time
 from typing import Optional
 
 import jax
+
+# a consumer blocked on the prefetch queue longer than this records a
+# ``data.prefetch_stall`` event (telemetry/events.py): the pipeline
+# failed to stay ahead of the device — the signal a goodput data_wait
+# spike needs a timeline for.  Short waits are normal double-buffer
+# jitter and would only be noise.
+STALL_EVENT_S = 0.05
 
 
 class PrefetchIterator:
@@ -100,7 +108,17 @@ class PrefetchIterator:
         return self
 
     def __next__(self):
-        item = self._q.get()
+        try:  # fast path: the worker stayed ahead, no stall to record
+            item = self._q.get_nowait()
+        except queue.Empty:
+            t0 = time.perf_counter()
+            item = self._q.get()
+            waited = time.perf_counter() - t0
+            if waited >= STALL_EVENT_S:
+                from gan_deeplearning4j_tpu.telemetry import events
+
+                events.instant("data.prefetch_stall",
+                               seconds=round(waited, 6))
         if item is None:
             if self.error is not None:
                 # the worker died; its enqueued exception may have been

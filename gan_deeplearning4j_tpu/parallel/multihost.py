@@ -52,10 +52,14 @@ def aggregate_goodput(report: Dict[str, float]) -> Dict[str, float]:
         return report
     from jax.experimental import multihost_utils
 
+    from gan_deeplearning4j_tpu.telemetry import events
+
     keys = sorted(k for k, v in report.items()
                   if isinstance(v, (int, float)))
     vals = np.asarray([float(report[k]) for k in keys], np.float32)
-    gathered = multihost_utils.process_allgather(vals)  # [n_proc, len]
+    with events.span("collective.aggregate_goodput",
+                     processes=jax.process_count()):
+        gathered = multihost_utils.process_allgather(vals)  # [n_proc, len]
     mean = np.asarray(gathered).reshape(-1, len(keys)).mean(axis=0)
     out = dict(report)
     out.update({k: round(float(m), 6) for k, m in zip(keys, mean)})
@@ -83,8 +87,12 @@ def agree_preemption(triggered: bool, step: int) -> tuple:
         return bool(triggered), int(step)
     from jax.experimental import multihost_utils
 
-    gathered = multihost_utils.process_allgather(
-        np.asarray([int(bool(triggered)), int(step)], np.int64))
+    from gan_deeplearning4j_tpu.telemetry import events
+
+    with events.span("collective.agree_preemption", step=int(step),
+                     triggered=bool(triggered)):
+        gathered = multihost_utils.process_allgather(
+            np.asarray([int(bool(triggered)), int(step)], np.int64))
     arr = np.asarray(gathered).reshape(-1, 2)
     return bool(arr[:, 0].any()), int(arr[:, 1].min())
 

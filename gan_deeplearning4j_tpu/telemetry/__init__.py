@@ -1,6 +1,6 @@
-"""Numerics + goodput telemetry — the two observability layers every
-serious TPU training stack carries and the reference (slf4j step logs +
-Spark's executor UI, SURVEY.md §5) never had:
+"""Numerics + goodput + event telemetry — the three observability layers
+every serious TPU training stack carries and the reference (slf4j step
+logs + Spark's executor UI, SURVEY.md §5) never had:
 
 * ``telemetry.ingraph`` — model numerics computed INSIDE the compiled
   step (gradient/param norms, update ratios, NaN/Inf counters), riding
@@ -9,11 +9,27 @@ Spark's executor UI, SURVEY.md §5) never had:
   MetricsLogger worker like the losses do.
 * ``telemetry.goodput`` — host-side phase accounting that attributes
   every wall-clock second of a run to data-wait / dispatch / readback /
-  checkpoint / eval / other, plus the per-run ``run_manifest.json``
-  (run id, config, versions, mesh) that metrics and bench JSONs
-  reference.
+  checkpoint / eval / other (with per-phase entry counts, ``phase_n``),
+  plus the per-run ``run_manifest.json`` (run id, config, versions,
+  mesh) that metrics and bench JSONs reference.
+* ``telemetry.events`` — the structured event TIMELINE: low-overhead
+  spans/instants (monotonic + wall timestamps, thread/host labels) to a
+  per-run ``events.jsonl``, a bounded recent-event ring dumped as a
+  flight record next to every crash artifact, and a Chrome-trace export
+  that merges with ``jax.profiler`` captures.  Served live by
+  ``telemetry.exporter`` — a stdlib ``/metrics`` (Prometheus text) +
+  ``/healthz`` endpoint behind ``--metrics-port``.
 """
 
+from gan_deeplearning4j_tpu.telemetry import events
+from gan_deeplearning4j_tpu.telemetry.events import (
+    EventRecorder,
+    export_chrome_trace,
+)
+from gan_deeplearning4j_tpu.telemetry.exporter import (
+    MetricsRegistry,
+    serve_exporter,
+)
 from gan_deeplearning4j_tpu.telemetry.goodput import (
     GoodputTimer,
     write_run_manifest,
@@ -28,4 +44,5 @@ from gan_deeplearning4j_tpu.telemetry.ingraph import (
 
 __all__ = ["GoodputTimer", "write_run_manifest", "NanAlarm",
            "NanAlarmError", "count_nonfinite", "graph_telemetry",
-           "tree_norm"]
+           "tree_norm", "events", "EventRecorder", "export_chrome_trace",
+           "MetricsRegistry", "serve_exporter"]

@@ -1,0 +1,205 @@
+"""Live ``/metrics`` + ``/healthz`` endpoint — the scrape surface.
+
+The reference's only live surface was the Spark UI; utils/live_ui.py
+rebuilt the human half (a dashboard).  This module is the MACHINE half:
+a stdlib ``ThreadingHTTPServer`` on a daemon thread serving
+
+* ``/metrics``  — Prometheus text format (version 0.0.4) rendered from a
+  thread-safe counter/gauge registry;
+* ``/healthz``  — 200 + a small JSON liveness document (last-record age,
+  run id) while the process serves, the conventional k8s liveness probe
+  target.
+
+The registry is fed from the things the stack already computes:
+``MetricsLogger.on_record`` (every materialized step record updates the
+step/loss/NaN series on the logger's worker thread — the training thread
+pays nothing), ``GoodputTimer`` phase totals (a scrape-time callback
+reads the live ledger), and the in-graph ``nonfinite`` counters.  Both
+protocol mains, ``roadmap_main`` and ``bench.py`` expose it as
+``--metrics-port`` (0 = ephemeral, the port is printed).
+
+Metric names (all ``gan4j_``-prefixed):
+
+  gan4j_steps_total            counter  materialized step records
+  gan4j_step                   gauge    last step seen
+  gan4j_nonfinite_total        counter  in-graph NaN/Inf counter sum
+  gan4j_d_loss / gan4j_g_loss / gan4j_classifier_loss   gauges
+  gan4j_examples_per_sec       gauge    last per-step throughput sample
+  gan4j_goodput_seconds{phase} gauge    GoodputTimer phase totals
+  gan4j_goodput_compute_fraction  gauge the headline goodput number
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Callable, Dict, List, Optional, Tuple
+
+_LOSS_GAUGES = ("d_loss", "g_loss", "classifier_loss", "examples_per_sec")
+
+
+def _fmt(v: float) -> str:
+    if math.isnan(v):
+        return "NaN"
+    if math.isinf(v):
+        return "+Inf" if v > 0 else "-Inf"
+    return repr(float(v))
+
+
+class MetricsRegistry:
+    """Thread-safe counter/gauge registry with Prometheus text render.
+
+    ``add_callback(fn)`` registers a scrape-time hook: ``fn(registry)``
+    is called (under the registry lock — it may only ``set``/``inc``)
+    at every ``render()``, so values that live elsewhere (the goodput
+    ledger) are read when asked for, not mirrored on every step."""
+
+    def __init__(self):
+        self._lock = threading.RLock()
+        # the headline counters exist at 0 from the first scrape — a
+        # monitoring rule on gan4j_nonfinite_total must see the series
+        # before the first (hopefully never) increment
+        self._counters: Dict[Tuple[str, tuple], float] = {
+            ("gan4j_steps_total", ()): 0.0,
+            ("gan4j_nonfinite_total", ()): 0.0,
+        }
+        self._gauges: Dict[Tuple[str, tuple], float] = {}
+        self._callbacks: List[Callable[["MetricsRegistry"], None]] = []
+        self.run_id: Optional[str] = None
+        self._last_record_wall: Optional[float] = None
+
+    @staticmethod
+    def _key(name: str, labels: Optional[Dict]) -> Tuple[str, tuple]:
+        return (name, tuple(sorted((labels or {}).items())))
+
+    def inc(self, name: str, value: float = 1.0,
+            labels: Optional[Dict] = None) -> None:
+        k = self._key(name, labels)
+        with self._lock:
+            self._counters[k] = self._counters.get(k, 0.0) + float(value)
+
+    def set(self, name: str, value: float,
+            labels: Optional[Dict] = None) -> None:
+        with self._lock:
+            self._gauges[self._key(name, labels)] = float(value)
+
+    def add_callback(self, fn: Callable[["MetricsRegistry"], None]) -> None:
+        with self._lock:
+            self._callbacks.append(fn)
+
+    # -- feeds ----------------------------------------------------------------
+
+    def observe_record(self, rec: Dict) -> None:
+        """``MetricsLogger.on_record`` hook: one materialized record
+        (step or run-level) updates the scrape series.  Runs on the
+        logger's worker thread — locking only, no I/O."""
+        step = rec.get("step")
+        with self._lock:
+            self._last_record_wall = time.time()
+            if step is None:
+                return  # run-level record (goodput summary): no step axis
+            self.inc("gan4j_steps_total")
+            self.set("gan4j_step", step)
+            for k in _LOSS_GAUGES:
+                v = rec.get(k)
+                if isinstance(v, (int, float)):
+                    self.set(f"gan4j_{k}", v)
+            nf = rec.get("nonfinite")
+            if isinstance(nf, (int, float)) and math.isfinite(nf) and nf > 0:
+                self.inc("gan4j_nonfinite_total", nf)
+
+    def observe_goodput(self, report_fn: Callable[[], Optional[Dict]]) -> None:
+        """Register the goodput feed: ``report_fn`` returns a
+        ``GoodputTimer.report()`` dict (or None before the run starts);
+        its phase totals become labeled gauges at scrape time."""
+
+        def cb(reg: "MetricsRegistry") -> None:
+            rep = report_fn()
+            if not rep:
+                return
+            for k, v in rep.items():
+                if k == "compute_fraction":
+                    reg.set("gan4j_goodput_compute_fraction", v)
+                elif isinstance(v, (int, float)) and k != "wall_s":
+                    reg.set("gan4j_goodput_seconds", v,
+                            labels={"phase": k})
+            if "wall_s" in rep:
+                reg.set("gan4j_goodput_wall_seconds", rep["wall_s"])
+
+        self.add_callback(cb)
+
+    # -- render ---------------------------------------------------------------
+
+    def render(self) -> str:
+        with self._lock:
+            for fn in self._callbacks:
+                try:
+                    fn(self)
+                except Exception:
+                    pass  # a broken feed must not take down the scrape
+            lines: List[str] = []
+            for kind, series in (("counter", self._counters),
+                                 ("gauge", self._gauges)):
+                seen = set()
+                for (name, labels), value in sorted(series.items()):
+                    if name not in seen:
+                        lines.append(f"# TYPE {name} {kind}")
+                        seen.add(name)
+                    if labels:
+                        lab = ",".join(f'{k}="{v}"' for k, v in labels)
+                        lines.append(f"{name}{{{lab}}} {_fmt(value)}")
+                    else:
+                        lines.append(f"{name} {_fmt(value)}")
+            return "\n".join(lines) + "\n"
+
+    def health(self) -> Dict:
+        with self._lock:
+            age = (None if self._last_record_wall is None
+                   else round(time.time() - self._last_record_wall, 3))
+            return {"status": "ok", "run_id": self.run_id,
+                    "last_record_age_s": age}
+
+
+def serve_exporter(registry: MetricsRegistry, port: int,
+                   host: str = "127.0.0.1") -> Callable[[], None]:
+    """Start the scrape endpoint (daemon thread); returns ``stop()``
+    with the resolved port on ``stop.port`` (0 = ephemeral, same
+    contract as utils/live_ui.serve_metrics)."""
+
+    class Handler(BaseHTTPRequestHandler):
+        def do_GET(self):  # noqa: N802 (stdlib API name)
+            if self.path.startswith("/metrics"):
+                body = registry.render().encode()
+                ctype = "text/plain; version=0.0.4; charset=utf-8"
+                status = 200
+            elif self.path.startswith("/healthz"):
+                body = json.dumps(registry.health()).encode()
+                ctype = "application/json"
+                status = 200
+            else:
+                body = b'{"error": "not found"}'
+                ctype = "application/json"
+                status = 404
+            self.send_response(status)
+            self.send_header("Content-Type", ctype)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def log_message(self, *args):  # quiet: no stderr per scrape
+            pass
+
+    server = ThreadingHTTPServer((host, port), Handler)
+    thread = threading.Thread(target=server.serve_forever, daemon=True,
+                              name="gan4j-metrics-exporter")
+    thread.start()
+
+    def stop() -> None:
+        server.shutdown()
+        server.server_close()
+
+    stop.port = server.server_address[1]
+    return stop

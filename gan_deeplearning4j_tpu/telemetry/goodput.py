@@ -21,6 +21,14 @@ interesting signal is how small ``dispatch``'s share is (on a tunneled
 PJRT link the device finishes long before the host returns from
 dispatch, so host-side attribution is a LOWER bound on device idleness).
 
+Alongside the per-phase SECONDS, ``report()`` carries ``phase_n`` — the
+per-phase ENTRY COUNTS (how many times each phase was entered).  Totals
+divided by counts turn the breakdown into per-event numbers:
+``checkpoint / phase_n["checkpoint"]`` is the blocking seconds per save
+(the async-checkpointing before/after metric), ``data_wait /
+phase_n["data_wait"]`` the wait per chunk.  Phases never entered are
+omitted from the map.
+
 The companion ``write_run_manifest`` emits ``run_manifest.json`` — run
 id, config, jax/libtpu versions, mesh/device topology — so metrics
 JSONLs and bench JSONs can reference the exact software+topology a
@@ -36,6 +44,8 @@ import uuid
 from contextlib import contextmanager
 from typing import Dict, Optional
 
+# the phase vocabulary; every phase also gets an entry COUNT in the
+# report's ``phase_n`` map (entered-at-least-once phases only)
 PHASES = ("data_wait", "dispatch", "readback", "checkpoint", "eval")
 
 
@@ -48,7 +58,9 @@ class GoodputTimer:
     device contact ever.  Phases may nest (e.g. a checkpoint that
     flushes artifacts inside an ``eval`` block): inner phases claim
     their own time and the outer phase gets the remainder, so no second
-    is double-counted."""
+    is double-counted.  Every ``phase()`` entry also bumps that phase's
+    ``phase_n`` count (reported alongside the totals), so seconds/count
+    gives the per-event cost."""
 
     def __init__(self):
         self._t0 = time.perf_counter()

@@ -120,6 +120,12 @@ def main(argv=None) -> Dict[str, float]:
     p.add_argument("--live-ui", type=int, default=0, metavar="PORT",
                    help="serve a live loss dashboard over the metrics "
                         "JSONL on this port (the Spark-web-UI analog)")
+    p.add_argument("--metrics-port", type=int, default=None,
+                   metavar="PORT",
+                   help="serve /metrics (Prometheus text: step/loss/"
+                        "goodput/NaN series) + /healthz on this port "
+                        "for the duration of training (0 = ephemeral; "
+                        "docs/OBSERVABILITY.md)")
     p.add_argument("--fid-samples", type=int, default=10000,
                    help="generator samples for the end-of-run FID "
                         "(0 disables)")
@@ -175,8 +181,9 @@ def main(argv=None) -> Dict[str, float]:
         seed=args.seed,
         telemetry=args.telemetry,
         nan_alarm=args.nan_alarm,
+        metrics_port=args.metrics_port,
     )
-    from gan_deeplearning4j_tpu.utils import maybe_trace
+    from gan_deeplearning4j_tpu.utils import maybe_trace, print_trace_summary
 
     stop_ui = None
     if args.live_ui:
@@ -192,6 +199,9 @@ def main(argv=None) -> Dict[str, float]:
                 lambda: CVWorkload(cfg=M.CVConfig(seed=args.seed),
                                n_train=args.n_train, n_test=args.n_test),
                 max_restarts=args.max_restarts)
+        if args.profile:
+            # where the step time went, without leaving the terminal
+            print_trace_summary(args.profile)
         result.update(evaluate(trainer, fid_samples=args.fid_samples))
     except PreemptionError as e:
         # the emergency checkpoint is durable; report the resumable state

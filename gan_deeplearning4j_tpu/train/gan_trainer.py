@@ -52,6 +52,7 @@ from gan_deeplearning4j_tpu.graph import serialization
 from gan_deeplearning4j_tpu.parallel import DataParallelGraph, data_mesh
 from gan_deeplearning4j_tpu.parallel import mesh as mesh_lib
 from gan_deeplearning4j_tpu.runtime import prng
+from gan_deeplearning4j_tpu.telemetry import MetricsRegistry, events
 from gan_deeplearning4j_tpu.utils import (
     MetricsLogger,
     device_fence,
@@ -162,6 +163,15 @@ class GANTrainerConfig:
     #                checkpoint would march straight into the same NaN —
     #                restarting only burns the budget)
     nan_alarm: Optional[str] = None
+    # Structured event tracing (telemetry/events.py): spans/instants for
+    # checkpoint stages, preemption, recovery, prefetch stalls etc. to
+    # res_path/events.jsonl plus the always-on flight-recorder ring.
+    # False = fully disabled (the bench --no-events A/B baseline).
+    events: bool = True
+    # Serve /metrics (Prometheus text) + /healthz on this port for the
+    # duration of train() (telemetry/exporter.py).  None = off; 0 = an
+    # ephemeral port (resolved port on ``trainer.metrics_port``).
+    metrics_port: Optional[int] = None
 
 
 class Workload:
@@ -266,6 +276,18 @@ def train_with_recovery(make_trainer: Callable[[bool], "GANTrainer"],
                         f"quiesce ({ce!r}); the restart will fall back "
                         "to the previous verified checkpoint")
             step = int(getattr(trainer, "batch_counter", 0) or 0)
+            # flight record FIRST, while the failed incarnation's ring
+            # still holds the events that led here (the save/preempt
+            # span in flight at the crash is in it) — even the final,
+            # budget-exhausted failure leaves its timeline behind
+            recorder = getattr(trainer, "_events", None)
+            if recorder is not None:
+                try:
+                    recorder.dump_flight_record(
+                        trainer.c.res_path, "training_failure",
+                        extra={"step": step, "error": repr(e)})
+                except Exception:
+                    pass  # the dump must never mask the failure
             if last_failure_step is not None and step > last_failure_step:
                 attempt = 0  # progress since the last failure: reset budget
             last_failure_step = step
@@ -280,6 +302,25 @@ def train_with_recovery(make_trainer: Callable[[bool], "GANTrainer"],
             log(f"training failed ({e!r}) at step {step}; restart "
                 f"{attempt}/{max_restarts} from the latest checkpoint"
                 + (f" after {delay:.1f}s backoff" if delay else ""))
+            # the restart marker must land in the run's events.jsonl,
+            # but the failed incarnation's recorder is already closed
+            # and the next one not yet open — append through a
+            # transient recorder (the resumed run appends after it, so
+            # the timeline stays one contiguous file)
+            cfg = getattr(trainer, "c", None)
+            if getattr(cfg, "events", False) \
+                    and getattr(cfg, "res_path", None):
+                try:
+                    with events.EventRecorder(
+                            path=os.path.join(cfg.res_path,
+                                              events.EVENTS_NAME),
+                            append=True, flush_every=1) as tr_rec:
+                        tr_rec.instant(
+                            "recovery.restart", step=step,
+                            attempt=attempt,
+                            backoff_s=round(delay, 3), error=repr(e))
+                except OSError:
+                    pass  # an unwritable res dir must not eat the retry
             if delay:
                 time.sleep(delay)
 
@@ -445,11 +486,20 @@ class GANTrainer:
             from gan_deeplearning4j_tpu.telemetry import NanAlarm
 
             self._nan_alarm = NanAlarm()
+        # scrape registry (telemetry/exporter.py): fed from every
+        # materialized metrics record (on the logger's worker thread)
+        # and, at scrape time, from the live goodput ledger; served
+        # over HTTP when config.metrics_port is set
+        self.registry = MetricsRegistry()
+        self.registry.observe_goodput(
+            lambda: self.goodput.report()
+            if getattr(self, "goodput", None) is not None else None)
+        self.metrics_port: Optional[int] = None  # resolved in train()
+        self._events: Optional[events.EventRecorder] = None
         self.metrics = MetricsLogger(
             os.path.join(config.res_path, f"{config.dataset_name}_metrics.jsonl")
             if config.metrics else None,
-            on_record=(self._nan_alarm.observe if self._nan_alarm
-                       else None),
+            on_record=self._observe_record,
         )
         # a checkpointer also exists for resume-only runs and preemption-
         # armed runs (the emergency save needs somewhere durable to land
@@ -496,6 +546,14 @@ class GANTrainer:
         # inline writer until train() swaps in the background one, so the
         # dump methods also work when called directly (tests, notebooks)
         self._dumper = AsyncArtifactWriter(synchronous=True)
+
+    def _observe_record(self, rec: Dict) -> None:
+        """MetricsLogger ``on_record`` hook (worker thread): every
+        materialized record feeds the NaN alarm AND the scrape
+        registry."""
+        if self._nan_alarm is not None:
+            self._nan_alarm.observe(rec)
+        self.registry.observe_record(rec)
 
     # -- artifact dumps ------------------------------------------------------
 
@@ -579,9 +637,10 @@ class GANTrainer:
             # exists, a crash-resume continues past this step and would
             # never re-create artifacts that were still in the queue
             self._dumper.flush()
-            self.checkpointer.save(
-                self.batch_counter, self._graphs(),
-                extra=self._checkpoint_extra())
+            with events.span("checkpoint.save", step=self.batch_counter):
+                self.checkpointer.save(
+                    self.batch_counter, self._graphs(),
+                    extra=self._checkpoint_extra())
 
     def _emergency_checkpoint(self, directory: Optional[str] = None,
                               keep: int = 1) -> str:
@@ -592,25 +651,28 @@ class GANTrainer:
         checkpointer (or a dedicated directory, e.g. ``nan_snapshot``)
         and BARRIERS on async serialization: an emergency save that is
         not durable when the process exits saved nothing."""
-        if self._fused_step is not None and self._final_state is not None:
-            self._fused_lib.state_to_graphs(
-                self._final_state, self.dis, self.gen, self.gan,
-                self.classifier)
-        if directory is None:
-            ck = self.checkpointer
-            if ck is None:  # no cadence configured: land in the usual spot
-                ck = TrainCheckpointer(
-                    os.path.join(self.c.res_path, "checkpoints"),
-                    keep=self.c.checkpoint_keep)
-                self.checkpointer = ck
-        else:
-            ck = TrainCheckpointer(directory, keep=keep)
-        path = ck.save(self.batch_counter, self._graphs(),
-                       extra=self._checkpoint_extra())
-        wait = getattr(ck, "wait", None)
-        if wait is not None:
-            wait()
-        return path
+        with events.span("checkpoint.emergency", step=self.batch_counter,
+                         directory=directory or "checkpoints"):
+            if self._fused_step is not None \
+                    and self._final_state is not None:
+                self._fused_lib.state_to_graphs(
+                    self._final_state, self.dis, self.gen, self.gan,
+                    self.classifier)
+            if directory is None:
+                ck = self.checkpointer
+                if ck is None:  # no cadence: land in the usual spot
+                    ck = TrainCheckpointer(
+                        os.path.join(self.c.res_path, "checkpoints"),
+                        keep=self.c.checkpoint_keep)
+                    self.checkpointer = ck
+            else:
+                ck = TrainCheckpointer(directory, keep=keep)
+            path = ck.save(self.batch_counter, self._graphs(),
+                           extra=self._checkpoint_extra())
+            wait = getattr(ck, "wait", None)
+            if wait is not None:
+                wait()
+            return path
 
     def _maybe_preempt(self) -> None:
         """Boundary poll of the preemption guard: the in-flight fused
@@ -712,7 +774,13 @@ class GANTrainer:
     def train(self, log: Callable[[str], None] = print) -> Dict[str, float]:
         """Run the training loop; with ``preempt_signals`` configured,
         the whole run is bracketed by the preemption guard (handlers
-        restored on every exit path)."""
+        restored on every exit path).  The run's event recorder
+        (``events.jsonl`` + flight-recorder ring) is installed as the
+        process-wide current recorder for the duration, so checkpoint
+        workers, prefetch threads and collectives land their events in
+        this run's file; with ``metrics_port`` set, the /metrics +
+        /healthz exporter serves the scrape registry for the same
+        window."""
         guard = None
         if self._preempt_signal_nums:
             from gan_deeplearning4j_tpu.train.preemption import (
@@ -732,9 +800,42 @@ class GANTrainer:
                     "thread; preemption guard NOT armed")
                 guard = None
         self._preempt_guard = guard
+        c = self.c
+        # setup failures (EADDRINUSE on the exporter port, an unwritable
+        # events file) must still tear down whatever was already
+        # installed — hence everything after the guard lives in the try
+        recorder = None
+        prev_recorder = None
+        stop_exporter = None
         try:
+            # a resumed run APPENDS to its own event history (same
+            # discipline as the metrics JSONL): the pre-crash timeline
+            # is exactly what a post-mortem overlay wants to keep
+            recorder = events.EventRecorder(
+                path=(os.path.join(c.res_path, events.EVENTS_NAME)
+                      if c.events else None),
+                enabled=c.events, append=c.resume)
+            self._events = recorder
+            prev_recorder = events.install(recorder)
+            if c.metrics_port is not None:
+                from gan_deeplearning4j_tpu.telemetry import serve_exporter
+
+                stop_exporter = serve_exporter(self.registry,
+                                               c.metrics_port)
+                self.metrics_port = stop_exporter.port
+                log(f"[metrics] serving /metrics + /healthz on "
+                    f"http://127.0.0.1:{stop_exporter.port}")
             return self._train_impl(log)
         finally:
+            if stop_exporter is not None:
+                stop_exporter()
+            if prev_recorder is not None:
+                events.install(prev_recorder)
+            if recorder is not None:
+                # close the file sink only — the ring stays readable, so
+                # a recovery wrapper can still dump the flight record of
+                # a failed run from trainer._events
+                recorder.close()
             if guard is not None:
                 guard.uninstall()
             self._preempt_guard = None
@@ -753,13 +854,21 @@ class GANTrainer:
         self.run_manifest = write_run_manifest(
             c.res_path, config=c, mesh=self._mesh,
             extra={"workload": self.w.name})
-        with self.goodput.phase("data_wait"):
+        run_id = self.run_manifest.get("run_id")
+        if self._events is not None:
+            self._events.run_id = run_id
+        self.registry.run_id = run_id
+        events.instant("train.start", step=self.batch_counter,
+                       workload=self.w.name)
+        with self.goodput.phase("data_wait"), \
+                events.span("data.prepare"):
             train_csv, test_csv = self.w.ensure_data(c.res_path)
             iter_train = RecordReaderDataSetIterator(
                 train_csv, c.batch_size, c.label_index, c.num_classes)
             iter_test = RecordReaderDataSetIterator(
                 test_csv, c.batch_size_pred, c.label_index, c.num_classes)
-        with self.goodput.phase("checkpoint"):
+        with self.goodput.phase("checkpoint"), \
+                events.span("train.resume"):
             self._maybe_resume(iter_train)
 
         ones = self._ones
@@ -1037,6 +1146,7 @@ class GANTrainer:
                 {"goodput": goodput, "run_id": run_id})
             self.metrics.flush()
         self._poll_nan_alarm()  # a trip materialized by the final flush
+        events.instant("train.end", step=self.batch_counter)
         return {
             "steps": self.batch_counter,
             "examples_per_sec": (
@@ -1191,7 +1301,9 @@ class GANTrainer:
                 # dispatches per step plus 3 scalar readbacks per step at
                 # metrics flush, host-side work that scales with steps and
                 # (on a tunneled link) dominates no matter how large K is
-                with self._phase("dispatch"):
+                with self._phase("dispatch"), \
+                        events.span("train.chunk",
+                                    step=self.batch_counter, n=run):
                     out = self._fused_multi(
                         fused_state, features, labels,
                         *self._fused_invariants)
@@ -1243,7 +1355,9 @@ class GANTrainer:
                     chunk = next(chunks)
             except StopIteration:  # dataset empty even after reset
                 break
-            with self._phase("dispatch"):
+            with self._phase("dispatch"), \
+                    events.span("train.chunk", step=self.batch_counter,
+                                n=run):
                 out = self._fused_multi(
                     fused_state, *chunk, *self._fused_invariants)
             fused_state, (d, g, cl), tel = self._unpack(out)
@@ -1263,7 +1377,9 @@ class GANTrainer:
         if self._steady_t0 is None:
             # goodput: this first fence waits out the XLA compile plus
             # the first chunk's compute — the run's one big readback
-            with self._phase("readback"):
+            with self._phase("readback"), \
+                    events.span("train.compile_fence",
+                                step=self.batch_counter):
                 device_fence(loss)
             self._steady_t0 = time.perf_counter()
             self._steady_start_step = self.batch_counter + steps
@@ -1379,10 +1495,13 @@ class GANTrainer:
                 self.classifier)
 
         if self.batch_counter % c.print_every == 0:
-            with self._phase("eval"):
+            with self._phase("eval"), \
+                    events.span("eval.grid", step=self.batch_counter):
                 self._dump_grid()
         if self.batch_counter % c.save_every == 0:
-            with self._phase("eval"):
+            with self._phase("eval"), \
+                    events.span("eval.predictions",
+                                step=self.batch_counter):
                 self._dump_predictions(iter_test)
         if c.checkpoint_every:
             with self._phase("checkpoint"):
@@ -1405,9 +1524,15 @@ class GANTrainer:
         run_id = (self.run_manifest or {}).get("run_id", "?")
         msg = (f"NaN alarm: first non-finite telemetry at step "
                f"{alarm.step} (run {run_id})")
+        events.instant("alarm.nan", step=alarm.step,
+                       action=self.c.nan_alarm)
         if self.c.nan_alarm == "abort":
             from gan_deeplearning4j_tpu.telemetry import NanAlarmError
 
+            # the abort is FATAL in the recovery wrapper — this dump is
+            # the timeline the post-mortem gets
+            events.dump_flight_record(self.c.res_path, "nan_alarm",
+                                      extra={"step": alarm.step})
             raise NanAlarmError(msg)
         import logging
 
@@ -1418,8 +1543,9 @@ class GANTrainer:
             # (one save path, manifest-verified like any checkpoint),
             # into its own directory so it never collides with the run's
             # resumable checkpoints
+            snap_dir = os.path.join(self.c.res_path, "nan_snapshot")
             with self._phase("checkpoint"):
-                self._emergency_checkpoint(
-                    directory=os.path.join(self.c.res_path,
-                                           "nan_snapshot"),
-                    keep=1)
+                self._emergency_checkpoint(directory=snap_dir, keep=1)
+            # the snapshot carries the event timeline that led to it
+            events.dump_flight_record(snap_dir, "nan_alarm",
+                                      extra={"step": alarm.step})

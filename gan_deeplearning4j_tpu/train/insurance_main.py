@@ -124,6 +124,12 @@ def main(argv=None) -> Dict[str, float]:
     p.add_argument("--live-ui", type=int, default=0, metavar="PORT",
                    help="serve a live loss dashboard over the metrics "
                         "JSONL on this port (the Spark-web-UI analog)")
+    p.add_argument("--metrics-port", type=int, default=None,
+                   metavar="PORT",
+                   help="serve /metrics (Prometheus text: step/loss/"
+                        "goodput/NaN series) + /healthz on this port "
+                        "for the duration of training (0 = ephemeral; "
+                        "docs/OBSERVABILITY.md)")
     p.add_argument("--telemetry", action="store_true",
                    help="in-graph numerics telemetry: per-step grad/param "
                         "norms, update ratios and NaN/Inf counters "
@@ -172,8 +178,9 @@ def main(argv=None) -> Dict[str, float]:
         seed=args.seed,
         telemetry=args.telemetry,
         nan_alarm=args.nan_alarm,
+        metrics_port=args.metrics_port,
     )
-    from gan_deeplearning4j_tpu.utils import maybe_trace
+    from gan_deeplearning4j_tpu.utils import maybe_trace, print_trace_summary
 
     stop_ui = None
     if args.live_ui:
@@ -189,6 +196,9 @@ def main(argv=None) -> Dict[str, float]:
                 lambda: InsuranceWorkload(
                     cfg=M.InsuranceConfig(seed=args.seed)),
                 max_restarts=args.max_restarts)
+        if args.profile:
+            # where the step time went, without leaving the terminal
+            print_trace_summary(args.profile)
         result.update(evaluate(trainer))
     except PreemptionError as e:
         # the emergency checkpoint is durable; report the resumable state

@@ -92,6 +92,8 @@ def preempt_exit(res_path: str, guard: "PreemptionGuard", *,
     records the allreduce consensus (equal under SPMD lockstep), so a
     straggler mismatch is observable in the marker instead of silently
     mislabeling the checkpoint."""
+    from gan_deeplearning4j_tpu.telemetry import events
+
     marker = {
         "step": local_step,
         "fleet_min_step": fleet_min_step,
@@ -105,6 +107,16 @@ def preempt_exit(res_path: str, guard: "PreemptionGuard", *,
         json.dump(marker, f, indent=1)
         f.flush()
         os.fsync(f.fileno())
+    # the timeline: the signal's true arrival (the handler only latched
+    # a flag — recording here keeps the handler async-signal-safe), the
+    # exit itself, then the flight record rides next to PREEMPTED.json
+    events.instant("preempt.signal", signal=guard.signal_name(),
+                   received_at=guard.received_at)
+    events.instant("preempt.exit", step=local_step,
+                   fleet_min_step=fleet_min_step, checkpoint=checkpoint)
+    events.dump_flight_record(res_path, "preemption",
+                              extra={"step": local_step,
+                                     "signal": guard.signal_name()})
     raise PreemptionError(
         f"preempted by {guard.signal_name()} at step {local_step}; "
         f"emergency checkpoint at {checkpoint} (resume with --resume / "

@@ -26,6 +26,7 @@ import numpy as np
 
 from gan_deeplearning4j_tpu.graph import serialization
 from gan_deeplearning4j_tpu.runtime import prng
+from gan_deeplearning4j_tpu.telemetry import MetricsRegistry, events
 from gan_deeplearning4j_tpu.train.gan_pair import GANPair
 from gan_deeplearning4j_tpu.utils import (
     MetricsLogger,
@@ -139,12 +140,16 @@ def train(family: str, iterations: int, batch_size: int, res_path: str,
           steps_per_call: int = None, lr_decay_steps: int = None,
           ms_weight: float = 0.0, fidelity_steps: int = 400,
           async_checkpoint: bool = False, preempt_signals: str = None,
-          log=print) -> Dict[str, float]:
+          metrics_port: int = None, log=print) -> Dict[str, float]:
     """Train one roadmap family end to end.  ``async_checkpoint`` /
     ``preempt_signals`` carry the protocol trainer's fault-tolerance
     semantics (docs/FAULT_TOLERANCE.md): background-serialized
     manifest-verified checkpoints, and signal-triggered emergency save +
-    resumable marker + ``PreemptionError``."""
+    resumable marker + ``PreemptionError``.  The run records its event
+    timeline to ``res_path/events.jsonl`` (telemetry/events.py) and,
+    with ``metrics_port`` (0 = ephemeral), serves /metrics + /healthz
+    for the duration (telemetry/exporter.py) — the same observability
+    contract as the protocol trainer."""
     guard = None
     if preempt_signals:
         from gan_deeplearning4j_tpu.train.preemption import PreemptionGuard
@@ -159,13 +164,40 @@ def train(family: str, iterations: int, batch_size: int, res_path: str,
                 "preempt_signals configured but not on the main thread; "
                 "preemption guard NOT armed")
             guard = None
+    os.makedirs(res_path, exist_ok=True)
+    registry = MetricsRegistry()
+    # setup failures (EADDRINUSE, unwritable events file) must still
+    # tear down whatever was already installed — everything after the
+    # guard lives in the try
+    recorder = None
+    prev_recorder = None
+    stop_exporter = None
     try:
+        # a resumed run APPENDS to its event history, same discipline
+        # as the metrics JSONL
+        recorder = events.EventRecorder(
+            path=os.path.join(res_path, events.EVENTS_NAME),
+            append=resume)
+        prev_recorder = events.install(recorder)
+        if metrics_port is not None:
+            from gan_deeplearning4j_tpu.telemetry import serve_exporter
+
+            stop_exporter = serve_exporter(registry, metrics_port)
+            log(f"[metrics] serving /metrics + /healthz on "
+                f"http://127.0.0.1:{stop_exporter.port}")
         return _train_impl(
             family, iterations, batch_size, res_path, n_train, print_every,
             n_devices, data_dir, ema_decay, checkpoint_every,
             checkpoint_keep, resume, steps_per_call, lr_decay_steps,
-            ms_weight, fidelity_steps, async_checkpoint, guard, log)
+            ms_weight, fidelity_steps, async_checkpoint, guard, registry,
+            log)
     finally:
+        if stop_exporter is not None:
+            stop_exporter()
+        if prev_recorder is not None:
+            events.install(prev_recorder)
+        if recorder is not None:
+            recorder.close()
         if guard is not None:
             guard.uninstall()
 
@@ -174,7 +206,7 @@ def _train_impl(family, iterations, batch_size, res_path, n_train,
                 print_every, n_devices, data_dir, ema_decay,
                 checkpoint_every, checkpoint_keep, resume, steps_per_call,
                 lr_decay_steps, ms_weight, fidelity_steps,
-                async_checkpoint, guard, log) -> Dict[str, float]:
+                async_checkpoint, guard, registry, log) -> Dict[str, float]:
     from gan_deeplearning4j_tpu.telemetry import (
         GoodputTimer,
         write_run_manifest,
@@ -196,6 +228,10 @@ def _train_impl(family, iterations, batch_size, res_path, n_train,
                           "ema_decay": ema_decay,
                           "steps_per_call": steps_per_call},
         mesh=mesh, extra={"workload": family})
+    events.current().run_id = manifest["run_id"]
+    registry.run_id = manifest["run_id"]
+    registry.observe_goodput(goodput.report)
+    events.instant("train.start", workload=family)
     # data first: a real --data-dir can dictate the class count the
     # conditional model's label input must match
     with goodput.phase("data_wait"):
@@ -306,10 +342,11 @@ def _train_impl(family, iterations, batch_size, res_path, n_train,
                         f"iteration {start_it}")
 
         # the resumed run APPENDS to its own metrics history rather than
-        # truncating the pre-crash records
+        # truncating the pre-crash records; every materialized record
+        # also feeds the scrape registry (on the logger's worker thread)
         metrics = MetricsLogger(
             os.path.join(res_path, f"{family}_metrics.jsonl"),
-            append=start_it > 0)
+            append=start_it > 0, on_record=registry.observe_record)
 
         g = math.gcd(math.gcd(iterations, print_every), 100)
         if checkpoint_every:
@@ -329,8 +366,9 @@ def _train_impl(family, iterations, batch_size, res_path, n_train,
             ema = getattr(pair.gen, "ema_params", None)
             if ema is not None:
                 extra["ema"] = ema
-            return ckpt.save(it, {"gen": pair.gen, "dis": pair.dis},
-                             extra=extra)
+            with events.span("checkpoint.save", step=it):
+                return ckpt.save(it, {"gen": pair.gen, "dis": pair.dis},
+                                 extra=extra)
 
         step_fn, state = pair.make_multistep(
             jnp.asarray(x), None if y is None else jnp.asarray(y),
@@ -339,7 +377,8 @@ def _train_impl(family, iterations, batch_size, res_path, n_train,
             seed_key=z_key, ema_decay=ema_decay, start_step=start_it)
         it = start_it
         while it < iterations:
-            with goodput.phase("dispatch"):
+            with goodput.phase("dispatch"), \
+                    events.span("train.chunk", step=it, n=K):
                 state, (dl, gl) = step_fn(state)
             if steady_t0 is None:
                 with goodput.phase("readback"):
@@ -360,7 +399,8 @@ def _train_impl(family, iterations, batch_size, res_path, n_train,
                     f"g={float(g_loss):.4f}")
             if it % print_every == 0 or it >= iterations:
                 pair.adopt_state(state)
-                with goodput.phase("eval"):
+                with goodput.phase("eval"), \
+                        events.span("eval.samples", step=it):
                     dump_samples(it)
             if ckpt is not None and checkpoint_every \
                     and it % checkpoint_every == 0:
@@ -427,6 +467,7 @@ def _train_impl(family, iterations, batch_size, res_path, n_train,
     gp = goodput.report()
     metrics.log_record({"goodput": gp, "run_id": manifest["run_id"]})
     metrics.flush()
+    events.instant("train.end", step=iterations)
     for name, graph in (("gen", pair.gen), ("dis", pair.dis)):
         serialization.write_model(
             graph, os.path.join(res_path, f"{family}_{name}_model.zip"))
@@ -558,6 +599,16 @@ def main(argv=None) -> Dict[str, float]:
                    help="generator weight EMA decay (e.g. 0.999): the "
                         "final sample grid is also rendered from the "
                         "trajectory-averaged weights")
+    p.add_argument("--profile", default=None, metavar="DIR",
+                   help="capture a jax.profiler trace of the run into "
+                        "DIR and print its top time sinks at exit "
+                        "(same contract as the protocol mains)")
+    p.add_argument("--metrics-port", type=int, default=None,
+                   metavar="PORT",
+                   help="serve /metrics (Prometheus text: step/loss/"
+                        "goodput series) + /healthz on this port for "
+                        "the duration of training (0 = ephemeral; "
+                        "docs/OBSERVABILITY.md)")
     from gan_deeplearning4j_tpu.runtime import backend
 
     backend.add_bf16_flag(p)
@@ -569,20 +620,28 @@ def main(argv=None) -> Dict[str, float]:
         backend.configure(compute_bf16=True)
     res = args.res_path or os.path.join("outputs", args.family)
     from gan_deeplearning4j_tpu.train.preemption import PreemptionError
+    from gan_deeplearning4j_tpu.utils import maybe_trace, print_trace_summary
 
     try:
-        result = train(args.family, args.iterations, args.batch_size, res,
-                       args.n_train, args.print_every, args.n_devices,
-                       data_dir=args.data_dir, ema_decay=args.ema_decay,
-                       checkpoint_every=args.checkpoint_every,
-                       resume=args.resume,
-                       steps_per_call=args.steps_per_call,
-                       lr_decay_steps=args.lr_decay_steps,
-                       ms_weight=args.ms_weight,
-                       fidelity_steps=args.fidelity_steps,
-                       async_checkpoint=args.async_checkpoint,
-                       preempt_signals=(",".join(args.preempt_signal)
-                                        if args.preempt_signal else None))
+        with maybe_trace(args.profile):
+            result = train(
+                args.family, args.iterations, args.batch_size, res,
+                args.n_train, args.print_every, args.n_devices,
+                data_dir=args.data_dir, ema_decay=args.ema_decay,
+                checkpoint_every=args.checkpoint_every,
+                resume=args.resume,
+                steps_per_call=args.steps_per_call,
+                lr_decay_steps=args.lr_decay_steps,
+                ms_weight=args.ms_weight,
+                fidelity_steps=args.fidelity_steps,
+                async_checkpoint=args.async_checkpoint,
+                preempt_signals=(",".join(args.preempt_signal)
+                                 if args.preempt_signal else None),
+                metrics_port=args.metrics_port)
+        if args.profile:
+            # where the step time went, without leaving the terminal
+            # (matching cv_main / insurance_main)
+            print_trace_summary(args.profile)
     except PreemptionError as e:
         # the emergency checkpoint is durable; report the resumable state
         # instead of a traceback (cli() exits 75 so the scheduler requeues)

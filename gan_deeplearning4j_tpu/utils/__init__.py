@@ -12,9 +12,14 @@ from gan_deeplearning4j_tpu.utils.listeners import (
     TrainingListener,
 )
 from gan_deeplearning4j_tpu.utils.metrics import MetricsLogger
-from gan_deeplearning4j_tpu.utils.profiling import maybe_trace, summarize_trace
+from gan_deeplearning4j_tpu.utils.profiling import (
+    maybe_trace,
+    print_trace_summary,
+    summarize_trace,
+)
 
 __all__ = ["MetricsLogger", "maybe_trace", "summarize_trace",
+           "print_trace_summary",
            "device_fence", "overlap_device_get", "start_host_copy",
            "TrainingListener", "ScoreIterationListener",
            "PerformanceListener", "CollectScoresListener"]

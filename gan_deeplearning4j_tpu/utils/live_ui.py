@@ -45,6 +45,7 @@ _PAGE = """<!doctype html>
 <div id="meta">waiting for data&hellip;</div>
 <h3>losses</h3>
 <div id="legend-loss"></div>
+<div id="legend-events"></div>
 <canvas id="chart-loss" width="1200" height="300"></canvas>
 <h3>numerics telemetry (grad/param norms, update ratios — log scale)</h3>
 <div id="legend-tel"></div>
@@ -56,9 +57,38 @@ async function tick() {
   try {
     const r = await fetch("/data");
     const recs = await r.json();
-    draw(recs);
+    let evs = [];
+    try { evs = await (await fetch("/events")).json(); } catch (e) {}
+    draw(recs, evs);
   } catch (e) { /* server gone: stop quietly */ }
   setTimeout(tick, 2000);
+}
+function drawMarkers(canvasId, legendId, recs, evs) {
+  // run-event markers (checkpoints / preemption / restarts / NaN
+  // alarms) from the run's events.jsonl, as dashed vertical lines
+  const c = document.getElementById(canvasId);
+  const ctx = c.getContext("2d");
+  const x0 = recs[0].step, x1 = recs[recs.length - 1].step || 1;
+  const px = s => (s - x0) / Math.max(x1 - x0, 1) * (c.width - 40) + 30;
+  let legend = "", seen = {};
+  ctx.save();
+  ctx.setLineDash([4, 4]);
+  for (const ev of evs) {
+    if (typeof ev.step !== "number") continue;
+    ctx.strokeStyle = ev.color || "#999";
+    ctx.globalAlpha = 0.6;
+    ctx.beginPath();
+    const x = px(ev.step);
+    ctx.moveTo(x, 10); ctx.lineTo(x, c.height - 20);
+    ctx.stroke();
+    if (!seen[ev.label]) {
+      seen[ev.label] = true;
+      legend += `<span class="key"><span class="swatch" style=` +
+        `"background:${ev.color || "#999"}"></span>${ev.label}</span>`;
+    }
+  }
+  ctx.restore();
+  document.getElementById(legendId).innerHTML = legend;
 }
 function drawSeries(canvasId, legendId, recs, keys, logScale) {
   const c = document.getElementById(canvasId);
@@ -105,7 +135,7 @@ function drawSeries(canvasId, legendId, recs, keys, logScale) {
   ctx.fillText(fmt(hi), 2, 14);
   ctx.fillText(fmt(lo), 2, c.height - 8);
 }
-function draw(recs) {
+function draw(recs, evs) {
   if (!recs.length) return;
   const last = recs[recs.length - 1];
   document.getElementById("meta").textContent =
@@ -132,6 +162,9 @@ function draw(recs) {
     k => typeof last[k] === "number");
   drawSeries("chart-loss", "legend-loss", recs,
              numKeys.filter(k => k.endsWith("loss")), false);
+  if (evs && evs.length) {
+    drawMarkers("chart-loss", "legend-events", recs, evs);
+  }
   drawSeries("chart-tel", "legend-tel", recs,
              numKeys.filter(k => k.endsWith("_norm") ||
                                  k.endsWith("_ratio")), true);
@@ -203,16 +236,34 @@ class _TailCache:
 
 def serve_metrics(jsonl_path: str, port: int = 8080,
                   host: str = "127.0.0.1") -> Callable[[], None]:
-    """Start the dashboard server (daemon thread); returns a stop()."""
+    """Start the dashboard server (daemon thread); returns a stop().
+
+    When an ``events.jsonl`` (telemetry/events.py) sits next to the
+    metrics file, ``/events`` serves its step-anchored marker events
+    (checkpoints, preemption, restarts, NaN alarms) and the loss chart
+    overlays them live."""
+    from gan_deeplearning4j_tpu.telemetry.events import (
+        EVENTS_NAME,
+        marker_records,
+    )
 
     cache = _TailCache(jsonl_path)
+    events_cache = _TailCache(os.path.join(
+        os.path.dirname(os.path.abspath(jsonl_path)), EVENTS_NAME))
     lock = threading.Lock()
+
+    def marker_events() -> list:
+        return marker_records(events_cache.read())
 
     class Handler(BaseHTTPRequestHandler):
         def do_GET(self):  # noqa: N802 (stdlib API name)
             if self.path == "/data":
                 with lock:  # ThreadingHTTPServer: one tail per poll
                     body = json.dumps(cache.read()).encode()
+                ctype = "application/json"
+            elif self.path == "/events":
+                with lock:
+                    body = json.dumps(marker_events()).encode()
                 ctype = "application/json"
             else:
                 body = _PAGE.encode()

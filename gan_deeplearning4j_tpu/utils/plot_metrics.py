@@ -31,6 +31,39 @@ def read_metrics(path: str) -> List[Dict]:
         return [json.loads(line) for line in f if line.strip()]
 
 
+def load_event_markers(metrics_jsonl: str) -> List[Dict]:
+    """Step-anchored run events for overlay: when an ``events.jsonl``
+    (telemetry/events.py) sits next to the metrics file, return its
+    marker-vocabulary events (checkpoint saves, emergency saves,
+    preemption, recovery restarts, NaN alarms) as
+    ``[{"step", "name", "label", "color"}]``; [] when absent/empty."""
+    from gan_deeplearning4j_tpu.telemetry.events import (
+        EVENTS_NAME,
+        marker_records,
+        read_events,
+    )
+
+    path = os.path.join(os.path.dirname(os.path.abspath(metrics_jsonl)),
+                        EVENTS_NAME)
+    if not os.path.exists(path):
+        return []
+    return marker_records(read_events(path))
+
+
+def _overlay_markers(axes, markers) -> None:
+    """Vertical marker lines on every axis, one legend entry per marker
+    KIND (a 100-checkpoint run must not produce 100 legend rows)."""
+    seen = set()
+    for m in markers:
+        for i, ax in enumerate(axes):
+            ax.axvline(m["step"], color=m["color"], alpha=0.55,
+                       linewidth=1.0, linestyle="--",
+                       label=(m["label"]
+                              if i == 0 and m["label"] not in seen
+                              else None))
+        seen.add(m["label"])
+
+
 def plot_losses(metrics_jsonl: str, out_png: Optional[str] = None,
                 keys: Optional[Sequence[str]] = None,
                 smooth: int = 1) -> str:
@@ -68,6 +101,10 @@ def plot_losses(metrics_jsonl: str, out_png: Optional[str] = None,
                     / np.convolve(np.ones_like(vals), kernel, mode="same"))
         color = _SERIES_COLORS.get(key) or next(fallback)
         ax.plot(steps, vals, color=color, linewidth=1.6, label=key)
+    # run-event markers (checkpoints, preemption, restarts, NaN alarms)
+    # from the sibling events.jsonl, when one exists
+    markers = load_event_markers(metrics_jsonl)
+    _overlay_markers([ax], markers)
     ax.set_xlabel("step")
     ax.set_ylabel("loss")
     ax.set_title(os.path.basename(metrics_jsonl))
@@ -75,7 +112,7 @@ def plot_losses(metrics_jsonl: str, out_png: Optional[str] = None,
     ax.grid(True, color="#dddddd", linewidth=0.6, alpha=0.6)
     for side in ("top", "right"):
         ax.spines[side].set_visible(False)
-    if len(keys) > 1:
+    if len(keys) > 1 or markers:
         ax.legend(frameon=False)
     fig.tight_layout()
     out_png = out_png or (os.path.splitext(metrics_jsonl)[0] + "_losses.png")
@@ -139,6 +176,12 @@ def plot_telemetry(metrics_jsonl: str, out_png: Optional[str] = None,
     series(ax_r, ratio_keys, log=True)
     ax_r.set_ylabel("update ratio")
     ax_r.set_xlabel("step")
+    # run-event markers on both panels (the checkpoint/restart/alarm
+    # timeline a norms post-mortem wants to correlate against)
+    markers = load_event_markers(metrics_jsonl)
+    _overlay_markers([ax_n, ax_r], markers)
+    if markers:
+        ax_n.legend(frameon=False, fontsize=8)  # include marker labels
     # rubricate steps whose NaN/Inf counter fired (or whose norms went
     # non-finite) — the first-bad-step marker a post-mortem reads first
     bad = [r["step"] for r in records

@@ -27,15 +27,35 @@ from typing import List, Optional, Tuple
 @contextlib.contextmanager
 def maybe_trace(trace_dir: Optional[str]):
     """jax.profiler.trace(trace_dir) when a directory is given; no-op
-    (zero overhead) otherwise — so the flag can always be plumbed."""
+    (zero overhead) otherwise — so the flag can always be plumbed.
+
+    The traced region is also recorded as a ``profiler.trace`` event
+    span (telemetry/events.py), and a ``host_anchor.json`` sidecar
+    (wall-clock start of the capture) is dropped INTO ``trace_dir`` —
+    the alignment anchor ``events.export_chrome_trace(...,
+    jax_trace_dir=...)`` reads first.  The sidecar is authoritative
+    because the run's file-backed event recorder is installed inside
+    train(), i.e. after this wrapper opened; the span alone would land
+    on whatever recorder was current here."""
     if not trace_dir:
         yield
         return
+    import json
+    import time
+
     import jax
 
+    from gan_deeplearning4j_tpu.telemetry import events
+
     os.makedirs(trace_dir, exist_ok=True)
-    with jax.profiler.trace(trace_dir):
-        yield
+    try:
+        with open(os.path.join(trace_dir, "host_anchor.json"), "w") as f:
+            json.dump({"wall_start": time.time()}, f)
+    except OSError:
+        pass  # alignment degrades to best-effort; the capture still runs
+    with events.span("profiler.trace", trace_dir=trace_dir):
+        with jax.profiler.trace(trace_dir):
+            yield
 
 
 def _trace_events(trace_dir: str) -> List[dict]:
@@ -72,3 +92,24 @@ def summarize_trace(trace_dir: str, top: int = 10,
             continue
         totals[ev["name"]] += ev["dur"] / 1000.0  # us -> ms
     return sorted(totals.items(), key=lambda kv: -kv[1])[:top]
+
+
+def print_trace_summary(trace_dir: str, top: int = 10,
+                        log=print) -> List[Tuple[str, float]]:
+    """The mains' shared ``--profile`` exit report: summarize the
+    captured trace's top time sinks to ``log`` so a profiled run says
+    where its step time went without leaving the terminal.  Returns the
+    rows; never raises (a missing/empty capture must not fail the run
+    that produced the real results)."""
+    try:
+        rows = summarize_trace(trace_dir, top=top)
+    except Exception as e:
+        log(f"[profile] could not summarize {trace_dir}: {e!r}")
+        return []
+    if not rows:
+        log(f"[profile] no trace events captured under {trace_dir}")
+        return rows
+    log(f"[profile] top {len(rows)} time sinks ({trace_dir}):")
+    for name, ms in rows:
+        log(f"[profile]  {ms:12.3f} ms  {name}")
+    return rows

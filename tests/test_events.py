@@ -1,0 +1,520 @@
+"""Event tracing + exporter + flight recorder (telemetry/events.py,
+telemetry/exporter.py).
+
+Proofs the third observability layer rests on:
+  - spans/instants carry monotonic + wall timestamps, thread labels and
+    attrs; completed spans land in events.jsonl; the ring is bounded.
+  - the flight recorder dumps the recent ring with IN-FLIGHT spans
+    marked — a crash mid-save names the stage it died in.
+  - the Chrome-trace export merges with a (synthetic) jax.profiler
+    capture on a shared time base.
+  - the /metrics endpoint speaks Prometheus text over a real socket and
+    carries the step/loss/goodput/NaN series; /healthz answers 200.
+  - the trainer wires all of it: a real run leaves a populated
+    events.jsonl, a fed registry, and a chaos-induced crash leaves a
+    flight-recorder dump whose last events include the save span that
+    was in flight.
+"""
+
+import gzip
+import json
+import os
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from gan_deeplearning4j_tpu.telemetry import events
+from gan_deeplearning4j_tpu.telemetry.events import (
+    EventRecorder,
+    export_chrome_trace,
+)
+from gan_deeplearning4j_tpu.telemetry.exporter import (
+    MetricsRegistry,
+    serve_exporter,
+)
+
+
+# -- recorder basics ----------------------------------------------------------
+
+
+def test_span_and_instant_recorded(tmp_path):
+    path = str(tmp_path / "events.jsonl")
+    rec = EventRecorder(path=path, run_id="r1", flush_every=1)
+    with rec.span("checkpoint.save", step=7):
+        time.sleep(0.01)
+    rec.instant("alarm.nan", step=8)
+    rec.close()
+
+    lines = events.read_events(path)
+    assert lines[0]["name"] == "recorder.start"
+    assert lines[0]["run_id"] == "r1"
+    by_name = {e["name"]: e for e in lines}
+    span = by_name["checkpoint.save"]
+    assert span["ph"] == "X" and span["step"] == 7
+    assert span["dur"] >= 0.01
+    assert span["thread"]  # thread label present
+    assert abs(span["wall"] - time.time()) < 60  # wall clock, not epoch 0
+    inst = by_name["alarm.nan"]
+    assert inst["ph"] == "i" and inst["step"] == 8 and "dur" not in inst
+
+
+def test_span_records_error_and_reraises(tmp_path):
+    rec = EventRecorder(path=str(tmp_path / "e.jsonl"), flush_every=1)
+    with pytest.raises(RuntimeError, match="boom"):
+        with rec.span("checkpoint.write", step=3):
+            raise RuntimeError("boom")
+    rec.close()
+    ev = [e for e in events.read_events(str(tmp_path / "e.jsonl"))
+          if e["name"] == "checkpoint.write"][0]
+    assert "boom" in ev["error"]
+    assert "dur" in ev  # the span still completed its timing
+
+
+def test_ring_is_bounded_and_threads_labeled():
+    rec = EventRecorder(ring_size=8)  # ring-only: no file
+    for i in range(50):
+        rec.instant("tick", i=i)
+    recent = rec.recent()
+    assert len(recent) == 8
+    assert [e["i"] for e in recent] == list(range(42, 50))
+
+    seen = []
+
+    def worker():
+        with rec.span("from.worker"):
+            pass
+        seen.append(rec.recent()[-1]["thread"])
+
+    t = threading.Thread(target=worker, name="evt-test-worker")
+    t.start()
+    t.join()
+    assert seen == ["evt-test-worker"]
+
+
+def test_disabled_recorder_is_noop(tmp_path):
+    path = str(tmp_path / "none.jsonl")
+    rec = EventRecorder(path=path, enabled=False)
+    with rec.span("x"):
+        pass
+    rec.instant("y")
+    rec.close()
+    assert not os.path.exists(path)
+    assert rec.recent() == []
+
+
+def test_install_and_recording_restore():
+    base = events.current()
+    rec = EventRecorder()
+    with events.recording(rec):
+        assert events.current() is rec
+        events.instant("inside")
+    assert events.current() is base
+    assert [e["name"] for e in rec.recent()] == ["inside"]
+
+
+# -- flight recorder ----------------------------------------------------------
+
+
+def test_flight_record_marks_in_flight_span(tmp_path):
+    rec = EventRecorder(run_id="rfr")
+    rec.instant("train.start")
+    with rec.span("checkpoint.save", step=5):
+        path = rec.dump_flight_record(str(tmp_path), "test_crash",
+                                      extra={"step": 5})
+    payload = json.load(open(path))
+    assert payload["reason"] == "test_crash"
+    assert payload["run_id"] == "rfr"
+    assert payload["step"] == 5
+    last = payload["events"][-1]
+    assert last["name"] == "checkpoint.save"
+    assert last["in_flight"] is True
+    # reason is sanitized into the filename
+    assert os.path.basename(path) == "flight_record_test_crash.json"
+
+
+def test_flight_record_never_raises(tmp_path):
+    rec = EventRecorder()
+    target = tmp_path / "ro"
+    target.mkdir()
+    os.chmod(target, 0o500)  # unwritable directory
+    try:
+        rec.dump_flight_record(str(target), "denied")  # must not raise
+    finally:
+        os.chmod(target, 0o700)
+
+
+# -- chrome trace export ------------------------------------------------------
+
+
+def test_export_chrome_trace_standalone(tmp_path):
+    rec = EventRecorder(path=str(tmp_path / "e.jsonl"), flush_every=1)
+    with rec.span("train.chunk", step=1, n=4):
+        pass
+    rec.instant("alarm.nan", step=2)
+    rec.close()
+    out = export_chrome_trace(str(tmp_path / "e.jsonl"),
+                              str(tmp_path / "trace.json"))
+    doc = json.load(open(out))
+    evs = doc["traceEvents"]
+    names = {e["name"] for e in evs}
+    assert {"process_name", "thread_name", "train.chunk",
+            "alarm.nan"} <= names
+    chunk = [e for e in evs if e["name"] == "train.chunk"][0]
+    assert chunk["ph"] == "X" and chunk["args"]["n"] == 4
+    mark = [e for e in evs if e["name"] == "alarm.nan"][0]
+    assert mark["ph"] == "i"
+
+
+def test_export_chrome_trace_merges_jax_capture(tmp_path):
+    # a synthetic jax.profiler capture with a RELATIVE time base
+    jax_dir = tmp_path / "jaxtrace"
+    jax_dir.mkdir()
+    with gzip.open(jax_dir / "host.trace.json.gz", "wt") as f:
+        json.dump({"traceEvents": [
+            {"ph": "M", "pid": 9, "name": "process_name",
+             "args": {"name": "/device:TPU:0"}},
+            {"ph": "X", "pid": 9, "tid": 1, "name": "fusion.1",
+             "ts": 100.0, "dur": 50.0},
+        ]}, f)
+
+    rec = EventRecorder()
+    with rec.span("profiler.trace"):
+        with rec.span("train.chunk", step=1):
+            pass
+    anchor_wall = [e for e in rec.recent()
+                   if e["name"] == "profiler.trace"][0]["wall"]
+    out = export_chrome_trace(rec, str(tmp_path / "merged.json"),
+                              jax_trace_dir=str(jax_dir))
+    evs = json.load(open(out))["traceEvents"]
+    fusion = [e for e in evs if e["name"] == "fusion.1"][0]
+    # the capture's ts=100us is shifted onto the host wall-clock base,
+    # anchored at the profiler.trace span's start
+    assert fusion["ts"] == pytest.approx(anchor_wall * 1e6, abs=1e3)
+    assert any(e["name"] == "train.chunk" for e in evs)
+
+
+# -- registry + exporter ------------------------------------------------------
+
+
+def test_registry_observe_record_and_render():
+    reg = MetricsRegistry()
+    reg.observe_record({"step": 3, "d_loss": 0.5, "g_loss": 0.7,
+                        "nonfinite": 0})
+    reg.observe_record({"step": 4, "d_loss": 0.4, "nonfinite": 2.0})
+    reg.observe_record({"goodput": {}, "run_id": "x"})  # run-level: no step
+    text = reg.render()
+    assert "# TYPE gan4j_steps_total counter" in text
+    assert "gan4j_steps_total 2.0" in text
+    assert "gan4j_step 4.0" in text
+    assert "gan4j_d_loss 0.4" in text
+    assert "gan4j_nonfinite_total 2.0" in text
+
+
+def test_registry_goodput_callback_labels():
+    from gan_deeplearning4j_tpu.telemetry import GoodputTimer
+
+    reg = MetricsRegistry()
+    gp = GoodputTimer()
+    with gp.phase("dispatch"):
+        time.sleep(0.01)
+    reg.observe_goodput(gp.report)
+    text = reg.render()
+    assert 'gan4j_goodput_seconds{phase="dispatch"}' in text
+    assert "gan4j_goodput_compute_fraction" in text
+    assert "gan4j_goodput_wall_seconds" in text
+
+
+def test_registry_broken_callback_does_not_break_scrape():
+    reg = MetricsRegistry()
+    reg.add_callback(lambda r: 1 / 0)
+    assert "gan4j_steps_total" in reg.render()
+
+
+def _get(port, path):
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}{path}", timeout=10) as r:
+        return r.status, r.headers.get("Content-Type"), r.read()
+
+
+def test_serve_exporter_metrics_and_healthz():
+    reg = MetricsRegistry()
+    reg.run_id = "runX"
+    reg.observe_record({"step": 1, "d_loss": 0.9, "nonfinite": 0})
+    stop = serve_exporter(reg, port=0)
+    try:
+        status, ctype, body = _get(stop.port, "/metrics")
+        assert status == 200 and ctype.startswith("text/plain")
+        text = body.decode()
+        assert "gan4j_step 1.0" in text
+        assert "gan4j_nonfinite_total 0.0" in text
+        status, ctype, body = _get(stop.port, "/healthz")
+        assert status == 200 and ctype == "application/json"
+        health = json.loads(body)
+        assert health["status"] == "ok" and health["run_id"] == "runX"
+        assert health["last_record_age_s"] >= 0
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _get(stop.port, "/nope")
+        assert ei.value.code == 404
+    finally:
+        stop()
+    with pytest.raises(OSError):
+        urllib.request.urlopen(
+            f"http://127.0.0.1:{stop.port}/healthz", timeout=2)
+
+
+# -- prefetch stall events ----------------------------------------------------
+
+
+class _SlowSource:
+    """Minimal DataSet-iterator protocol whose next() is slow once."""
+
+    class _DS:
+        def __init__(self, n):
+            import numpy as np
+
+            self.features = np.zeros((n, 2), np.float32)
+            self.labels = np.zeros((n, 1), np.float32)
+
+        def num_examples(self):
+            return len(self.features)
+
+    def __init__(self, delays):
+        self.delays = list(delays)
+
+    def has_next(self):
+        return bool(self.delays)
+
+    def next(self):
+        time.sleep(self.delays.pop(0))
+        return self._DS(4)
+
+    def reset(self):
+        pass
+
+
+def test_prefetch_stall_event_recorded():
+    from gan_deeplearning4j_tpu.data.prefetch import PrefetchIterator
+
+    rec = EventRecorder()
+    with events.recording(rec):
+        pf = PrefetchIterator(_SlowSource([0.15, 0.0]), prefetch_depth=1)
+        try:
+            next(pf)  # blocks on the worker's slow first next()
+            next(pf)
+        finally:
+            pf.close()
+    stalls = [e for e in rec.recent()
+              if e["name"] == "data.prefetch_stall"]
+    assert stalls and stalls[0]["seconds"] >= 0.05
+
+
+# -- preemption flight record -------------------------------------------------
+
+
+def test_preempt_exit_leaves_flight_record(tmp_path):
+    import signal
+
+    from gan_deeplearning4j_tpu.train.preemption import (
+        MARKER_NAME,
+        PreemptionError,
+        PreemptionGuard,
+        preempt_exit,
+    )
+
+    guard = PreemptionGuard(("SIGUSR1",))
+    guard._handler(signal.SIGUSR1, None)  # simulate the latch
+    rec = EventRecorder(run_id="pre1")
+    with events.recording(rec):
+        with rec.span("checkpoint.emergency", step=11):
+            pass
+        with pytest.raises(PreemptionError):
+            preempt_exit(str(tmp_path), guard, local_step=11,
+                         fleet_min_step=11, checkpoint="ckpt_11",
+                         run_id="pre1")
+    assert os.path.exists(tmp_path / MARKER_NAME)
+    dump = json.load(open(tmp_path / "flight_record_preemption.json"))
+    assert dump["signal"] == "SIGUSR1"
+    names = [e["name"] for e in dump["events"]]
+    assert "checkpoint.emergency" in names
+    assert "preempt.exit" in names
+
+
+# -- trainer end to end -------------------------------------------------------
+
+
+def _insurance_trainer(tmp_path, **kw):
+    from gan_deeplearning4j_tpu.train.gan_trainer import GANTrainer
+    from gan_deeplearning4j_tpu.train.insurance_main import (
+        InsuranceWorkload,
+        default_config,
+    )
+
+    cfg = default_config(
+        num_iterations=4, print_every=100, save_every=100,
+        res_path=str(tmp_path / "run"), n_devices=1, **kw)
+    return GANTrainer(InsuranceWorkload(), cfg)
+
+
+def test_trainer_events_and_registry_end_to_end(tmp_path):
+    t = _insurance_trainer(tmp_path, checkpoint_every=2, metrics_port=0)
+    result = t.train(log=lambda s: None)
+    assert result["steps"] == 4
+    assert t.metrics_port  # the exporter resolved an ephemeral port
+
+    evs = events.read_events(os.path.join(t.c.res_path, "events.jsonl"))
+    names = [e["name"] for e in evs]
+    assert names[0] == "recorder.start"
+    assert evs[0]["run_id"] == result["run_id"]
+    for expected in ("train.start", "data.prepare", "train.resume",
+                     "checkpoint.save", "checkpoint.serialize",
+                     "checkpoint.commit", "train.end"):
+        assert expected in names, expected
+    saves = [e for e in evs if e["name"] == "checkpoint.save"]
+    assert [e["step"] for e in saves] == [2, 4]
+
+    text = t.registry.render()
+    assert "gan4j_step 4.0" in text
+    assert "gan4j_d_loss" in text
+    assert 'gan4j_goodput_seconds{phase="dispatch"}' in text
+    # the run recorder was uninstalled at train() exit
+    assert events.current() is not t._events
+
+
+def test_trainer_events_disabled_writes_nothing(tmp_path):
+    t = _insurance_trainer(tmp_path, events=False)
+    t.train(log=lambda s: None)
+    assert not os.path.exists(os.path.join(t.c.res_path, "events.jsonl"))
+
+
+def test_chaos_crash_leaves_flight_record_with_inflight_save(tmp_path):
+    """The acceptance scenario: a chaos-injected kill during a save
+    crashes training; the recovery wrapper's failure handler dumps a
+    flight record whose LAST events include the save span that was in
+    flight (errored mid-write)."""
+    from gan_deeplearning4j_tpu.testing.chaos import (
+        ChaosInjector,
+        InjectedCrash,
+    )
+    from gan_deeplearning4j_tpu.train.gan_trainer import train_with_recovery
+
+    holder = {}
+
+    def make_trainer(resume):
+        holder["t"] = _insurance_trainer(tmp_path, checkpoint_every=2)
+        return holder["t"]
+
+    chaos = ChaosInjector(seed=7)
+    with chaos.kill_at_save_event(1):  # die inside the serialize stage
+        with pytest.raises(InjectedCrash):
+            train_with_recovery(make_trainer, max_restarts=0,
+                                log=lambda s: None)
+
+    dump_path = os.path.join(holder["t"].c.res_path,
+                             "flight_record_training_failure.json")
+    payload = json.load(open(dump_path))
+    assert payload["reason"] == "training_failure"
+    assert "InjectedCrash" in payload["error"]
+    tail = payload["events"][-4:]
+    save_spans = [e for e in tail
+                  if e["name"].startswith("checkpoint.")]
+    assert save_spans, [e["name"] for e in payload["events"]]
+    assert any("InjectedCrash" in e.get("error", "")
+               for e in save_spans)
+
+
+def test_recovery_restart_marker_lands_in_contiguous_event_log(tmp_path):
+    """A crash + successful restart leaves ONE events.jsonl holding the
+    first incarnation's timeline, the recovery.restart marker, and the
+    resumed incarnation's events (append-on-resume, same discipline as
+    the metrics JSONL)."""
+    from gan_deeplearning4j_tpu.testing.chaos import ChaosInjector
+    from gan_deeplearning4j_tpu.train.gan_trainer import train_with_recovery
+
+    holder = {}
+
+    def make_trainer(resume):
+        holder["t"] = _insurance_trainer(tmp_path, checkpoint_every=2,
+                                         resume=resume)
+        return holder["t"]
+
+    chaos = ChaosInjector(seed=3)
+    with chaos.kill_at_save_event(0):  # one-shot: the retry succeeds
+        result = train_with_recovery(make_trainer, max_restarts=1,
+                                     backoff_base_s=0,
+                                     log=lambda s: None)
+    assert result["steps"] == 4
+    evs = events.read_events(
+        os.path.join(holder["t"].c.res_path, "events.jsonl"))
+    names = [e["name"] for e in evs]
+    assert names.count("train.start") == 2  # both incarnations kept
+    restarts = [e for e in evs if e["name"] == "recovery.restart"]
+    assert len(restarts) == 1 and restarts[0]["attempt"] == 1
+    assert "InjectedCrash" in restarts[0]["error"]
+    # the marker is step-anchored, so the plot/live-UI overlays see it
+    from gan_deeplearning4j_tpu.telemetry.events import marker_records
+
+    assert any(m["label"] == "restart" for m in marker_records(evs))
+
+
+def test_nan_snapshot_carries_flight_record(tmp_path):
+    t = _insurance_trainer(tmp_path, telemetry=True,
+                           nan_alarm="snapshot")
+    t.metrics.log_step(9, d_loss=float("nan"), nonfinite=1.0)
+    t.metrics.flush(wait=True)
+    t._poll_nan_alarm()
+    snap_dir = os.path.join(t.c.res_path, "nan_snapshot")
+    dump = json.load(
+        open(os.path.join(snap_dir, "flight_record_nan_alarm.json")))
+    assert dump["reason"] == "nan_alarm" and dump["step"] == 9
+    # the forensic checkpoint landed next to it
+    assert any(n.startswith("ckpt_") for n in os.listdir(snap_dir))
+
+
+# -- plot overlay -------------------------------------------------------------
+
+
+def test_plot_losses_overlays_event_markers(tmp_path):
+    from gan_deeplearning4j_tpu.utils.plot_metrics import (
+        load_event_markers,
+        plot_losses,
+    )
+
+    jsonl = tmp_path / "m_metrics.jsonl"
+    jsonl.write_text("".join(
+        json.dumps({"step": i + 1, "d_loss": 0.5, "g_loss": 0.6}) + "\n"
+        for i in range(10)))
+    with EventRecorder(path=str(tmp_path / "events.jsonl"),
+                       flush_every=1) as rec:
+        with rec.span("checkpoint.save", step=4):
+            pass
+        rec.instant("alarm.nan", step=8)
+        rec.instant("train.start")  # no step: not a marker
+
+    markers = load_event_markers(str(jsonl))
+    assert [(m["step"], m["label"]) for m in markers] == \
+        [(4, "checkpoint"), (8, "nan alarm")]
+    out = plot_losses(str(jsonl))
+    assert os.path.exists(out)
+
+
+def test_live_ui_serves_event_markers(tmp_path):
+    from gan_deeplearning4j_tpu.utils.live_ui import serve_metrics
+
+    jsonl = tmp_path / "m.jsonl"
+    jsonl.write_text(json.dumps({"step": 1, "d_loss": 0.5}) + "\n")
+    with EventRecorder(path=str(tmp_path / "events.jsonl"),
+                       flush_every=1) as rec:
+        with rec.span("checkpoint.save", step=1):
+            pass
+    stop = serve_metrics(str(jsonl), port=0)
+    try:
+        _, _, body = _get(stop.port, "/events")
+        payload = json.loads(body)
+        assert payload == [{"step": 1, "name": "checkpoint.save",
+                            "label": "checkpoint", "color": "#1baf7a"}]
+        _, _, body = _get(stop.port, "/")
+        assert "drawMarkers" in body.decode()
+    finally:
+        stop()

@@ -1,0 +1,103 @@
+"""Direct tests for utils/profiling.py::summarize_trace — previously
+only exercised implicitly through the mains' --profile plumbing.
+
+A synthetic ``*.trace.json.gz`` fixture (the chrome-trace layout
+jax.profiler writes) pins the three behaviors the summary's consumers
+rely on: device-lane filtering when accelerator lanes exist, the
+host-only fallback when none do, and top-N ordering by total duration.
+"""
+
+import gzip
+import json
+import os
+
+from gan_deeplearning4j_tpu.utils.profiling import (
+    print_trace_summary,
+    summarize_trace,
+)
+
+
+def _write_trace(path, events):
+    with gzip.open(path, "wt") as f:
+        json.dump({"traceEvents": events}, f)
+
+
+def _lane(pid, name):
+    return {"ph": "M", "pid": pid, "name": "process_name",
+            "args": {"name": name}}
+
+
+def _x(pid, name, dur_us, ts=0.0):
+    return {"ph": "X", "pid": pid, "tid": 0, "name": name,
+            "ts": ts, "dur": dur_us}
+
+
+def test_device_lane_filtering(tmp_path):
+    """With a device lane present, host-lane events are excluded from
+    the totals (device_only default)."""
+    _write_trace(tmp_path / "a.trace.json.gz", [
+        _lane(1, "/device:TPU:0"),
+        _lane(2, "python host"),
+        _x(1, "fusion.7", 2000.0),
+        _x(2, "host_overhead", 9000.0),
+    ])
+    rows = summarize_trace(str(tmp_path))
+    assert rows == [("fusion.7", 2.0)]  # us -> ms; host lane dropped
+
+    # device_only=False keeps every lane
+    rows = summarize_trace(str(tmp_path), device_only=False)
+    assert dict(rows) == {"fusion.7": 2.0, "host_overhead": 9.0}
+
+
+def test_host_only_fallback(tmp_path):
+    """A pure-host capture (no accelerator lanes at all) falls back to
+    summarizing every lane rather than returning nothing."""
+    _write_trace(tmp_path / "b.trace.json.gz", [
+        _lane(5, "python host"),
+        _x(5, "np.dot", 1500.0),
+        _x(5, "np.dot", 500.0),  # same name accumulates
+    ])
+    rows = summarize_trace(str(tmp_path))
+    assert rows == [("np.dot", 2.0)]
+
+
+def test_top_n_ordering(tmp_path):
+    """Rows come back sorted by total milliseconds descending and are
+    capped at ``top``."""
+    evs = [_lane(1, "/device:TPU:0")]
+    for i in range(6):
+        evs.append(_x(1, f"op_{i}", 1000.0 * (i + 1)))
+    _write_trace(tmp_path / "c.trace.json.gz", evs)
+    rows = summarize_trace(str(tmp_path), top=3)
+    assert rows == [("op_5", 6.0), ("op_4", 5.0), ("op_3", 4.0)]
+
+
+def test_recursive_glob_and_nonduration_events(tmp_path):
+    """Captures land in nested per-host dirs; metadata and counter
+    events (no ``dur``) are ignored, not crashed on."""
+    nested = tmp_path / "plugins" / "profile" / "run1"
+    os.makedirs(nested)
+    _write_trace(nested / "d.trace.json.gz", [
+        _lane(1, "/device:TPU:0"),
+        {"ph": "C", "pid": 1, "name": "mem", "ts": 0.0},  # counter
+        _x(1, "conv", 3000.0),
+    ])
+    assert summarize_trace(str(tmp_path)) == [("conv", 3.0)]
+
+
+def test_print_trace_summary_logs_and_degrades(tmp_path):
+    _write_trace(tmp_path / "e.trace.json.gz", [
+        _lane(1, "/device:TPU:0"), _x(1, "matmul", 4000.0)])
+    lines = []
+    rows = print_trace_summary(str(tmp_path), log=lines.append)
+    assert rows == [("matmul", 4.0)]
+    assert any("matmul" in l for l in lines)
+    assert any("top" in l for l in lines)
+
+    # an empty capture reports, never raises — the run's real results
+    # must not be lost to a failed summary
+    empty = tmp_path / "empty"
+    empty.mkdir()
+    lines = []
+    assert print_trace_summary(str(empty), log=lines.append) == []
+    assert any("no trace events" in l for l in lines)

@@ -287,6 +287,25 @@ def test_goodput_phases_sum_to_wall():
     assert 0.0 <= rep["compute_fraction"] <= 1.0
 
 
+def test_goodput_phase_n_counts_entries():
+    """``phase_n`` counts ENTRIES per phase (totals / counts = the
+    per-event cost, e.g. blocking seconds per checkpoint save); phases
+    never entered are omitted."""
+    gp = GoodputTimer()
+    for _ in range(3):
+        with gp.phase("checkpoint"):
+            pass
+    with gp.phase("dispatch"):
+        with gp.phase("readback"):  # nested entry still counts
+            pass
+    rep = gp.report()
+    assert rep["phase_n"] == {"checkpoint": 3, "dispatch": 1,
+                              "readback": 1}
+    assert "data_wait" not in rep["phase_n"]  # never entered: omitted
+    # the per-event quotient is well-defined for every counted phase
+    assert rep["checkpoint"] / rep["phase_n"]["checkpoint"] >= 0.0
+
+
 def test_goodput_nested_phases_no_double_count():
     gp = GoodputTimer()
     with gp.phase("eval"):
