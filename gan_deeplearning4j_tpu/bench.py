@@ -460,7 +460,15 @@ def dryrun(telemetry: bool = True,
     non-empty ``events.jsonl``) and the /metrics + /healthz exporter is
     served and scraped over a real socket (``exporter_ok`` requires 200s
     and the step/goodput/NaN series in the payload).  ``metrics_port``
-    picks the port (default: ephemeral)."""
+    picks the port (default: ephemeral).
+
+    The training-health layer rides the same smoke (``watchdog_ok``):
+    the step runs with a HEARTBEAT WATCHDOG armed, the per-beat cost is
+    measured and must be in the noise (<< the 2% telemetry budget —
+    the bar here is 50µs/beat, ~3 orders below a step), and one REAL
+    /healthz scrape during the live (beating) run must report
+    ``"stalled": false`` with a 200 — the stalled contract's healthy
+    half, the 503 half being pinned by tests/test_supervision.py."""
     global BATCH
     prev_batch, BATCH = BATCH, 8
     try:
@@ -487,6 +495,13 @@ def dryrun(telemetry: bool = True,
             stop = serve_exporter(registry,
                                   0 if metrics_port is None
                                   else metrics_port)
+            from gan_deeplearning4j_tpu.train.watchdog import (
+                HeartbeatWatchdog,
+            )
+
+            watchdog = HeartbeatWatchdog(deadline_s=3600.0)
+            watchdog.start()
+            registry.observe_watchdog(watchdog.report)
             try:
                 device = jax.devices()[0]
                 with goodput.phase("dispatch"), \
@@ -494,7 +509,15 @@ def dryrun(telemetry: bool = True,
                     step, state, real, labels, inv = \
                         _build_step_and_args(device)
                     state, losses = step(state, real, labels, *inv)
+                watchdog.beat(step=1)  # a live, beating run
                 ok = all(math.isfinite(float(l)) for l in losses)
+                # per-beat cost: the whole heartbeat layer must be in
+                # the noise (beats ride the hot loop's phase wrappers)
+                n_beats = 2000
+                t0 = time.perf_counter()
+                for k in range(n_beats):
+                    watchdog.beat(step=k + 2)
+                beat_us = (time.perf_counter() - t0) / n_beats * 1e6
                 with events_mod.span("bench.multistep"):
                     t = protocol_multistep_time(device, k=2, repeats=1,
                                                 telemetry=telemetry)
@@ -517,10 +540,10 @@ def dryrun(telemetry: bool = True,
 
                 try:
                     m_status, m_body = get("/metrics")
-                    h_status, _ = get("/healthz")
+                    h_status, h_body = get("/healthz")
                 except OSError:
                     m_status = h_status = 0
-                    m_body = ""
+                    m_body = h_body = ""
                 exporter_ok = (
                     m_status == 200 and h_status == 200
                     # trailing space: "gan4j_step" alone would be a
@@ -528,7 +551,19 @@ def dryrun(telemetry: bool = True,
                     and "gan4j_step " in m_body
                     and "gan4j_steps_total " in m_body
                     and "gan4j_nonfinite_total " in m_body
-                    and "gan4j_goodput_seconds" in m_body)
+                    and "gan4j_goodput_seconds" in m_body
+                    and "gan4j_watchdog_last_beat_age_seconds" in m_body
+                    and "gan4j_rollback_total " in m_body)
+                # stalled contract, healthy half: the scrape above ran
+                # against a LIVE (beating) watchdog-armed run and must
+                # say so — 200 with "stalled": false
+                try:
+                    health = json.loads(h_body) if h_body else {}
+                except ValueError:
+                    health = {}
+                watchdog_ok = (h_status == 200
+                               and health.get("stalled") is False
+                               and beat_us < 50.0)
                 recorder.flush()
                 try:
                     events_ok = len(events_mod.read_events(
@@ -536,17 +571,21 @@ def dryrun(telemetry: bool = True,
                 except OSError:
                     events_ok = False
             finally:
+                watchdog.stop()
                 stop()
                 events_mod.install(prev_rec)
                 recorder.close()
         return {"metric": "dcgan_mnist_img_per_sec", "dryrun": True,
                 "ok": bool(ok and math.isfinite(t) and ckpt_ok
-                           and exporter_ok and events_ok),
+                           and exporter_ok and events_ok
+                           and watchdog_ok),
                 "platform": device.platform,
                 "telemetry": telemetry,
                 "checkpoint": ckpt,
                 "exporter_ok": bool(exporter_ok),
-                "events_ok": bool(events_ok)}
+                "events_ok": bool(events_ok),
+                "watchdog_ok": bool(watchdog_ok),
+                "watchdog_beat_us": round(beat_us, 3)}
     finally:
         BATCH = prev_batch
 

@@ -178,6 +178,11 @@ class AsyncCheckpointer:
         return self.inner.verify(step)
 
     def restore(self, graphs: Dict[str, object],
-                step: Optional[int] = None):
+                step: Optional[int] = None,
+                max_step: Optional[int] = None):
         self.wait()
-        return self.inner.restore(graphs, step)
+        return self.inner.restore(graphs, step, max_step=max_step)
+
+    def prune_above(self, step: int) -> list:
+        self.wait()
+        return self.inner.prune_above(step)

@@ -347,8 +347,27 @@ class TrainCheckpointer:
         steps = self.steps()
         return steps[-1] if steps else None
 
+    def prune_above(self, step: int) -> list:
+        """Remove every committed checkpoint with a step STRICTLY above
+        ``step`` — the rollback path's poisoned-suffix cleanup
+        (train/rollback.py): once a run has rolled back to ``step``,
+        the later checkpoints hold the diverged/NaN state and a plain
+        restart must never resume into them.  Returns the pruned
+        steps."""
+        pruned = [s for s in self.steps() if s > step]
+        for s in pruned:
+            _log.warning(
+                "pruning checkpoint ckpt_%d (> rollback restore point "
+                "%d: holds post-divergence state)", s, step)
+            shutil.rmtree(os.path.join(self.directory, f"ckpt_{s}"),
+                          ignore_errors=True)
+        if pruned:
+            _fsync_dir(self.directory)
+        return pruned
+
     def restore(
-        self, graphs: Dict[str, object], step: Optional[int] = None
+        self, graphs: Dict[str, object], step: Optional[int] = None,
+        max_step: Optional[int] = None,
     ) -> Tuple[int, Dict]:
         """Load params + updater state into the given graphs (in place).
 
@@ -356,7 +375,9 @@ class TrainCheckpointer:
         skipping — with a loud warning — any checkpoint that fails
         manifest verification or whose files turn out unreadable, so a
         checkpoint torn by a mid-write kill degrades the restart to the
-        previous save instead of crashing it.  Raises
+        previous save instead of crashing it.  ``max_step`` bounds the
+        walk: checkpoints ABOVE it are skipped outright (the rollback
+        path restores strictly before the first known-bad step).  Raises
         ``NoVerifiedCheckpointError`` when nothing survives.
 
         An EXPLICIT ``step`` is a user decision: verification failure
@@ -386,9 +407,13 @@ class TrainCheckpointer:
                         "fails manifest verification (torn or corrupt)")
             return self._load(step, graphs)
         candidates = self.steps()
+        if max_step is not None:
+            candidates = [s for s in candidates if s <= max_step]
         if not candidates:
             raise NoVerifiedCheckpointError(
-                f"no checkpoints in {self.directory}")
+                f"no checkpoints in {self.directory}"
+                + (f" at or below step {max_step}"
+                   if max_step is not None else ""))
         legacy = []
         for s in reversed(candidates):
             if not self.verify(s):

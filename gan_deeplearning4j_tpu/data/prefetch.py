@@ -112,7 +112,19 @@ class PrefetchIterator:
             item = self._q.get_nowait()
         except queue.Empty:
             t0 = time.perf_counter()
-            item = self._q.get()
+            # bounded-poll wait, NOT a bare blocking get: a thread
+            # parked inside a C-level acquire never reaches a bytecode
+            # boundary, so the hang watchdog's async-raised
+            # WatchdogTimeout (train/watchdog.py) could not be
+            # delivered to a consumer stuck on a dead source.  The
+            # re-armed get returns to Python every 0.25s, where a
+            # pending async exception fires.
+            while True:
+                try:
+                    item = self._q.get(timeout=0.25)
+                    break
+                except queue.Empty:
+                    continue
             waited = time.perf_counter() - t0
             if waited >= STALL_EVENT_S:
                 from gan_deeplearning4j_tpu.telemetry import events

@@ -97,6 +97,49 @@ def agree_preemption(triggered: bool, step: int) -> tuple:
     return bool(arr[:, 0].any()), int(arr[:, 1].min())
 
 
+# agree_rollback sentinel for "this host has no local bad step": any
+# real step is far below it, so the fleet min ignores non-alarmed hosts
+_NO_BAD_STEP = 1 << 62
+
+
+def agree_rollback(triggered: bool, step: int,
+                   bad_step: Optional[int] = None) -> tuple:
+    """Fleet rollback consensus — ``agree_preemption``'s mirror for the
+    training-health layer (train/rollback.py): allgather every host's
+    (triggered, boundary step, first-known-bad step) and return
+    ``(any_triggered, min_step, min_bad_step-or-None)``.
+
+    Same collective discipline: while a rollback manager is armed,
+    EVERY host enters this at EVERY step/chunk boundary, never only the
+    host whose alarm tripped (a conditionally-entered collective
+    deadlocks the fleet against the training step's own collectives).
+    ``any_triggered`` rolls the WHOLE fleet back together — a lone host
+    restoring an old checkpoint while its peers train on would desync
+    the SPMD state irrecoverably.  The BAD step must be agreed too:
+    every host restores strictly before the fleet-MIN bad step (hosts
+    whose own alarm never tripped contribute no bound) — hosts
+    restoring to different points would desync the same way.
+    ``min_step`` (equal under lockstep) is recorded so a straggler
+    mismatch is observable.  Single process: passthrough, no device
+    contact."""
+    if jax.process_count() == 1:
+        return bool(triggered), int(step), bad_step
+    from jax.experimental import multihost_utils
+
+    from gan_deeplearning4j_tpu.telemetry import events
+
+    local_bad = _NO_BAD_STEP if bad_step is None else int(bad_step)
+    with events.span("collective.agree_rollback", step=int(step),
+                     triggered=bool(triggered)):
+        gathered = multihost_utils.process_allgather(
+            np.asarray([int(bool(triggered)), int(step), local_bad],
+                       np.int64))
+    arr = np.asarray(gathered).reshape(-1, 3)
+    fleet_bad = int(arr[:, 2].min())
+    return (bool(arr[:, 0].any()), int(arr[:, 1].min()),
+            None if fleet_bad >= _NO_BAD_STEP else fleet_bad)
+
+
 def hybrid_mesh(ici_shape: Dict[str, int], dcn_axis: str,
                 num_slices: Optional[int] = None) -> Mesh:
     """Mesh for multi-slice TPU jobs: ``dcn_axis`` spans slices (hosts),

@@ -59,6 +59,9 @@ MARKER_EVENTS = {
     "preempt.exit": ("preemption", "#4a3aa7"),
     "recovery.restart": ("restart", "#e87ba4"),
     "alarm.nan": ("nan alarm", "#e34948"),
+    "alarm.divergence": ("divergence", "#c2571a"),
+    "watchdog.timeout": ("watchdog timeout", "#7a1f1f"),
+    "rollback.restore": ("rollback", "#8338ec"),
 }
 
 

@@ -65,11 +65,18 @@ class MetricsRegistry:
         self._counters: Dict[Tuple[str, tuple], float] = {
             ("gan4j_steps_total", ()): 0.0,
             ("gan4j_nonfinite_total", ()): 0.0,
+            ("gan4j_watchdog_timeouts_total", ()): 0.0,
+            ("gan4j_rollback_total", ()): 0.0,
         }
         self._gauges: Dict[Tuple[str, tuple], float] = {}
         self._callbacks: List[Callable[["MetricsRegistry"], None]] = []
         self.run_id: Optional[str] = None
         self._last_record_wall: Optional[float] = None
+        # training-health feed (train/watchdog.py): a callable returning
+        # the watchdog's report dict; drives the /healthz "stalled"
+        # contract (503 once the heartbeat goes quiet past the deadline)
+        # and the gan4j_watchdog_* series
+        self._watchdog_fn: Optional[Callable[[], Optional[Dict]]] = None
 
     @staticmethod
     def _key(name: str, labels: Optional[Dict]) -> Tuple[str, tuple]:
@@ -85,6 +92,17 @@ class MetricsRegistry:
             labels: Optional[Dict] = None) -> None:
         with self._lock:
             self._gauges[self._key(name, labels)] = float(value)
+
+    def set_counter(self, name: str, value: float,
+                    labels: Optional[Dict] = None) -> None:
+        """Monotonic set: raise the counter to ``value`` if it is
+        higher (for counters whose source of truth lives elsewhere —
+        e.g. the rollback manager's lifetime count, mirrored at scrape
+        time; a counter must never go backwards)."""
+        k = self._key(name, labels)
+        with self._lock:
+            self._counters[k] = max(self._counters.get(k, 0.0),
+                                    float(value))
 
     def add_callback(self, fn: Callable[["MetricsRegistry"], None]) -> None:
         with self._lock:
@@ -131,6 +149,34 @@ class MetricsRegistry:
 
         self.add_callback(cb)
 
+    def observe_watchdog(self, report_fn: Callable[[], Optional[Dict]]
+                         ) -> None:
+        """Register the hang-watchdog feed: ``report_fn`` returns a
+        ``HeartbeatWatchdog.report()`` dict (last beat age, effective
+        deadline, timeout count, stalled flag).  Scrapes mirror it into
+        the ``gan4j_watchdog_*`` series, and ``/healthz`` answers 503 +
+        ``"stalled": true`` while the heartbeat is quiet past the
+        deadline — the liveness probe sees a hang the moment the
+        watchdog does, without waiting for the process to die."""
+        self._watchdog_fn = report_fn
+
+        def cb(reg: "MetricsRegistry") -> None:
+            rep = report_fn()
+            if not rep:
+                return
+            age = rep.get("last_beat_age_s")
+            if isinstance(age, (int, float)):
+                reg.set("gan4j_watchdog_last_beat_age_seconds", age)
+            deadline = rep.get("deadline_s")
+            if isinstance(deadline, (int, float)):
+                reg.set("gan4j_watchdog_deadline_seconds", deadline)
+            reg.set("gan4j_watchdog_stalled",
+                    1.0 if rep.get("stalled") else 0.0)
+            reg.set_counter("gan4j_watchdog_timeouts_total",
+                            float(rep.get("timeouts_total", 0)))
+
+        self.add_callback(cb)
+
     # -- render ---------------------------------------------------------------
 
     def render(self) -> str:
@@ -156,11 +202,31 @@ class MetricsRegistry:
             return "\n".join(lines) + "\n"
 
     def health(self) -> Dict:
+        """Liveness document.  ``stalled`` is the watchdog's verdict
+        (False without a watchdog feed — no heartbeat means no hang
+        CLAIM, not a hang); a stalled process answers
+        ``status: "stalled"`` and the exporter serves it as 503, so a
+        k8s liveness probe restarts a hung pod the same way
+        ``train_with_recovery`` restarts a hung run."""
+        stalled = False
+        beat_age = None
+        fn = self._watchdog_fn
+        if fn is not None:
+            try:
+                rep = fn() or {}
+                stalled = bool(rep.get("stalled"))
+                beat_age = rep.get("last_beat_age_s")
+            except Exception:
+                pass  # a broken feed must not take down the probe
         with self._lock:
             age = (None if self._last_record_wall is None
                    else round(time.time() - self._last_record_wall, 3))
-            return {"status": "ok", "run_id": self.run_id,
-                    "last_record_age_s": age}
+            doc = {"status": "stalled" if stalled else "ok",
+                   "stalled": stalled, "run_id": self.run_id,
+                   "last_record_age_s": age}
+            if beat_age is not None:
+                doc["last_beat_age_s"] = round(float(beat_age), 3)
+            return doc
 
 
 def serve_exporter(registry: MetricsRegistry, port: int,
@@ -176,9 +242,14 @@ def serve_exporter(registry: MetricsRegistry, port: int,
                 ctype = "text/plain; version=0.0.4; charset=utf-8"
                 status = 200
             elif self.path.startswith("/healthz"):
-                body = json.dumps(registry.health()).encode()
+                doc = registry.health()
+                body = json.dumps(doc).encode()
                 ctype = "application/json"
-                status = 200
+                # the stalled contract (docs/OBSERVABILITY.md): a hung
+                # run answers 503 so liveness probes restart the pod —
+                # the process being alive enough to serve HTTP is
+                # exactly what makes a hang invisible otherwise
+                status = 503 if doc.get("stalled") else 200
             else:
                 body = b'{"error": "not found"}'
                 ctype = "application/json"

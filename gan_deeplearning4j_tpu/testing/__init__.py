@@ -3,9 +3,11 @@ the training path imports this package)."""
 
 from gan_deeplearning4j_tpu.testing.chaos import (
     ChaosInjector,
+    HangingSource,
     InjectedCrash,
     NanSource,
     StallingSource,
 )
 
-__all__ = ["ChaosInjector", "InjectedCrash", "NanSource", "StallingSource"]
+__all__ = ["ChaosInjector", "HangingSource", "InjectedCrash", "NanSource",
+           "StallingSource"]

@@ -22,10 +22,16 @@ the seeded, deterministic injector that does all four, driven by
 * **stall-the-data-source** — ``StallingSource`` wraps any DataSet
   iterator and blocks inside ``next()`` at a seeded call until released
   (a hung storage layer); pins that ``PrefetchIterator.close`` neither
-  deadlocks nor loses worker errors.
+  deadlocks nor loses worker errors.  ``HangingSource`` is the terminal
+  variant: it NEVER releases (a dead storage layer) — the hang the
+  watchdog (train/watchdog.py) converts into a retryable restart.
+* **hang-the-readback** — ``ChaosInjector.hang_at_readback`` hooks
+  ``utils/device.device_fence`` so a chosen fence call blocks
+  indefinitely (a wedged device/tunnel), the OTHER silent hang class.
 * **NaN-into-grads** — ``NanSource`` poisons the features of a seeded
   batch (the classic bad-record path to non-finite grads), driving the
-  telemetry NaN alarm end to end.
+  telemetry NaN alarm — and the rollback-with-perturbation heal path —
+  end to end.
 
 Everything is parameterized by an explicit seed: a chaos failure must
 replay exactly.
@@ -35,6 +41,7 @@ from __future__ import annotations
 
 import random
 import threading
+import time
 from typing import List, Optional
 
 import numpy as np
@@ -129,6 +136,51 @@ class ChaosInjector:
         os.remove(path)
         return path
 
+    # -- hangs -----------------------------------------------------------------
+
+    def hang_at_readback(self, at: int = 0) -> "_ReadbackHang":
+        """Context manager: the ``at``-th ``device_fence`` call inside
+        the block hangs indefinitely (a wedged device readback /
+        tunnel).  One-shot — a restarted run's fences proceed normally,
+        so a watchdog-driven restart can finish.  The hang sleeps in
+        small increments, which keeps the hung thread interruptible at
+        bytecode boundaries — exactly the property a real C-level hang
+        lacks until its call returns, and the reason the watchdog also
+        dumps diagnostics and checkpoints from its OWN thread."""
+        return _ReadbackHang(at)
+
+
+class _ReadbackHang:
+    def __init__(self, at: int):
+        self.at = at
+        self.calls = 0
+        self.fired = False                  # one-shot, like _KillPoint
+        self.hung = threading.Event()       # observable: fence is stuck
+        self._release = threading.Event()   # set on __exit__ (cleanup)
+        self._prev = None
+
+    def _hook(self) -> None:
+        if self.fired:
+            return
+        if self.calls == self.at:
+            self.fired = True
+            self.hung.set()
+            while not self._release.is_set():
+                time.sleep(0.05)
+        self.calls += 1
+
+    def __enter__(self) -> "_ReadbackHang":
+        from gan_deeplearning4j_tpu.utils import device as _device_mod
+
+        self._device_mod = _device_mod
+        self._prev = _device_mod._chaos_readback_hook
+        _device_mod._chaos_readback_hook = self._hook
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self._device_mod._chaos_readback_hook = self._prev
+        self._release.set()  # free any thread still parked in the hook
+
 
 class _KillPoint:
     def __init__(self, index: int, after_times: int):
@@ -188,6 +240,45 @@ class StallingSource:
         if self.calls - 1 == self.stall_at:
             self.stalled.set()
             self._release.wait()  # block until the test releases us
+        return self.source.next()
+
+    def __getattr__(self, name):
+        return getattr(self.source, name)
+
+
+class HangingSource:
+    """DataSet-iterator wrapper whose ``next()`` blocks FOREVER at the
+    ``hang_at``-th call — a dead storage layer.  Unlike
+    ``StallingSource`` there is no release: the only way out is the
+    hang watchdog (train/watchdog.py) unwinding the consumer and the
+    recovery wrapper rebuilding the pipeline (the abandoned daemon
+    worker thread dies with the process).  One-shot: a source
+    constructed fresh for a restarted incarnation hangs again, so tests
+    wrap only the first incarnation's iterator.
+
+    The wait sleeps in small increments so a TRAINING thread that calls
+    ``next()`` directly (the unfused/streaming paths go through the
+    prefetch queue instead) stays interruptible at bytecode
+    boundaries."""
+
+    def __init__(self, source, hang_at: int = 0):
+        self.source = source
+        self.hang_at = hang_at
+        self.calls = 0
+        self.hung = threading.Event()   # observable: a consumer is stuck
+
+    def has_next(self):
+        return self.source.has_next()
+
+    def reset(self):
+        return self.source.reset()
+
+    def next(self):
+        self.calls += 1
+        if self.calls - 1 == self.hang_at:
+            self.hung.set()
+            while True:  # never released — the watchdog's problem now
+                time.sleep(0.05)
         return self.source.next()
 
     def __getattr__(self, name):

@@ -162,7 +162,43 @@ class GANTrainerConfig:
     #                it FATAL (deterministic replay from the last
     #                checkpoint would march straight into the same NaN —
     #                restarting only burns the budget)
+    #   "rollback" — heal instead of dying (train/rollback.py): restore
+    #                the last verified checkpoint from BEFORE the bad
+    #                step in-process, cut the LR by rollback_lr_factor
+    #                and advance the noise stream so the replay is NOT
+    #                deterministic; escalates to fatal after
+    #                max_rollbacks (progress-aware).  Needs a shared
+    #                RollbackManager (run_with_recovery wires one).
+    #                The divergence sentinel shares this action.
     nan_alarm: Optional[str] = None
+    # Windowed divergence sentinel (train/divergence.py): trip on loss
+    # explosion / grad-norm blowup BEFORE NaNs appear, from the same
+    # materialized telemetry records the NaN alarm watches.  The action
+    # on a trip is nan_alarm's (warn when None).  Requires telemetry.
+    divergence: bool = False
+    divergence_window: int = 64       # rolling median window (records)
+    divergence_factor: float = 20.0   # |value| > factor * median = outlier
+    divergence_patience: int = 3      # consecutive outliers to trip
+    # Rollback-with-perturbation knobs (used when nan_alarm="rollback")
+    max_rollbacks: int = 3            # progress-aware budget, then fatal
+    rollback_lr_factor: float = 0.5   # LR multiplier per rollback
+    # Hang watchdog (train/watchdog.py): the trainer heartbeats at every
+    # step/chunk boundary and around every blocking region (the goodput
+    # phases); if no beat lands within the deadline the watchdog dumps a
+    # flight record, attempts a best-effort emergency checkpoint and
+    # raises WatchdogTimeout on the training thread — a hang becomes a
+    # retryable failure for train_with_recovery instead of a run wedged
+    # forever.
+    watchdog: bool = False
+    # None = auto-scale: watchdog_scale x the measured steady-state
+    # inter-beat interval (EWMA), floored at watchdog_min_deadline_s;
+    # watchdog_warmup_s applies until enough intervals are measured
+    # (the XLA-compile allowance).  An explicit value is a fixed
+    # deadline in seconds.
+    watchdog_deadline_s: Optional[float] = None
+    watchdog_warmup_s: float = 300.0
+    watchdog_scale: float = 20.0
+    watchdog_min_deadline_s: float = 5.0
     # Structured event tracing (telemetry/events.py): spans/instants for
     # checkpoint stages, preemption, recovery, prefetch stalls etc. to
     # res_path/events.jsonl plus the always-on flight-recorder ring.
@@ -241,40 +277,73 @@ def train_with_recovery(make_trainer: Callable[[bool], "GANTrainer"],
     later step than the previous one, the run has advanced past the old
     failure point and the attempt counter resets — one flaky host taxes
     the run per incident, while a genuine crash-loop (failing at the
-    same step every time) still exhausts ``max_restarts``."""
+    same step every time) still exhausts ``max_restarts``.
+
+    ``RollbackRequested`` (the ``--nan-alarm rollback`` healing path,
+    train/rollback.py) is handled here but does NOT burn the restart
+    budget or back off: the rollback budget is the RollbackManager's
+    own (progress-aware, ``--max-rollbacks``), the manager already
+    charged it before raising, and the restore is an in-process resume
+    — the next incarnation restores the last verified checkpoint from
+    before the bad step with a cut LR and a perturbed noise stream.
+    ``RollbackError`` (budget exhausted) and ``DivergenceError`` (the
+    sentinel's abort action — a deterministic replay re-diverges
+    identically) join the fatal class."""
     import random as _random
 
     from gan_deeplearning4j_tpu.checkpoint import CheckpointCorruptError
     from gan_deeplearning4j_tpu.telemetry import NanAlarmError
+    from gan_deeplearning4j_tpu.train.divergence import DivergenceError
     from gan_deeplearning4j_tpu.train.preemption import PreemptionError
+    from gan_deeplearning4j_tpu.train.rollback import (
+        RollbackError,
+        RollbackRequested,
+    )
+
+    def quiesce_checkpointer(trainer) -> None:
+        # quiesce the failed incarnation's checkpoint writer BEFORE
+        # rebuilding: an async save still in flight must become
+        # durable (or surface its error in the log) before the new
+        # trainer's init reclaims temp dirs out from under the old
+        # worker — and close() also reaps the worker thread, which
+        # would otherwise leak one per restart
+        ck_close = getattr(getattr(trainer, "checkpointer", None),
+                           "close", None)
+        if ck_close is not None:
+            try:
+                ck_close()
+            except Exception as ce:
+                log(f"checkpoint writer failed during restart "
+                    f"quiesce ({ce!r}); the restart will fall back "
+                    "to the previous verified checkpoint")
 
     attempt = 0
+    resume_next = False
     last_failure_step: Optional[int] = None
     while True:
-        trainer = make_trainer(attempt > 0)
+        trainer = make_trainer(resume_next)
         try:
             return trainer.train(log=log)
         except (KeyboardInterrupt, PreemptionError):
             raise  # preemption: checkpointed; the scheduler requeues
         except (ValueError, TypeError, CheckpointCorruptError,
-                NanAlarmError):
+                NanAlarmError, DivergenceError, RollbackError):
             raise  # fatal class: a restart replays the identical failure
+        except RollbackRequested as e:
+            # in-process heal: no budget burned here (the manager's was
+            # charged), no backoff (nothing external to wait out) — the
+            # rebuild below resumes before the bad step, LR cut and
+            # noise stream advanced (the rollback.request/restore
+            # events carry the timeline)
+            quiesce_checkpointer(trainer)
+            resume_next = True
+            log(f"rolling back at step {e.step} (rollback "
+                f"#{e.rollbacks}): {e} — restoring the last verified "
+                "pre-failure checkpoint with a cut LR and a perturbed "
+                "noise stream")
+            continue
         except Exception as e:  # noqa: BLE001 — retryable class
-            # quiesce the failed incarnation's checkpoint writer BEFORE
-            # rebuilding: an async save still in flight must become
-            # durable (or surface its error in the log) before the new
-            # trainer's init reclaims temp dirs out from under the old
-            # worker — and close() also reaps the worker thread, which
-            # would otherwise leak one per restart
-            ck_close = getattr(getattr(trainer, "checkpointer", None),
-                               "close", None)
-            if ck_close is not None:
-                try:
-                    ck_close()
-                except Exception as ce:
-                    log(f"checkpoint writer failed during restart "
-                        f"quiesce ({ce!r}); the restart will fall back "
-                        "to the previous verified checkpoint")
+            quiesce_checkpointer(trainer)
             step = int(getattr(trainer, "batch_counter", 0) or 0)
             # flight record FIRST, while the failed incarnation's ring
             # still holds the events that led here (the save/preempt
@@ -292,6 +361,7 @@ def train_with_recovery(make_trainer: Callable[[bool], "GANTrainer"],
                 attempt = 0  # progress since the last failure: reset budget
             last_failure_step = step
             attempt += 1
+            resume_next = True
             if attempt > max_restarts:
                 raise
             delay = 0.0
@@ -319,17 +389,76 @@ def train_with_recovery(make_trainer: Callable[[bool], "GANTrainer"],
                             "recovery.restart", step=step,
                             attempt=attempt,
                             backoff_s=round(delay, 3), error=repr(e))
-                except OSError:
-                    pass  # an unwritable res dir must not eat the retry
+                except Exception:
+                    # same never-mask discipline as the flight-record
+                    # dump above: ANY recorder failure (unwritable res
+                    # dir is OSError, but a concurrently-removed dir
+                    # can surface as ValueError from the closed/invalid
+                    # recorder state) must not eat the retry — the
+                    # marker is diagnostics, the restart is the product
+                    pass
             if delay:
                 time.sleep(delay)
 
 
+def add_health_args(parser) -> None:
+    """Shared CLI flags for the training-health supervision layer
+    (watchdog / divergence sentinel / rollback) — one definition so the
+    protocol mains cannot drift apart.  ``--nan-alarm`` itself stays
+    with each main (its help text carries workload-specific paths)."""
+    parser.add_argument(
+        "--divergence", action="store_true",
+        help="arm the windowed divergence sentinel (needs --telemetry): "
+             "trip on loss explosion / grad-norm blowup BEFORE NaNs "
+             "appear; the action on a trip is --nan-alarm's (warn when "
+             "unset) — pair with '--nan-alarm rollback' to heal")
+    parser.add_argument(
+        "--max-rollbacks", type=int, default=3, metavar="N",
+        help="rollback budget for '--nan-alarm rollback' (progress-"
+             "aware like --max-restarts: a rollback at a later step "
+             "than the previous one resets the counter); exhausted = "
+             "fatal escalation")
+    parser.add_argument(
+        "--rollback-lr-factor", type=float, default=0.5, metavar="F",
+        help="learning-rate multiplier applied per rollback "
+             "(compounding) — the healing half of rollback-with-"
+             "perturbation")
+    parser.add_argument(
+        "--watchdog", action="store_true",
+        help="arm the hang watchdog: heartbeat at every step/chunk "
+             "boundary and around every blocking region; a silent hang "
+             "(dead data source, wedged readback/collective) dumps a "
+             "flight record, takes a best-effort emergency checkpoint "
+             "and becomes a retryable WatchdogTimeout for "
+             "--max-restarts instead of a run stuck forever")
+    parser.add_argument(
+        "--watchdog-deadline", type=float, default=None, metavar="SEC",
+        help="fixed watchdog deadline in seconds (default: auto-scale "
+             "from the measured steady-state step time)")
+
+
+def health_config_kwargs(args) -> Dict:
+    """The add_health_args flags as GANTrainerConfig overrides."""
+    return dict(
+        divergence=args.divergence,
+        max_rollbacks=args.max_rollbacks,
+        rollback_lr_factor=args.rollback_lr_factor,
+        watchdog=args.watchdog,
+        watchdog_deadline_s=args.watchdog_deadline,
+    )
+
+
 def check_recovery_args(parser, args) -> None:
-    """Shared CLI validation for the mains' --max-restarts flag."""
+    """Shared CLI validation for the mains' recovery/health flags."""
     if args.max_restarts > 0 and args.checkpoint_every <= 0:
         parser.error("--max-restarts needs --checkpoint-every (without "
                      "checkpoints every restart replays from step 0)")
+    if getattr(args, "nan_alarm", None) == "rollback" \
+            and args.checkpoint_every <= 0:
+        parser.error("--nan-alarm rollback needs --checkpoint-every "
+                     "(rollback restores the last verified checkpoint "
+                     "from before the bad step; without checkpoints it "
+                     "can only replay from step 0)")
 
 
 def run_with_recovery(config: "GANTrainerConfig", make_workload,
@@ -337,15 +466,30 @@ def run_with_recovery(config: "GANTrainerConfig", make_workload,
     """Shared main wiring: construct the trainer (fresh workload each
     attempt, resume=True on retries) and train, optionally under
     train_with_recovery.  Returns (trainer, result) — the trainer is the
-    last (successful) one, for post-run evaluation."""
+    last (successful) one, for post-run evaluation.
+
+    With ``nan_alarm="rollback"`` a single ``RollbackManager`` is
+    created HERE and shared by every incarnation (the LR scale, RNG
+    epoch and rollback budget must survive the rebuild — a fresh
+    manager per attempt would reset them and loop forever), and the run
+    goes through ``train_with_recovery`` even at ``max_restarts=0`` so
+    the rollback restart path exists (other failures still re-raise
+    immediately: the restart budget stays 0)."""
     holder = {}
+    manager = None
+    if config.nan_alarm == "rollback":
+        from gan_deeplearning4j_tpu.train.rollback import RollbackManager
+
+        manager = RollbackManager(max_rollbacks=config.max_rollbacks,
+                                  lr_factor=config.rollback_lr_factor)
 
     def make_trainer(resume: bool) -> "GANTrainer":
         cfg = dataclasses.replace(config, resume=True) if resume else config
-        holder["trainer"] = GANTrainer(make_workload(), cfg)
+        holder["trainer"] = GANTrainer(make_workload(), cfg,
+                                       rollback_manager=manager)
         return holder["trainer"]
 
-    if max_restarts > 0:
+    if max_restarts > 0 or manager is not None:
         result = train_with_recovery(make_trainer, max_restarts=max_restarts)
     else:
         result = make_trainer(False).train()
@@ -360,9 +504,11 @@ def sync_params(dst, src, mapping) -> None:
 
 
 class GANTrainer:
-    def __init__(self, workload: Workload, config: GANTrainerConfig):
+    def __init__(self, workload: Workload, config: GANTrainerConfig,
+                 rollback_manager=None):
         self.w = workload
         self.c = config
+        self._rollback_mgr = rollback_manager
         if config.n_devices is not None and config.n_devices > 1 \
                 and config.batch_size % config.n_devices != 0:
             # an EXPLICIT mesh size must divide the batch — fail before
@@ -467,25 +613,55 @@ class GANTrainer:
             self._fit_gan = self.spark_gan.fit
             self._fit_clf = self.spark_clf.fit
 
-        if config.nan_alarm not in (None, "warn", "snapshot", "abort"):
+        if config.nan_alarm not in (None, "warn", "snapshot", "abort",
+                                    "rollback"):
             raise ValueError(
-                f"nan_alarm must be None/'warn'/'snapshot'/'abort', "
-                f"got {config.nan_alarm!r}")
+                f"nan_alarm must be None/'warn'/'snapshot'/'abort'/"
+                f"'rollback', got {config.nan_alarm!r}")
         if config.nan_alarm and not config.telemetry:
             raise ValueError(
                 "nan_alarm needs telemetry=True — without the in-graph "
                 "NaN/Inf counters there is nothing to trip on")
+        if config.divergence and not config.telemetry:
+            raise ValueError(
+                "divergence=True needs telemetry=True — the sentinel "
+                "watches the in-graph grad-norm/loss records")
         if config.telemetry and not self._fused_enabled:
             raise ValueError(
                 "telemetry=True requires the fused step (fused=True, "
                 "dp_mode='gradient_sync') — only the fused program "
                 "computes the in-graph numerics block")
+        if config.nan_alarm == "rollback" and rollback_manager is None:
+            raise ValueError(
+                "nan_alarm='rollback' needs a RollbackManager shared "
+                "across trainer incarnations (run_with_recovery wires "
+                "one; pass rollback_manager= when driving GANTrainer "
+                "directly) — a per-incarnation manager would reset the "
+                "LR cut, the RNG epoch and the budget on every rollback")
         self._nan_alarm = None
         self._nan_handled = False
         if config.nan_alarm:
             from gan_deeplearning4j_tpu.telemetry import NanAlarm
 
             self._nan_alarm = NanAlarm()
+        self._divergence = None
+        self._div_handled = False
+        if config.divergence:
+            from gan_deeplearning4j_tpu.train.divergence import (
+                DivergenceSentinel,
+            )
+
+            self._divergence = DivergenceSentinel(
+                window=config.divergence_window,
+                factor=config.divergence_factor,
+                patience=config.divergence_patience)
+        # rollback plumbing: a pending (reason, bad_step) set by the
+        # alarm polls and consumed by _maybe_rollback at the next
+        # boundary (multi-host: after the fleet consensus); the resume
+        # bound is installed by RollbackManager.apply below
+        self._rollback_pending: Optional[tuple] = None
+        self._resume_max_step: Optional[int] = None
+        self._watchdog = None
         # scrape registry (telemetry/exporter.py): fed from every
         # materialized metrics record (on the logger's worker thread)
         # and, at scrape time, from the live goodput ledger; served
@@ -546,13 +722,26 @@ class GANTrainer:
         # inline writer until train() swaps in the background one, so the
         # dump methods also work when called directly (tests, notebooks)
         self._dumper = AsyncArtifactWriter(synchronous=True)
+        if self._rollback_mgr is not None:
+            mgr = self._rollback_mgr
+            # mirror the manager's lifetime count into the scrape
+            # series at scrape time (monotonic — set_counter only
+            # raises it) and install the current perturbation: LR
+            # scale, noise-stream epoch, resume bound.  Must run before
+            # anything traces the updaters' LR constants into a program.
+            self.registry.add_callback(
+                lambda reg: reg.set_counter("gan4j_rollback_total",
+                                            float(mgr.total)))
+            mgr.apply(self)
 
     def _observe_record(self, rec: Dict) -> None:
         """MetricsLogger ``on_record`` hook (worker thread): every
-        materialized record feeds the NaN alarm AND the scrape
-        registry."""
+        materialized record feeds the NaN alarm, the divergence
+        sentinel AND the scrape registry."""
         if self._nan_alarm is not None:
             self._nan_alarm.observe(rec)
+        if self._divergence is not None:
+            self._divergence.observe(rec)
         self.registry.observe_record(rec)
 
     # -- artifact dumps ------------------------------------------------------
@@ -691,8 +880,9 @@ class GANTrainer:
         if jax.process_count() > 1:
             from gan_deeplearning4j_tpu.parallel import multihost
 
-            any_trig, agreed = multihost.agree_preemption(
-                guard.triggered, self.batch_counter)
+            with self._wd_region("collective.agree_preemption"):
+                any_trig, agreed = multihost.agree_preemption(
+                    guard.triggered, self.batch_counter)
         else:
             any_trig, agreed = guard.triggered, self.batch_counter
         if not any_trig:
@@ -726,8 +916,13 @@ class GANTrainer:
             logging.getLogger(__name__).info(
                 "resuming a preempted run (consuming %s)", marker)
             os.remove(marker)
+        # a rollback resume is BOUNDED: the manager recorded the first
+        # known-bad step, and restoring at-or-after it would replay the
+        # poisoned state the rollback exists to discard
+        max_step = self._resume_max_step
         try:
-            step, extra = self.checkpointer.restore(self._graphs())
+            step, extra = self.checkpointer.restore(self._graphs(),
+                                                    max_step=max_step)
         except NoVerifiedCheckpointError as e:
             # restore() already fell back as far as it could; an empty or
             # fully-torn directory means: start from step 0 (the
@@ -737,7 +932,18 @@ class GANTrainer:
 
             logging.getLogger(__name__).warning(
                 "resume requested but %s; starting from step 0", e)
+            if max_step is not None:
+                # still a rollback: the checkpoints ABOVE the bound are
+                # known-poisoned and must not be resumable later
+                self.checkpointer.prune_above(max_step)
+                self._consume_rollback_restore(0, max_step)
             return
+        if max_step is not None:
+            # the restore point is committed: drop the poisoned suffix
+            # (a later plain restart must never resume into it) and
+            # mark the timeline
+            self.checkpointer.prune_above(step)
+            self._consume_rollback_restore(step, max_step)
         self.batch_counter = step
         self.soften_real = jnp.asarray(extra["soften_real"])
         self.soften_fake = jnp.asarray(extra["soften_fake"])
@@ -768,6 +974,23 @@ class GANTrainer:
             steps_done += 1
             if not iter_train.has_next():
                 iter_train.reset()
+
+    def _consume_rollback_restore(self, restored_step: int,
+                                  max_step: int) -> None:
+        """One rollback restore happened: emit the ``rollback.restore``
+        timeline marker (the overlay vocabulary, telemetry/events.py)
+        and clear the resume bound — it applied to THIS restore only; a
+        later plain restart of the same run must resume from wherever
+        the healed run has checkpointed since."""
+        mgr = self._rollback_mgr
+        events.instant(
+            "rollback.restore", step=restored_step,
+            bad_step=max_step + 1,
+            rollbacks=getattr(mgr, "total", None),
+            lr_scale=getattr(mgr, "lr_scale", None))
+        self._resume_max_step = None
+        if mgr is not None:
+            mgr.restore_before = None
 
     # -- the loop ------------------------------------------------------------
 
@@ -817,6 +1040,24 @@ class GANTrainer:
                 enabled=c.events, append=c.resume)
             self._events = recorder
             prev_recorder = events.install(recorder)
+            if c.watchdog:
+                # armed AFTER the recorder install so the timeout event
+                # and flight record land in this run's timeline; beats
+                # come from the goodput-phase wrapper (_phase) and the
+                # step/chunk bookkeeping
+                from gan_deeplearning4j_tpu.train.watchdog import (
+                    HeartbeatWatchdog,
+                )
+
+                self._watchdog = HeartbeatWatchdog(
+                    deadline_s=c.watchdog_deadline_s,
+                    warmup_s=c.watchdog_warmup_s,
+                    scale=c.watchdog_scale,
+                    min_deadline_s=c.watchdog_min_deadline_s,
+                    on_timeout=self._watchdog_emergency,
+                    res_path=c.res_path)
+                self._watchdog.start()
+                self.registry.observe_watchdog(self._watchdog.report)
             if c.metrics_port is not None:
                 from gan_deeplearning4j_tpu.telemetry import serve_exporter
 
@@ -827,6 +1068,11 @@ class GANTrainer:
                     f"http://127.0.0.1:{stop_exporter.port}")
             return self._train_impl(log)
         finally:
+            if self._watchdog is not None:
+                # disarm FIRST: no async raise may land while the
+                # teardown below runs (stop() joins the poll thread)
+                self._watchdog.stop()
+                self._watchdog = None
             if stop_exporter is not None:
                 stop_exporter()
             if prev_recorder is not None:
@@ -1145,7 +1391,11 @@ class GANTrainer:
             self.metrics.log_record(
                 {"goodput": goodput, "run_id": run_id})
             self.metrics.flush()
-        self._poll_nan_alarm()  # a trip materialized by the final flush
+        # trips materialized only by the final flush still get their
+        # action — including a rollback of the run's last window
+        self._poll_nan_alarm()
+        self._poll_divergence()
+        self._maybe_rollback()
         events.instant("train.end", step=self.batch_counter)
         return {
             "steps": self.batch_counter,
@@ -1278,12 +1528,25 @@ class GANTrainer:
 
     def _phase(self, name: str):
         """Goodput phase context, or a no-op outside train() (tests and
-        notebooks may drive the dump/bookkeeping methods directly)."""
-        if self.goodput is not None:
-            return self.goodput.phase(name)
+        notebooks may drive the dump/bookkeeping methods directly).
+        With the watchdog armed, every phase doubles as a heartbeat
+        region: beat on entry and exit, and the phase name is what a
+        timeout reports as "in flight" — the goodput phases are exactly
+        the trainer's blocking regions (data wait, dispatch, readback,
+        checkpoint barrier, eval)."""
         from contextlib import nullcontext
 
-        return nullcontext()
+        ctx = (self.goodput.phase(name) if self.goodput is not None
+               else nullcontext())
+        wd = self._watchdog
+        if wd is None:
+            return ctx
+        from contextlib import ExitStack
+
+        stack = ExitStack()
+        stack.enter_context(wd.region(name))
+        stack.enter_context(ctx)
+        return stack
 
     def _resident_loop(self, features, labels, iter_test, fused_state,
                        log) -> None:
@@ -1442,6 +1705,16 @@ class GANTrainer:
             self._step_bookkeeping(iter_test, d_loss, g_loss, c_loss, log,
                                    tel)
 
+    def _wd_region(self, name: str):
+        """Watchdog heartbeat region (no goodput phase) — for blocking
+        regions that are not phases, e.g. the multihost consensus
+        collectives."""
+        if self._watchdog is not None:
+            return self._watchdog.region(name)
+        from contextlib import nullcontext
+
+        return nullcontext()
+
     def _chunk_bookkeeping(self, iter_test, d, g, cl, n, log,
                            tel=None) -> None:
         """Bookkeeping for one multi-step dispatch: ONE chunk metrics
@@ -1454,6 +1727,8 @@ class GANTrainer:
         c = self.c
         start = self.batch_counter
         self.batch_counter += n
+        if self._watchdog is not None:
+            self._watchdog.beat(step=self.batch_counter)
         # examples=0: on the async resident path the host free-runs ahead
         # of the device, so inter-chunk wall time measures dispatch, not
         # compute — a per-step examples_per_sec from it would be fiction.
@@ -1471,6 +1746,8 @@ class GANTrainer:
                           tel=None) -> None:
         c = self.c
         self.batch_counter += 1
+        if self._watchdog is not None:
+            self._watchdog.beat(step=self.batch_counter)
         self.metrics.log_step(
             self.batch_counter, examples=c.batch_size,
             d_loss=d_loss, g_loss=g_loss, classifier_loss=c_loss,
@@ -1494,6 +1771,14 @@ class GANTrainer:
                 self._final_state, self.dis, self.gen, self.gan,
                 self.classifier)
 
+        # health polls FIRST: a tripped alarm with an abort/rollback
+        # action must unwind BEFORE this boundary checkpoints the
+        # known-bad state it just detected (detection granularity is
+        # the metrics flush cadence, so this only narrows the window —
+        # the rollback resume bound closes it for good)
+        self._poll_nan_alarm()
+        self._poll_divergence()
+        self._maybe_rollback()
         if self.batch_counter % c.print_every == 0:
             with self._phase("eval"), \
                     events.span("eval.grid", step=self.batch_counter):
@@ -1507,7 +1792,6 @@ class GANTrainer:
             with self._phase("checkpoint"):
                 self._maybe_checkpoint()
         self._maybe_preempt()
-        self._poll_nan_alarm()
 
     def _poll_nan_alarm(self) -> None:
         """Apply the configured nan_alarm action once the async worker
@@ -1549,3 +1833,134 @@ class GANTrainer:
             # the snapshot carries the event timeline that led to it
             events.dump_flight_record(snap_dir, "nan_alarm",
                                       extra={"step": alarm.step})
+        elif self.c.nan_alarm == "rollback":
+            # the heal path: consumed by _maybe_rollback at this same
+            # boundary (multi-host: after the fleet consensus).  The
+            # params went non-finite AT alarm.step, so the restore must
+            # land strictly before it.
+            self._request_rollback(msg, alarm.step)
+
+    def _request_rollback(self, reason: str, bad_step) -> None:
+        """Record a rollback request for the next ``_maybe_rollback``
+        poll.  When BOTH alarms trip in one detection window (the NaN
+        alarm and the divergence sentinel can fire off the same flush),
+        the EARLIER bad step wins — restoring inside the later alarm's
+        window could land on a checkpoint the earlier alarm already
+        condemned."""
+        if bad_step is None:
+            bad_step = self.batch_counter
+        pending = self._rollback_pending
+        if pending is not None and pending[1] <= bad_step:
+            return  # the existing request already bounds tighter
+        self._rollback_pending = (reason, bad_step)
+
+    def _poll_divergence(self) -> None:
+        """Apply the configured action once the divergence sentinel has
+        tripped (same latched/poll discipline as the NaN alarm — the
+        sentinel observes on the metrics worker thread, the loop reacts
+        at its bookkeeping points).  The action vocabulary is shared
+        with nan_alarm (warn when unset); abort raises DivergenceError,
+        FATAL in the recovery wrapper (a deterministic replay
+        re-diverges identically) — rollback is the action that heals."""
+        sentinel = self._divergence
+        if sentinel is None or self._div_handled or not sentinel.tripped:
+            return
+        self._div_handled = True
+        action = self.c.nan_alarm or "warn"
+        run_id = (self.run_manifest or {}).get("run_id", "?")
+        msg = f"{sentinel.describe()} (run {run_id})"
+        events.instant("alarm.divergence", step=sentinel.step,
+                       key=sentinel.key, value=sentinel.value,
+                       baseline=sentinel.baseline, action=action)
+        if action == "abort":
+            from gan_deeplearning4j_tpu.train.divergence import (
+                DivergenceError,
+            )
+
+            events.dump_flight_record(
+                self.c.res_path, "divergence",
+                extra={"step": sentinel.step, "key": sentinel.key})
+            raise DivergenceError(msg)
+        import logging
+
+        logging.getLogger(__name__).warning("%s", msg)
+        if action == "snapshot":
+            snap_dir = os.path.join(self.c.res_path,
+                                    "divergence_snapshot")
+            with self._phase("checkpoint"):
+                self._emergency_checkpoint(directory=snap_dir, keep=1)
+            events.dump_flight_record(
+                snap_dir, "divergence",
+                extra={"step": sentinel.step, "key": sentinel.key})
+        elif action == "rollback":
+            self._request_rollback(msg, sentinel.step)
+
+    def _maybe_rollback(self) -> None:
+        """Boundary poll of the rollback path (train/rollback.py).
+
+        Multi-host: the ``agree_rollback`` allgather is entered by
+        EVERY host at every boundary while a manager is armed — the
+        same unconditional-collective discipline as ``_maybe_preempt``
+        — so one host's alarm rolls the whole fleet back together and
+        a partially-alarmed fleet can never strand itself inside a
+        mismatched collective.  On agreement: charge the (progress-
+        aware) budget, leave the timeline behind, and unwind through
+        ``RollbackRequested`` — or ``RollbackError`` once the budget is
+        exhausted (fatal in the recovery wrapper)."""
+        mgr = self._rollback_mgr
+        if mgr is None:
+            return
+        pending = self._rollback_pending
+        if jax.process_count() > 1:
+            from gan_deeplearning4j_tpu.parallel import multihost
+
+            with self._wd_region("collective.agree_rollback"):
+                any_trig, agreed, fleet_bad = multihost.agree_rollback(
+                    pending is not None, self.batch_counter,
+                    pending[1] if pending is not None else None)
+        else:
+            any_trig, agreed = pending is not None, self.batch_counter
+            fleet_bad = pending[1] if pending is not None else None
+        if not any_trig:
+            return
+        from gan_deeplearning4j_tpu.train.rollback import (
+            RollbackError,
+            RollbackRequested,
+        )
+
+        # EVERY host restores before the fleet-agreed (min) bad step —
+        # per-host restore points would desync the SPMD state; with no
+        # agreed bad step (defensive: cannot happen when any_trig came
+        # from a real alarm) fall back to the boundary step
+        bad_step = fleet_bad if fleet_bad is not None else agreed
+        reason = (pending[0] if pending is not None
+                  else "peer host rollback consensus")
+        self._rollback_pending = None
+        ok = mgr.request(self.batch_counter, reason, bad_step=bad_step)
+        events.instant("rollback.request", step=self.batch_counter,
+                       bad_step=bad_step, rollbacks=mgr.total,
+                       attempts=mgr.attempts,
+                       lr_scale=mgr.lr_scale, reason=reason)
+        events.dump_flight_record(
+            self.c.res_path, "rollback",
+            extra={"step": self.batch_counter, "bad_step": bad_step,
+                   "rollbacks": mgr.total, "reason": reason})
+        if not ok:
+            raise RollbackError(
+                f"rollback budget exhausted ({mgr.attempts - 1}/"
+                f"{mgr.max_rollbacks} at step {self.batch_counter} "
+                f"without progress): {reason}")
+        raise RollbackRequested(
+            f"rollback #{mgr.total} at step {self.batch_counter}: "
+            f"{reason}",
+            step=self.batch_counter, rollbacks=mgr.total)
+
+    def _watchdog_emergency(self) -> None:
+        """Watchdog ``on_timeout`` action (runs on the watchdog's
+        sacrificial thread, bounded join): best-effort emergency
+        checkpoint of the state as of the last dispatched step.  On a
+        DATA hang the device is idle and this commits a resume point at
+        the exact stall step; on a device hang it blocks on the same
+        hang and is abandoned by the watchdog — the restart then falls
+        back to the last periodic checkpoint."""
+        self._emergency_checkpoint()

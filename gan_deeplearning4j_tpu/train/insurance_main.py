@@ -23,7 +23,9 @@ from gan_deeplearning4j_tpu.train.gan_trainer import (
     GANTrainer,
     GANTrainerConfig,
     Workload,
+    add_health_args,
     check_recovery_args,
+    health_config_kwargs,
     run_with_recovery,
 )
 
@@ -138,7 +140,7 @@ def main(argv=None) -> Dict[str, float]:
                         "also always writes res-path/run_manifest.json "
                         "and a goodput phase breakdown")
     p.add_argument("--nan-alarm", default=None,
-                   choices=["warn", "snapshot", "abort"],
+                   choices=["warn", "snapshot", "abort", "rollback"],
                    help="action on the first non-finite step (needs "
                         "--telemetry): warn = log and continue; snapshot "
                         "= save a forensic checkpoint to "
@@ -146,7 +148,13 @@ def main(argv=None) -> Dict[str, float]:
                         "checkpoint path) and continue; abort = raise; "
                         "the recovery wrapper classifies the abort as "
                         "FATAL — a deterministic replay would hit the "
-                        "same NaN, so --max-restarts is not burned on it)")
+                        "same NaN, so --max-restarts is not burned on it; "
+                        "rollback = heal in-process: restore the last "
+                        "verified pre-NaN checkpoint, cut the LR by "
+                        "--rollback-lr-factor and perturb the noise "
+                        "stream so the replay differs (needs "
+                        "--checkpoint-every; docs/FAULT_TOLERANCE.md)")
+    add_health_args(p)
     from gan_deeplearning4j_tpu.runtime import backend
 
     backend.add_bf16_flag(p)
@@ -179,6 +187,7 @@ def main(argv=None) -> Dict[str, float]:
         telemetry=args.telemetry,
         nan_alarm=args.nan_alarm,
         metrics_port=args.metrics_port,
+        **health_config_kwargs(args),
     )
     from gan_deeplearning4j_tpu.utils import maybe_trace, print_trace_summary
 

@@ -1,0 +1,214 @@
+"""Rollback-with-perturbation — heal a diverging run instead of dying.
+
+``--nan-alarm abort`` is deliberately FATAL in the recovery wrapper:
+the data order and the training z-stream are counter-based functions of
+the seed and the step index, so a deterministic replay from the last
+checkpoint marches straight back into the same NaN (train/
+gan_trainer.py:~230).  That logic also shows the way out — make the
+replay NOT deterministic.  ``--nan-alarm rollback`` (shared by the
+divergence sentinel, train/divergence.py) does three things instead of
+raising a fatal error:
+
+1. **restore** the last verified checkpoint from BEFORE the bad step,
+   in-process (the trainer raises ``RollbackRequested``; the recovery
+   wrapper rebuilds the trainer with ``resume=True`` — no process exit,
+   no scheduler round trip — and the resume path restores with
+   ``max_step`` excluding the poisoned suffix, then prunes it);
+2. **cut the learning rate** by ``lr_factor`` (compounding per
+   rollback) — the classic divergence remedy the reference hand-tuned
+   around;
+3. **advance the noise RNG stream**: the training z-key and the fused
+   dropout key are folded with a per-rollback salt, so the replayed
+   window draws DIFFERENT latents and the run explores a different
+   trajectory out of the basin that produced the blowup.
+
+The budget is progress-aware like the restart budget: a rollback at a
+LATER step than the previous one resets the attempt counter (the run is
+getting somewhere; each incident taxes it once), while repeated
+rollbacks at the same step exhaust ``max_rollbacks`` and escalate to
+``RollbackError`` — which the recovery wrapper classifies FATAL, the
+same end state ``abort`` reaches immediately.
+
+One ``RollbackManager`` must be shared across every trainer incarnation
+of a run (``run_with_recovery`` owns it): the LR scale, the RNG epoch
+and the budget all live on it, and a per-incarnation manager would
+reset them on every restart — an infinite rollback loop.  Multi-host
+fleets agree through ``parallel/multihost.agree_rollback`` (mirrors
+``agree_preemption``): every host polls the consensus at each armed
+boundary, so one host's alarm rolls the whole fleet back together.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+from typing import Optional
+
+import jax
+
+_log = logging.getLogger(__name__)
+
+# fold_in salt namespacing the rollback perturbation away from every
+# other derived stream (runtime/prng.py folds small indices; step
+# folding uses 2*i(+1)) — any large constant works, it just must be
+# reserved for this purpose
+PERTURB_SALT = 0x5EED_BACC
+
+
+class RollbackRequested(RuntimeError):
+    """The trainer wants an in-process rollback: restore the last
+    verified pre-failure checkpoint, apply the manager's perturbation,
+    and continue.  ``train_with_recovery`` handles it WITHOUT burning
+    the restart budget (the rollback budget is the manager's own)."""
+
+    def __init__(self, msg: str, step: Optional[int] = None,
+                 rollbacks: int = 0):
+        super().__init__(msg)
+        self.step = step
+        self.rollbacks = rollbacks
+
+
+class RollbackError(RuntimeError):
+    """The rollback budget is exhausted (same step keeps failing even
+    with the LR cut and perturbed noise): escalate to fatal — the same
+    end state ``--nan-alarm abort`` reaches immediately, after
+    ``max_rollbacks`` genuine healing attempts."""
+
+
+class RollbackManager:
+    """Cross-incarnation rollback state: budget, LR scale, RNG epoch.
+
+    ``request(step, reason, bad_step=...)`` charges the (progress-aware)
+    budget and records where the poison starts; ``apply(trainer)`` is
+    called by every new trainer incarnation and installs the current
+    perturbation — LR scale on all four graphs' updaters, fold-in epoch
+    on the z/dropout streams, and the resume bound that keeps the
+    restore strictly before the bad step."""
+
+    def __init__(self, max_rollbacks: int = 3, lr_factor: float = 0.5):
+        if not 0.0 < lr_factor <= 1.0:
+            raise ValueError(
+                f"lr_factor must be in (0, 1], got {lr_factor} "
+                "(a factor > 1 would amplify the divergence being "
+                "healed)")
+        if max_rollbacks < 1:
+            raise ValueError("max_rollbacks must be >= 1")
+        self.max_rollbacks = int(max_rollbacks)
+        self.lr_factor = float(lr_factor)
+        self.total = 0              # lifetime count: LR compounding + metrics
+        self.attempts = 0           # progress-aware budget window
+        self.last_step: Optional[int] = None
+        self.restore_before: Optional[int] = None
+        self.last_reason: Optional[str] = None
+
+    @property
+    def lr_scale(self) -> float:
+        return self.lr_factor ** self.total
+
+    @property
+    def exhausted(self) -> bool:
+        return self.attempts > self.max_rollbacks
+
+    def request(self, step: int, reason: str,
+                bad_step: Optional[int] = None) -> bool:
+        """Charge one rollback at ``step``.  ``bad_step``: the first
+        step whose state is known-poisoned (the alarm step); the resume
+        restores strictly before it.  Returns False when the budget is
+        exhausted (the caller escalates to ``RollbackError``)."""
+        if self.last_step is not None and step > self.last_step:
+            self.attempts = 0  # progress since the last incident
+        self.last_step = step
+        self.attempts += 1
+        self.total += 1
+        self.restore_before = bad_step if bad_step is not None else step
+        self.last_reason = reason
+        return not self.exhausted
+
+    # -- applying the perturbation --------------------------------------------
+
+    def apply(self, trainer) -> None:
+        """Install the current perturbation on a fresh trainer
+        incarnation (called from ``GANTrainer.__init__``, before
+        anything traces the updaters' LR constants into a program).
+        A manager that has never rolled back is a no-op."""
+        if not self.total:
+            return
+        scale = self.lr_scale
+        scaled = 0
+        for graph in trainer._graphs().values():
+            scaled += scale_graph_lr(graph, scale)
+        trainer._z_base = perturb_key(trainer._z_base, self.total)
+        trainer._fused_rng = perturb_key(trainer._fused_rng, self.total)
+        # keep the restore strictly before the known-bad step and let
+        # the resume path prune the poisoned suffix once restored
+        trainer._resume_max_step = (
+            None if self.restore_before is None
+            else self.restore_before - 1)
+        _log.warning(
+            "rollback #%d applied: lr x%.4g on %d layer updaters, noise "
+            "stream advanced (epoch %d), resuming before step %s",
+            self.total, scale, scaled, self.total, self.restore_before)
+
+
+def perturb_key(key, epoch: int):
+    """Advance a PRNG stream to the ``epoch``-th rollback lineage: the
+    replayed window must NOT redraw the latents that produced the
+    blowup.  fold_in keeps it a pure function of (seed, epoch) — two
+    hosts of a fleet at the same epoch still derive identical streams,
+    which the SPMD step requires."""
+    return jax.random.fold_in(key, PERTURB_SALT + epoch)
+
+
+def _scaled_updater(up, scale: float):
+    """One layer updater scaled by ``scale``, or None when there is
+    nothing to scale (frozen lr-0 layers, unknown kinds).  Handles the
+    three updater shapes the stack carries: plain frozen dataclasses
+    with a ``learning_rate`` field (RmsProp/Adam/...), ``Scheduled``
+    wrappers (``learning_rate`` is a read-only property — the scale
+    goes onto the schedule's ``initial_lr``, a pure multiplier in every
+    schedule kind, so the WHOLE trajectory scales), and mutable custom
+    updaters (setattr)."""
+    sched = getattr(up, "schedule", None)
+    if sched is not None and getattr(sched, "initial_lr", None):
+        return dataclasses.replace(
+            up, schedule=dataclasses.replace(
+                sched, initial_lr=sched.initial_lr * scale))
+    lr = getattr(up, "learning_rate", None)
+    if not lr:  # absent or 0.0 (frozen)
+        return None
+    try:
+        return dataclasses.replace(up, learning_rate=lr * scale)
+    except (TypeError, ValueError):
+        pass  # not a dataclass, or learning_rate not an init field
+    up.learning_rate = lr * scale  # mutable custom updater
+    return up
+
+
+def scale_graph_lr(graph, scale: float) -> int:
+    """Multiply every trainable layer updater's learning rate by
+    ``scale`` (frozen lr-0 layers stay frozen).  The updaters are
+    frozen dataclasses shared by reference between graphs, so each is
+    REPLACED, never mutated.  Returns the number of layer updaters
+    rescaled; an updater whose shape defeats scaling is SKIPPED with a
+    loud warning — the rollback is the healing path, and crashing it
+    over one exotic layer would be worse than a partial LR cut.  Must
+    run before the graph's update rule is traced (fresh graphs only):
+    the LRs are compile-time constants of the fused program."""
+    updater = getattr(graph, "updater", None)
+    if updater is None:
+        return 0
+    ups = updater.layer_updaters
+    n = 0
+    for name, up in list(ups.items()):
+        try:
+            scaled = _scaled_updater(up, scale)
+        except Exception as e:
+            _log.warning(
+                "rollback LR cut skipped layer %r (updater %r: %r)",
+                name, type(up).__name__, e)
+            continue
+        if scaled is None:
+            continue
+        ups[name] = scaled
+        n += 1
+    return n

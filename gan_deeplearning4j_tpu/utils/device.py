@@ -12,10 +12,16 @@ tunnel, ~70ms round trip):
 
 from __future__ import annotations
 
-from typing import Any
+from typing import Any, Callable, Optional
 
 import jax
 import numpy as np
+
+# fault-injection seam (testing/chaos.py hang_at_readback): called at
+# the top of device_fence so a chaos test can simulate a device
+# readback that never completes — the hang class the watchdog
+# (train/watchdog.py) exists to catch.  None in production.
+_chaos_readback_hook: Optional[Callable[[], None]] = None
 
 
 def overlap_device_get(tree: Any) -> Any:
@@ -50,5 +56,7 @@ def device_fence(tree: Any) -> None:
     overlapped readback of ALL array leaves — block_until_ready is NOT a
     fence on tunneled backends, and reading a single leaf would not fence
     later-dispatched programs producing the other leaves."""
+    if _chaos_readback_hook is not None:
+        _chaos_readback_hook()
     overlap_device_get([a for a in jax.tree_util.tree_leaves(tree)
                         if hasattr(a, "dtype")])

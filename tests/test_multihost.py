@@ -131,3 +131,89 @@ def test_two_process_gradient_sync_matches_single_host(tmp_path):
     for pid in (0, 1):
         np.testing.assert_allclose(
             np.asarray(results[pid]["grad"]), ref, rtol=1e-5, atol=1e-6)
+
+
+# -- consensus math (mocked allgather: no cluster, no devices) ---------------
+#
+# agree_preemption / agree_rollback are collectives, so their MATH
+# (any-triggered, min-step) is pinned here against a mocked
+# process_allgather standing in for an N-host fleet: the local host's
+# gathered row is the array the function actually passed in, the peers'
+# rows are the fixture's — exactly the shape a real DCN allgather
+# returns, without needing a jax.distributed rendezvous in the test.
+
+
+import pytest
+
+from gan_deeplearning4j_tpu.parallel import multihost
+
+
+def _mock_fleet(monkeypatch, peer_rows):
+    """Mock an N-host fleet: ``peer_rows`` are the OTHER hosts' payload
+    rows (any width); the local call's array is appended as the last
+    row, mirroring a real allgather's [n_proc, payload] result."""
+    import jax
+    from jax.experimental import multihost_utils
+
+    monkeypatch.setattr(jax, "process_count",
+                        lambda: len(peer_rows) + 1)
+
+    def fake_allgather(arr):
+        rows = [np.asarray(r, np.int64) for r in peer_rows]
+        rows.append(np.asarray(arr))
+        return np.stack(rows)
+
+    monkeypatch.setattr(multihost_utils, "process_allgather",
+                        fake_allgather)
+
+
+_NO_BAD = multihost._NO_BAD_STEP
+
+
+def test_consensus_single_process_passthrough():
+    # no mock: jax.process_count() == 1 in the test rig — pure identity,
+    # no device contact
+    assert multihost.agree_preemption(True, 7) == (True, 7)
+    assert multihost.agree_preemption(False, 3) == (False, 3)
+    assert multihost.agree_rollback(True, 7, 5) == (True, 7, 5)
+    assert multihost.agree_rollback(False, 3) == (False, 3, None)
+
+
+def test_preemption_any_triggered_takes_fleet(monkeypatch):
+    # only a PEER host got the signal: the unsignaled local host must
+    # still agree to act (one evicted host takes the fleet with it)
+    _mock_fleet(monkeypatch, [[1, 9], [0, 9]])
+    assert multihost.agree_preemption(False, 9) == (True, 9)
+
+
+def test_preemption_min_step_wins(monkeypatch):
+    # a straggler host at an earlier step: the fleet-agreed step is the
+    # MIN (the only step every host's checkpoint can satisfy)
+    _mock_fleet(monkeypatch, [[1, 5], [0, 11]])
+    assert multihost.agree_preemption(False, 7) == (True, 5)
+
+
+def test_preemption_none_triggered_is_quiet(monkeypatch):
+    _mock_fleet(monkeypatch, [[0, 4], [0, 6]])
+    assert multihost.agree_preemption(False, 5) == (False, 4)
+
+
+def test_rollback_any_triggered_and_min_bad_step(monkeypatch):
+    # only a peer's alarm tripped: the whole fleet rolls back, bounded
+    # by the PEER's bad step (the local host contributes no bound)
+    _mock_fleet(monkeypatch, [[1, 9, 6], [0, 9, _NO_BAD]])
+    assert multihost.agree_rollback(False, 9) == (True, 9, 6)
+
+
+def test_rollback_min_bad_step_across_alarmed_hosts(monkeypatch):
+    # two hosts alarmed at different steps: everyone restores before
+    # the EARLIEST bad step — per-host restore points would desync SPMD
+    _mock_fleet(monkeypatch, [[1, 10, 8], [0, 10, _NO_BAD]])
+    assert multihost.agree_rollback(True, 10, 5) == (True, 10, 5)
+    _mock_fleet(monkeypatch, [[1, 10, 3], [0, 10, _NO_BAD]])
+    assert multihost.agree_rollback(True, 10, 5) == (True, 10, 3)
+
+
+def test_rollback_none_triggered_is_quiet(monkeypatch):
+    _mock_fleet(monkeypatch, [[0, 4, _NO_BAD], [0, 6, _NO_BAD]])
+    assert multihost.agree_rollback(False, 5) == (False, 4, None)

@@ -1,0 +1,761 @@
+"""Training-health supervision suite — hang watchdog, divergence
+sentinel, rollback-with-perturbation (train/watchdog.py,
+train/divergence.py, train/rollback.py; docs/FAULT_TOLERANCE.md "Hangs,
+divergence, and rollback").
+
+Fast tier (tier-1 AND the CI hang-injection lane):
+  * watchdog unit behavior: fires on a quiet thread (async raise
+    delivered), beats prevent firing, deadline auto-scales from the
+    measured inter-beat interval, foreign-thread beats are ignored, a
+    wedged emergency action is abandoned on its bounded join;
+  * the /healthz stalled contract: 503 + ``"stalled": true`` while the
+    heartbeat is quiet, 200 otherwise; ``gan4j_watchdog_*`` and
+    ``gan4j_rollback_total`` series exist;
+  * divergence sentinel: windowed median rule, patience, latching;
+  * rollback manager: progress-aware budget, compounding LR scale,
+    noise-stream perturbation, bounded restore + poisoned-suffix prune;
+  * recovery classification: RollbackRequested burns NO restart budget,
+    RollbackError/DivergenceError are fatal;
+  * END TO END (the acceptance bar): a run whose data source hangs
+    FOREVER finishes to the target step count via watchdog-restart
+    (``test_e2e_hang_watchdog_restart_finishes`` — the CI hang lane's
+    external ``timeout`` is the backstop proving the INTERNAL watchdog
+    fired first), and a run whose source injects NaNs finishes via
+    rollback-with-perturbation, with the events.jsonl timeline carrying
+    the ``watchdog.timeout`` / ``rollback.restore`` markers and
+    /healthz flipping stalled -> healthy.
+
+Every test is bounded by the same SIGALRM fixture as the chaos suite —
+an injected hang must fail the test, never the runner.
+"""
+
+import json
+import os
+import signal
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from gan_deeplearning4j_tpu.checkpoint import TrainCheckpointer
+from gan_deeplearning4j_tpu.telemetry import MetricsRegistry, serve_exporter
+from gan_deeplearning4j_tpu.telemetry.events import read_events
+from gan_deeplearning4j_tpu.testing import HangingSource, NanSource
+from gan_deeplearning4j_tpu.train.divergence import (
+    DivergenceError,
+    DivergenceSentinel,
+)
+from gan_deeplearning4j_tpu.train.rollback import (
+    RollbackError,
+    RollbackManager,
+    RollbackRequested,
+    perturb_key,
+    scale_graph_lr,
+)
+from gan_deeplearning4j_tpu.train.watchdog import (
+    HeartbeatWatchdog,
+    WatchdogTimeout,
+)
+
+SEED = 666
+
+
+@pytest.fixture(autouse=True)
+def _test_deadline():
+    """Per-test deadline (as in tests/test_chaos.py): an injected hang
+    must FAIL the test, not wedge the runner."""
+    limit = int(os.environ.get("CHAOS_TEST_TIMEOUT", "300"))
+    if not hasattr(signal, "SIGALRM"):
+        yield
+        return
+
+    def on_alarm(signum, frame):
+        raise TimeoutError(f"supervision test exceeded {limit}s deadline")
+
+    prev = signal.signal(signal.SIGALRM, on_alarm)
+    signal.alarm(limit)
+    try:
+        yield
+    finally:
+        signal.alarm(0)
+        signal.signal(signal.SIGALRM, prev)
+
+
+# -- watchdog units -----------------------------------------------------------
+
+
+def _victim(caught, beats=0.0, life_s=30.0):
+    """A thread that idles (optionally beating) until WatchdogTimeout
+    lands or its life expires; records what it caught."""
+
+    def run(wd):
+        t0 = time.perf_counter()
+        try:
+            while time.perf_counter() - t0 < life_s:
+                if beats:
+                    wd.beat(step=1)
+                    time.sleep(beats)
+                else:
+                    time.sleep(0.02)
+        except WatchdogTimeout:
+            caught["timeout"] = True
+
+    return run
+
+
+def test_watchdog_fires_and_raises_on_monitored_thread(tmp_path):
+    caught = {}
+    wd = HeartbeatWatchdog(deadline_s=0.5, poll_s=0.05,
+                           res_path=str(tmp_path))
+    t = threading.Thread(target=_victim(caught), args=(wd,))
+    t.start()
+    wd.start(thread=t)
+    t.join(timeout=20)
+    wd.stop()
+    assert caught.get("timeout"), "WatchdogTimeout not delivered"
+    assert wd.fired and wd.timeouts == 1
+    # the flight record landed next to where the artifacts live
+    assert os.path.exists(
+        os.path.join(str(tmp_path), "flight_record_watchdog_timeout.json"))
+
+
+def test_watchdog_beats_prevent_firing():
+    caught = {}
+    wd = HeartbeatWatchdog(deadline_s=0.5, poll_s=0.05)
+    t = threading.Thread(
+        target=_victim(caught, beats=0.05, life_s=1.5), args=(wd,))
+    t.start()
+    wd.start(thread=t)
+    t.join(timeout=20)
+    wd.stop()
+    assert not wd.fired and "timeout" not in caught
+
+
+def test_watchdog_deadline_autoscale():
+    wd = HeartbeatWatchdog(scale=10.0, min_deadline_s=0.01,
+                           warmup_s=99.0, min_intervals=3)
+    wd.start()
+    try:
+        # warmup until steady state is observable (step beats + history)
+        assert wd.effective_deadline() == 99.0
+        for _ in range(6):
+            wd.beat(step=1)
+            time.sleep(0.02)
+        d = wd.effective_deadline()
+        # ~10 x ~20ms, robust to scheduler noise
+        assert 0.05 < d < 3.0
+        rep = wd.report()
+        assert rep["deadline_s"] == d and rep["timeouts_total"] == 0
+    finally:
+        wd.stop()
+
+
+def test_watchdog_ignores_foreign_thread_beats():
+    caught = {}
+    wd = HeartbeatWatchdog(deadline_s=0.5, poll_s=0.05)
+    t = threading.Thread(target=_victim(caught), args=(wd,))
+    t.start()
+    wd.start(thread=t)
+    deadline = time.perf_counter() + 3.0
+    while time.perf_counter() < deadline and not wd.fired:
+        wd.beat(step=9)  # from the TEST thread: must not count
+        time.sleep(0.02)
+    t.join(timeout=20)
+    wd.stop()
+    assert caught.get("timeout"), \
+        "foreign-thread beats masked the hang"
+
+
+def test_watchdog_wedged_emergency_action_is_abandoned():
+    """An on_timeout that hangs (the device hang it was racing got it
+    too) is bounded by its join — the raise still happens."""
+    caught = {}
+    entered = threading.Event()
+
+    def wedged_emergency():
+        entered.set()
+        while True:
+            time.sleep(0.05)
+
+    wd = HeartbeatWatchdog(deadline_s=0.4, poll_s=0.05,
+                           on_timeout=wedged_emergency,
+                           emergency_timeout_s=0.3)
+    t = threading.Thread(target=_victim(caught), args=(wd,))
+    t.start()
+    wd.start(thread=t)
+    t.join(timeout=20)
+    wd.stop()
+    assert entered.is_set() and caught.get("timeout")
+
+
+def test_watchdog_region_floor_outlasts_tight_auto_deadline():
+    """While a declared slow region (checkpoint) is open, the AUTO
+    deadline is floored at the region's allowance — a legitimate 10s
+    sync save must not be declared a hang by a tight steady-state
+    deadline.  An EXPLICIT deadline is the operator's number and is
+    NOT raised by the floors."""
+    wd = HeartbeatWatchdog(scale=10.0, min_deadline_s=0.2,
+                           warmup_s=99.0, min_intervals=3,
+                           region_floors={"checkpoint": 30.0})
+    wd.start()
+    try:
+        for _ in range(5):  # steady state: tight auto deadline
+            wd.beat(step=1)
+            time.sleep(0.005)
+        base = wd.effective_deadline()
+        assert base < 5.0
+        with wd.region("checkpoint"):
+            assert wd.effective_deadline() == 30.0
+        assert wd.effective_deadline() == pytest.approx(base, rel=0.9)
+    finally:
+        wd.stop()
+
+    fixed = HeartbeatWatchdog(deadline_s=0.5,
+                              region_floors={"checkpoint": 30.0})
+    fixed.start()
+    try:
+        with fixed.region("checkpoint"):
+            assert fixed.effective_deadline() == 0.5  # fixed means fixed
+    finally:
+        fixed.stop()
+
+
+def test_healthz_stalled_contract():
+    """The scrape surface: /healthz serves 503 + stalled:true while the
+    watchdog reports a quiet heartbeat, 200 + stalled:false otherwise;
+    the gan4j_watchdog_*/gan4j_rollback_total series exist."""
+    reg = MetricsRegistry()
+    state = {"stalled": False}
+    reg.observe_watchdog(
+        lambda: {"last_beat_age_s": 1.0, "deadline_s": 5.0,
+                 "timeouts_total": 2, "stalled": state["stalled"]})
+    stop = serve_exporter(reg, port=0)
+    try:
+        def get(path):
+            url = f"http://127.0.0.1:{stop.port}{path}"
+            try:
+                with urllib.request.urlopen(url, timeout=10) as r:
+                    return r.status, r.read().decode()
+            except urllib.error.HTTPError as e:
+                return e.code, e.read().decode()
+
+        status, body = get("/healthz")
+        doc = json.loads(body)
+        assert status == 200 and doc["stalled"] is False \
+            and doc["status"] == "ok"
+        state["stalled"] = True
+        status, body = get("/healthz")
+        doc = json.loads(body)
+        assert status == 503 and doc["stalled"] is True \
+            and doc["status"] == "stalled"
+        _, metrics = get("/metrics")
+        assert "gan4j_watchdog_last_beat_age_seconds 1.0" in metrics
+        assert "gan4j_watchdog_deadline_seconds 5.0" in metrics
+        assert "gan4j_watchdog_timeouts_total 2.0" in metrics
+        assert "gan4j_watchdog_stalled 1.0" in metrics
+        assert "gan4j_rollback_total 0.0" in metrics
+    finally:
+        stop()
+
+
+# -- divergence sentinel ------------------------------------------------------
+
+
+def test_divergence_trips_on_sustained_explosion():
+    s = DivergenceSentinel(window=32, factor=10.0, patience=3,
+                           min_history=8, floor=1e-3)
+    for i in range(12):
+        s.observe({"step": i, "g_loss": 1.0 + 0.01 * i})
+    assert not s.tripped
+    for j in range(3):
+        s.observe({"step": 100 + j, "g_loss": 50.0})
+    assert s.tripped and s.key == "g_loss" and s.step == 102
+    assert "divergence" in s.describe()
+    # latched: later records don't overwrite the first trip
+    s.observe({"step": 200, "d_loss": 1e9})
+    assert s.step == 102
+
+
+def test_divergence_single_spike_does_not_trip():
+    s = DivergenceSentinel(window=32, factor=10.0, patience=3,
+                           min_history=8)
+    for i in range(12):
+        s.observe({"step": i, "d_grad_norm": 2.0})
+    s.observe({"step": 50, "d_grad_norm": 500.0})  # one bad batch
+    for i in range(13, 25):
+        s.observe({"step": i, "d_grad_norm": 2.0})
+    s.observe({"step": 60, "d_grad_norm": 500.0})
+    assert not s.tripped  # streak reset between spikes
+
+
+def test_divergence_ignores_nonfinite_and_unwatched_keys():
+    s = DivergenceSentinel(window=16, factor=5.0, patience=1,
+                           min_history=4)
+    for i in range(6):
+        s.observe({"step": i, "g_loss": 1.0, "examples_per_sec": 1e12})
+    s.observe({"step": 9, "g_loss": float("nan")})   # NaN alarm's job
+    s.observe({"step": 10, "wall_s": 1e9})           # unwatched key
+    assert not s.tripped
+
+
+# -- rollback manager ---------------------------------------------------------
+
+
+def test_rollback_budget_progress_aware():
+    mgr = RollbackManager(max_rollbacks=2, lr_factor=0.5)
+    assert mgr.request(10, "nan", bad_step=10)      # attempt 1
+    assert mgr.restore_before == 10
+    assert mgr.request(10, "nan again")             # attempt 2
+    assert not mgr.request(10, "still")             # budget exhausted
+    # progress resets the window but not the lifetime count / LR scale
+    mgr2 = RollbackManager(max_rollbacks=1, lr_factor=0.5)
+    assert mgr2.request(10, "a")
+    assert mgr2.request(20, "b")   # later step: window reset
+    assert mgr2.request(30, "c")
+    assert mgr2.total == 3 and mgr2.lr_scale == 0.5 ** 3
+
+
+def test_rollback_manager_validation():
+    with pytest.raises(ValueError, match="lr_factor"):
+        RollbackManager(lr_factor=1.5)
+    with pytest.raises(ValueError, match="max_rollbacks"):
+        RollbackManager(max_rollbacks=0)
+
+
+def test_scale_graph_lr_scales_trainable_keeps_frozen():
+    from gan_deeplearning4j_tpu.models import mlpgan_insurance as M
+
+    gan = M.build_gan()  # carries a frozen (lr 0) discriminator tail
+    ups = gan.updater.layer_updaters
+    before = {k: float(getattr(u, "learning_rate", 0.0))
+              for k, u in ups.items()}
+    assert any(v > 0 for v in before.values())
+    n = scale_graph_lr(gan, 0.5)
+    assert n == sum(1 for v in before.values() if v > 0)
+    for k, u in gan.updater.layer_updaters.items():
+        assert float(u.learning_rate) == pytest.approx(before[k] * 0.5
+                                                       if before[k] else 0.0)
+
+
+def test_scale_graph_lr_handles_scheduled_updaters():
+    """A Scheduled wrapper's learning_rate is a read-only property of a
+    frozen dataclass — the scale must land on the schedule's initial_lr
+    (a pure multiplier in every schedule kind), not crash the heal
+    path with FrozenInstanceError."""
+    from gan_deeplearning4j_tpu.optim.rmsprop import RmsProp
+    from gan_deeplearning4j_tpu.optim.schedules import (
+        Scheduled,
+        StepSchedule,
+    )
+
+    class _G:
+        pass
+
+    class _U:
+        def __init__(self, ups):
+            self.layer_updaters = ups
+
+    sched = Scheduled(RmsProp(0.01), StepSchedule(0.1, 0.5, 1000))
+    g = _G()
+    g.updater = _U({"a": sched, "b": RmsProp(0.02)})
+    assert scale_graph_lr(g, 0.5) == 2
+    scaled = g.updater.layer_updaters["a"]
+    assert scaled.schedule.initial_lr == pytest.approx(0.05)
+    assert scaled.learning_rate == pytest.approx(0.05)  # t=0 summary
+    assert g.updater.layer_updaters["b"].learning_rate \
+        == pytest.approx(0.01)
+
+
+def test_request_rollback_keeps_earliest_bad_step(tmp_path):
+    """When the NaN alarm and the divergence sentinel both trip in one
+    detection window, the restore bound must be the EARLIEST bad step —
+    a later request must not widen it back into the poisoned window."""
+    from gan_deeplearning4j_tpu.train.gan_trainer import GANTrainer
+    from gan_deeplearning4j_tpu.train.insurance_main import (
+        InsuranceWorkload,
+        default_config,
+    )
+
+    t = GANTrainer(InsuranceWorkload(), default_config(
+        num_iterations=2, metrics=False, n_devices=1,
+        res_path=str(tmp_path)))
+    t._request_rollback("nan at 100", 100)
+    t._request_rollback("divergence at 103", 103)  # later: ignored
+    assert t._rollback_pending == ("nan at 100", 100)
+    t._request_rollback("nan at 90", 90)           # earlier: tightens
+    assert t._rollback_pending == ("nan at 90", 90)
+
+
+def test_perturb_key_changes_stream_deterministically():
+    import jax
+
+    base = jax.random.PRNGKey(7)
+    a = perturb_key(base, 1)
+    b = perturb_key(base, 2)
+    assert not np.array_equal(np.asarray(a), np.asarray(base))
+    assert not np.array_equal(np.asarray(a), np.asarray(b))
+    # same epoch => same key (fleet hosts must agree)
+    np.testing.assert_array_equal(np.asarray(a),
+                                  np.asarray(perturb_key(base, 1)))
+
+
+def test_manager_apply_perturbs_trainer(tmp_path):
+    from gan_deeplearning4j_tpu.train.gan_trainer import GANTrainer
+    from gan_deeplearning4j_tpu.train.insurance_main import (
+        InsuranceWorkload,
+        default_config,
+    )
+
+    def make(mgr, sub):
+        return GANTrainer(
+            InsuranceWorkload(),
+            default_config(num_iterations=2, metrics=False, n_devices=1,
+                           res_path=str(tmp_path / sub)),
+            rollback_manager=mgr)
+
+    plain = make(None, "plain")
+    mgr = RollbackManager(max_rollbacks=3, lr_factor=0.5)
+    mgr.request(4, "nan at 4", bad_step=4)
+    rolled = make(mgr, "rolled")
+    import jax
+
+    key_bits = lambda k: np.asarray(jax.random.key_data(k))  # noqa: E731
+    assert not np.array_equal(key_bits(plain._z_base),
+                              key_bits(rolled._z_base))
+    assert rolled._resume_max_step == 3
+    for layer, up in rolled.dis.updater.layer_updaters.items():
+        ref = plain.dis.updater.layer_updaters[layer]
+        assert float(up.learning_rate) == pytest.approx(
+            0.5 * float(ref.learning_rate))
+
+
+def test_trainer_rejects_rollback_without_manager(tmp_path):
+    from gan_deeplearning4j_tpu.train.gan_trainer import GANTrainer
+    from gan_deeplearning4j_tpu.train.insurance_main import (
+        InsuranceWorkload,
+        default_config,
+    )
+
+    with pytest.raises(ValueError, match="RollbackManager"):
+        GANTrainer(InsuranceWorkload(), default_config(
+            num_iterations=2, res_path=str(tmp_path), n_devices=1,
+            telemetry=True, nan_alarm="rollback"))
+
+
+# -- bounded restore + poisoned-suffix prune ---------------------------------
+
+
+def _graph():
+    from gan_deeplearning4j_tpu.models import mlpgan_insurance as M
+
+    return M.build_discriminator()
+
+
+def test_restore_max_step_and_prune_above(tmp_path):
+    d = str(tmp_path)
+    ck = TrainCheckpointer(d, keep=10)
+    g = _graph()
+    for s in (2, 4, 6):
+        ck.save(s, {"dis": g}, extra={"tag": s})
+    step, extra = ck.restore({"dis": _graph()}, max_step=5)
+    assert step == 4 and extra["tag"] == 4
+    assert ck.prune_above(4) == [6]
+    assert ck.steps() == [2, 4]
+    from gan_deeplearning4j_tpu.checkpoint import NoVerifiedCheckpointError
+
+    with pytest.raises(NoVerifiedCheckpointError):
+        ck.restore({"dis": _graph()}, max_step=1)
+
+
+# -- recovery classification --------------------------------------------------
+
+
+class _FakeTrainer:
+    def __init__(self, exc, step):
+        self._exc = exc
+        self.batch_counter = step
+
+    def train(self, log=print):
+        if self._exc is None:
+            return {"steps": self.batch_counter}
+        raise self._exc
+
+
+def test_rollback_requested_burns_no_restart_budget():
+    from gan_deeplearning4j_tpu.train.gan_trainer import train_with_recovery
+
+    seq = [(RollbackRequested("nan at 4", step=4, rollbacks=1), 4),
+           (RollbackRequested("nan at 4", step=4, rollbacks=2), 4),
+           (None, 8)]
+    it = iter(seq)
+    calls = []
+
+    def make(resume):
+        calls.append(resume)
+        return _FakeTrainer(*next(it))
+
+    # max_restarts=0: ANY budget charge would raise — two rollbacks
+    # must still be absorbed, and every rebuild resumes
+    res = train_with_recovery(make, max_restarts=0, log=lambda s: None,
+                              backoff_base_s=0)
+    assert res == {"steps": 8}
+    assert calls == [False, True, True]
+
+
+def test_rollback_and_divergence_errors_are_fatal():
+    from gan_deeplearning4j_tpu.train.gan_trainer import train_with_recovery
+
+    for exc in (RollbackError("budget exhausted"),
+                DivergenceError("g_loss exploded")):
+        calls = []
+
+        def make(resume, exc=exc):
+            calls.append(resume)
+            return _FakeTrainer(exc, 0)
+
+        with pytest.raises(type(exc)):
+            train_with_recovery(make, max_restarts=5,
+                                log=lambda s: None, backoff_base_s=0)
+        assert calls == [False]
+
+
+def test_watchdog_timeout_is_retryable():
+    from gan_deeplearning4j_tpu.train.gan_trainer import train_with_recovery
+
+    seq = [(WatchdogTimeout(), 3), (None, 8)]
+    it = iter(seq)
+    res = train_with_recovery(lambda resume: _FakeTrainer(*next(it)),
+                              max_restarts=1, log=lambda s: None,
+                              backoff_base_s=0)
+    assert res == {"steps": 8}
+
+
+def test_hang_at_readback_injector_caught_by_watchdog():
+    """The OTHER silent hang class: a device readback that never
+    completes (chaos hang_at_readback hooks utils/device.device_fence).
+    The watchdog unwinds the thread stuck inside the fence."""
+    import jax.numpy as jnp
+
+    from gan_deeplearning4j_tpu.testing import ChaosInjector
+    from gan_deeplearning4j_tpu.utils.device import device_fence
+
+    caught = {}
+    inj = ChaosInjector(SEED)
+    with inj.hang_at_readback(at=0) as hang:
+        wd = HeartbeatWatchdog(deadline_s=0.5, poll_s=0.05)
+
+        def victim():
+            try:
+                device_fence(jnp.ones((4,)))
+            except WatchdogTimeout:
+                caught["timeout"] = True
+
+        t = threading.Thread(target=victim)
+        t.start()
+        wd.start(thread=t)
+        assert hang.hung.wait(timeout=10)  # the fence is really stuck
+        t.join(timeout=20)
+        wd.stop()
+    assert caught.get("timeout") and hang.fired
+    # one-shot: the next fence proceeds (a restarted run can finish)
+    with inj.hang_at_readback(at=5):
+        device_fence(jnp.ones((2,)))
+
+
+# -- overlay vocabulary -------------------------------------------------------
+
+
+def test_marker_vocabulary_covers_supervision_events():
+    from gan_deeplearning4j_tpu.telemetry.events import marker_records
+
+    evs = [{"name": "watchdog.timeout", "step": 5},
+           {"name": "rollback.restore", "step": 2},
+           {"name": "alarm.divergence", "step": 4},
+           {"name": "watchdog.timeout"},           # no step: not placeable
+           {"name": "unrelated", "step": 1}]
+    markers = marker_records(evs)
+    labels = {m["label"] for m in markers}
+    assert labels == {"watchdog timeout", "rollback", "divergence"}
+    assert all(m["color"].startswith("#") for m in markers)
+
+
+# -- end to end (the acceptance bar) -----------------------------------------
+
+
+def _supervised_cfg(res, **kw):
+    from gan_deeplearning4j_tpu.train.insurance_main import default_config
+
+    base = dict(num_iterations=6, batch_size=20, res_path=res,
+                print_every=10 ** 9, save_every=10 ** 9, metrics=False,
+                n_devices=1, checkpoint_every=2, steps_per_call=1,
+                data_on_device=False)  # streaming: the source is LIVE
+    base.update(kw)
+    return default_config(**base)
+
+
+class _WrapFirstTrainIter:
+    """Monkeypatch target for gan_trainer.RecordReaderDataSetIterator:
+    wrap the FIRST constructed iterator (incarnation 1's iter_train)
+    with the given chaos source; every later construction — the test
+    iterator, the restarted incarnation's iterators — is passthrough."""
+
+    def __init__(self, orig, wrap):
+        self.orig = orig
+        self.wrap = wrap
+        self.calls = 0
+        self.wrapped = None
+
+    def __call__(self, *a, **kw):
+        it = self.orig(*a, **kw)
+        self.calls += 1
+        if self.calls == 1:
+            self.wrapped = self.wrap(it)
+            return self.wrapped
+        return it
+
+
+def test_e2e_hang_watchdog_restart_finishes(tmp_path, monkeypatch):
+    """ACCEPTANCE: a run whose data source hangs FOREVER finishes to
+    the target step count via watchdog-restart under
+    train_with_recovery; the timeline carries watchdog.timeout and
+    /healthz flips stalled -> healthy.  The CI hang lane's external
+    ``timeout`` is the backstop — this test passing under it proves the
+    INTERNAL watchdog fired first."""
+    import gan_deeplearning4j_tpu.train.gan_trainer as gt
+
+    res = str(tmp_path)
+    wrapper = _WrapFirstTrainIter(
+        gt.RecordReaderDataSetIterator,
+        lambda it: HangingSource(it, hang_at=4))
+    monkeypatch.setattr(gt, "RecordReaderDataSetIterator", wrapper)
+
+    from gan_deeplearning4j_tpu.train.insurance_main import (
+        InsuranceWorkload,
+    )
+
+    trainers = []
+
+    def make_trainer(resume):
+        cfg = _supervised_cfg(
+            res, resume=resume, watchdog=True, metrics_port=0,
+            watchdog_warmup_s=120.0, watchdog_scale=20.0,
+            watchdog_min_deadline_s=1.5)
+        t = gt.GANTrainer(InsuranceWorkload(), cfg)
+        trainers.append(t)
+        return t
+
+    health = {"stalled_503": None, "healthy_200": None}
+
+    def probe():
+        # /healthz must flip to 503+stalled while the hang is live...
+        src = None
+        deadline = time.perf_counter() + 240
+        while time.perf_counter() < deadline:
+            src = getattr(wrapper.wrapped, "hung", None)
+            if src is not None and src.wait(timeout=0.2):
+                break
+        while time.perf_counter() < deadline and trainers:
+            port = trainers[0].metrics_port
+            if port:
+                try:
+                    urllib.request.urlopen(
+                        f"http://127.0.0.1:{port}/healthz", timeout=2)
+                except urllib.error.HTTPError as e:
+                    if e.code == 503:
+                        health["stalled_503"] = json.loads(
+                            e.read().decode())
+                        break
+                except OSError:
+                    pass  # incarnation 1 tore down: window missed
+            time.sleep(0.1)
+        # ...and back to 200+healthy on the restarted incarnation
+        while time.perf_counter() < deadline:
+            if len(trainers) > 1 and trainers[1].metrics_port:
+                try:
+                    with urllib.request.urlopen(
+                            f"http://127.0.0.1:"
+                            f"{trainers[1].metrics_port}/healthz",
+                            timeout=2) as r:
+                        health["healthy_200"] = json.loads(
+                            r.read().decode())
+                        break
+                except OSError:
+                    pass
+            time.sleep(0.1)
+
+    prober = threading.Thread(target=probe, daemon=True)
+    prober.start()
+    res_dict = gt.train_with_recovery(
+        make_trainer, max_restarts=2, log=lambda s: None,
+        backoff_base_s=0)
+    prober.join(timeout=30)
+
+    assert res_dict["steps"] == 6           # dead-hung run FINISHED
+    assert len(trainers) == 2               # exactly one restart
+    names = [e.get("name") for e in read_events(
+        os.path.join(res, "events.jsonl"))]
+    assert "watchdog.timeout" in names      # the internal watchdog fired
+    assert "recovery.restart" in names
+    assert os.path.exists(
+        os.path.join(res, "flight_record_watchdog_timeout.json"))
+    assert health["stalled_503"] is not None \
+        and health["stalled_503"]["stalled"] is True
+    assert health["healthy_200"] is not None \
+        and health["healthy_200"]["stalled"] is False
+
+
+def test_e2e_nan_rollback_with_perturbation_finishes(tmp_path,
+                                                     monkeypatch):
+    """ACCEPTANCE: a run whose source injects NaNs (NanSource) finishes
+    to the target step count via rollback-with-perturbation — restore
+    before the bad step, LR cut, noise stream advanced — with the
+    rollback.request/rollback.restore markers on the timeline and the
+    poisoned checkpoint suffix pruned."""
+    import gan_deeplearning4j_tpu.train.gan_trainer as gt
+
+    res = str(tmp_path)
+    wrapper = _WrapFirstTrainIter(
+        gt.RecordReaderDataSetIterator,
+        lambda it: NanSource(it, nan_at=2))  # 3rd batch -> step 3 NaN
+    monkeypatch.setattr(gt, "RecordReaderDataSetIterator", wrapper)
+
+    from gan_deeplearning4j_tpu.train.insurance_main import (
+        InsuranceWorkload,
+    )
+
+    mgr = RollbackManager(max_rollbacks=3, lr_factor=0.5)
+
+    def make_trainer(resume):
+        cfg = _supervised_cfg(res, resume=resume, num_iterations=8,
+                              telemetry=True, nan_alarm="rollback")
+        t = gt.GANTrainer(InsuranceWorkload(), cfg,
+                          rollback_manager=mgr)
+        # detection granularity is the metrics flush cadence — flush
+        # per record so the alarm trips within a boundary or two
+        t.metrics.flush_every = 1
+        return t
+
+    res_dict = gt.train_with_recovery(
+        make_trainer, max_restarts=0, log=lambda s: None,
+        backoff_base_s=0)
+
+    assert res_dict["steps"] == 8           # NaN-poisoned run FINISHED
+    assert mgr.total == 1                   # healed in one rollback
+    events = read_events(os.path.join(res, "events.jsonl"))
+    names = [e.get("name") for e in events]
+    assert "alarm.nan" in names
+    assert "rollback.request" in names
+    assert "rollback.restore" in names
+    restore = next(e for e in events if e["name"] == "rollback.restore")
+    bad = next(e for e in events if e["name"] == "rollback.request")
+    assert restore["step"] < bad["bad_step"]  # restored BEFORE the NaN
+    assert os.path.exists(
+        os.path.join(res, "flight_record_rollback.json"))
+    # the poisoned checkpoint suffix was pruned at restore time: no
+    # committed checkpoint between the restore point and the bad step
+    # survived into the healed run's history
+    ck = TrainCheckpointer(os.path.join(res, "checkpoints"))
+    assert ck.latest_verified_step() is not None
