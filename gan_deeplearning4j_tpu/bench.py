@@ -468,7 +468,13 @@ def dryrun(telemetry: bool = True,
     the bar here is 50µs/beat, ~3 orders below a step), and one REAL
     /healthz scrape during the live (beating) run must report
     ``"stalled": false`` with a 200 — the stalled contract's healthy
-    half, the 503 half being pinned by tests/test_supervision.py."""
+    half, the 503 half being pinned by tests/test_supervision.py.
+
+    The resilient data plane rides it too (``data_ok``): the
+    ``gan4j_data_*`` series must exist from the first scrape and the
+    /healthz ``"data"`` block must report a budget-intact ``ok`` —
+    the healthy half of the quarantine contract
+    (tests/test_resilient.py pins the failure half)."""
     global BATCH
     prev_batch, BATCH = BATCH, 8
     try:
@@ -492,6 +498,11 @@ def dryrun(telemetry: bool = True,
             registry = MetricsRegistry()
             goodput = GoodputTimer()
             registry.observe_goodput(goodput.report)
+            # data-plane feed (data/resilient.py), as a trainer wires it
+            from gan_deeplearning4j_tpu.data.resilient import DataHealth
+
+            data_health = DataHealth()
+            registry.observe_data(data_health.report)
             stop = serve_exporter(registry,
                                   0 if metrics_port is None
                                   else metrics_port)
@@ -564,6 +575,16 @@ def dryrun(telemetry: bool = True,
                 watchdog_ok = (h_status == 200
                                and health.get("stalled") is False
                                and beat_us < 50.0)
+                # resilient-data-plane surface: the gan4j_data_* series
+                # exist from the first scrape and /healthz carries the
+                # "data" block with a healthy (budget-intact) verdict
+                data_block = health.get("data")
+                data_ok = (
+                    "gan4j_data_retries_total " in m_body
+                    and "gan4j_data_quarantined_total " in m_body
+                    and "gan4j_data_last_error_age_seconds " in m_body
+                    and isinstance(data_block, dict)
+                    and data_block.get("ok") is True)
                 recorder.flush()
                 try:
                     events_ok = len(events_mod.read_events(
@@ -578,13 +599,14 @@ def dryrun(telemetry: bool = True,
         return {"metric": "dcgan_mnist_img_per_sec", "dryrun": True,
                 "ok": bool(ok and math.isfinite(t) and ckpt_ok
                            and exporter_ok and events_ok
-                           and watchdog_ok),
+                           and watchdog_ok and data_ok),
                 "platform": device.platform,
                 "telemetry": telemetry,
                 "checkpoint": ckpt,
                 "exporter_ok": bool(exporter_ok),
                 "events_ok": bool(events_ok),
                 "watchdog_ok": bool(watchdog_ok),
+                "data_ok": bool(data_ok),
                 "watchdog_beat_us": round(beat_us, 3)}
     finally:
         BATCH = prev_batch

@@ -8,10 +8,20 @@ native C++ fast-decode path.
 
 from gan_deeplearning4j_tpu.data.csv import (
     CSVRecordReader,
+    CSVRowError,
     DataSet,
     RecordReaderDataSetIterator,
     read_csv_matrix,
     write_csv_matrix,
+)
+from gan_deeplearning4j_tpu.data.resilient import (
+    DataHealth,
+    DataQuarantineError,
+    DataSourceError,
+    RecordQuarantine,
+    RetryingReader,
+    RetryingSource,
+    ValidatingSource,
 )
 from gan_deeplearning4j_tpu.data.normalizers import (  # noqa: F401
     NormalizerMinMaxScaler,
@@ -31,6 +41,14 @@ __all__ = [
     "NormalizerMinMaxScaler",
     "NormalizerStandardize",
     "CSVRecordReader",
+    "CSVRowError",
+    "DataHealth",
+    "DataQuarantineError",
+    "DataSourceError",
+    "RecordQuarantine",
+    "RetryingReader",
+    "RetryingSource",
+    "ValidatingSource",
     "DataSet",
     "RecordReaderDataSetIterator",
     "read_csv_matrix",

@@ -43,31 +43,128 @@ class DataSet:
         return self.features.shape[0]
 
 
+class CSVRowError(ValueError):
+    """A malformed CSV record, with file:line provenance.  A ValueError
+    subclass so ``train_with_recovery`` keeps classifying it FATAL (a
+    restart re-reads the identical bad row) — but the message names
+    the exact record instead of numpy's bare parse error."""
+
+    def __init__(self, path: str, line: int, reason: str, raw: str = ""):
+        self.path = path
+        self.line = line
+        self.reason = reason
+        self.raw = raw
+        super().__init__(
+            f"{path}:{line}: {reason}"
+            + (f" (row: {raw[:120]!r})" if raw else ""))
+
+
 class CSVRecordReader:
     """DataVec ``CSVRecordReader(numLinesToSkip, delimiter)`` equivalent.
 
     Decodes the entire file eagerly with numpy's C parser.  A native C++
     fast path (data/native) is used automatically for large files when the
     extension is built.
+
+    With a ``quarantine`` (data/resilient.py ``RecordQuarantine``) the
+    decode is ROW-TOLERANT: malformed records — wrong column count,
+    unparseable fields, non-finite values — are skipped, charged
+    against the quarantine budget with file:line provenance, and the
+    surviving rows become the table.  Without one, a malformed record
+    raises ``CSVRowError`` naming the exact file:line (the strict path
+    re-parses on numpy failure purely to recover the provenance).
     """
 
     def __init__(self, skip_lines: int = 0, delimiter: str = ","):
         self.skip_lines = skip_lines
         self.delimiter = delimiter
 
-    def read(self, path: str, dtype=np.float32) -> np.ndarray:
+    def read(self, path: str, dtype=np.float32,
+             quarantine=None) -> np.ndarray:
         from gan_deeplearning4j_tpu.data import native as _native
 
+        if quarantine is not None:
+            # row-tolerant path: the native parser (data/native.py) is
+            # all-or-nothing with no row provenance, so tolerant decode
+            # always takes the python row parser
+            return self._read_rows(path, dtype, quarantine.charge)
         arr = _native.read_csv(path, self.skip_lines, self.delimiter, dtype)
         if arr is not None:
             return arr
-        return np.loadtxt(
-            path,
-            delimiter=self.delimiter,
-            skiprows=self.skip_lines,
-            dtype=dtype,
-            ndmin=2,
-        )
+        try:
+            # comments=None: the contract is pure numeric CSV — without
+            # it numpy silently DROPS any '#'-prefixed line, so a row
+            # corrupted into '#…' garbage would shrink the table without
+            # any error (and the strict/tolerant decodes would disagree
+            # on the same file)
+            return np.loadtxt(
+                path,
+                delimiter=self.delimiter,
+                skiprows=self.skip_lines,
+                dtype=dtype,
+                ndmin=2,
+                comments=None,
+            )
+        except ValueError:
+            # strict mode still owes the caller provenance: re-parse
+            # row-by-row and raise CSVRowError at the first bad record
+            # (file:line) instead of numpy's positionless message
+            def raise_row(file, line=None, row=None, reason="", raw=""):
+                raise CSVRowError(file, line, reason, raw)
+
+            return self._read_rows(path, dtype, raise_row)
+
+    def _read_rows(self, path: str, dtype, on_bad_row) -> np.ndarray:
+        """Two-phase decode with per-record validation: float parse and
+        finiteness per line, then column count against the MAJORITY
+        width of the parseable rows — so one torn-but-parseable record
+        (wherever it sits, including line 1) gets rejected instead of
+        poisoning the expected width and condemning every healthy row
+        after it.  Bad records go to ``on_bad_row(file=, line=,
+        reason=, raw=)`` in line order — the quarantine's ``charge``
+        (skip-and-log, budget permitting) or a raiser (strict
+        provenance path)."""
+        from collections import Counter
+
+        parsed = []   # (lineno, vals, raw) — parseable AND finite
+        bad = []      # (lineno, reason, raw)
+        with open(path, "r") as f:
+            for lineno, line in enumerate(f, start=1):
+                if lineno <= self.skip_lines:
+                    continue
+                s = line.strip()
+                if not s:
+                    continue  # blank line: numpy skips these too
+                parts = s.split(self.delimiter)
+                try:
+                    vals = np.asarray(parts, dtype=np.float64)
+                except ValueError:
+                    bad.append((lineno, "unparseable field", s))
+                    continue
+                if not np.all(np.isfinite(vals)):
+                    bad.append((lineno, "non-finite value", s))
+                    continue
+                parsed.append((lineno, vals, s))
+        ncols = None
+        if parsed:
+            widths = Counter(v.shape[0] for _, v, _ in parsed)
+            # majority wins; a tie breaks to the width seen first (the
+            # file's leading contract) — deterministic either way
+            top = widths.most_common()
+            best = max(c for _, c in top)
+            ncols = next(v.shape[0] for _, v, _ in parsed
+                         if widths[v.shape[0]] == best)
+            bad.extend(
+                (ln, f"expected {ncols} columns, got {v.shape[0]}", s)
+                for ln, v, s in parsed if v.shape[0] != ncols)
+        for lineno, reason, raw in sorted(bad):
+            on_bad_row(path, line=lineno, reason=reason, raw=raw)
+        rows = [v.astype(dtype) for _, v, _ in parsed
+                if v.shape[0] == ncols]
+        if not rows:
+            raise ValueError(
+                f"{path}: no valid rows survived the tolerant decode")
+        return np.stack(rows)
 
 
 class RecordReaderDataSetIterator:
@@ -87,14 +184,32 @@ class RecordReaderDataSetIterator:
         reader: Optional[CSVRecordReader] = None,
         dtype=np.float32,
         strict: bool = False,
+        shuffle: bool = False,
+        shuffle_seed: int = 0,
+        quarantine=None,
     ):
+        src_name = "<array>"
         if isinstance(source, (str, os.PathLike)):
+            src_name = str(source)
             reader = reader or CSVRecordReader()
-            table = reader.read(str(source), dtype=dtype)
+            if quarantine is not None:
+                table = reader.read(str(source), dtype=dtype,
+                                    quarantine=quarantine)
+            else:
+                table = reader.read(str(source), dtype=dtype)
         else:
             table = np.asarray(source, dtype=dtype)
             if table.ndim != 2:
                 raise ValueError(f"expected 2-D table, got shape {table.shape}")
+            if quarantine is not None:
+                # array sources skip the reader's row validation: apply
+                # the finite-value half of the ingest contract here
+                bad = ~np.isfinite(table).all(axis=1)
+                if bad.any():
+                    for i in np.nonzero(bad)[0]:
+                        quarantine.charge(src_name, row=int(i),
+                                          reason="non-finite value")
+                    table = np.ascontiguousarray(table[~bad])
         if strict and table.shape[0] % batch_size != 0:
             raise ValueError(
                 f"{table.shape[0]} rows is not a multiple of batch_size={batch_size}"
@@ -102,6 +217,21 @@ class RecordReaderDataSetIterator:
         self.batch_size = batch_size
         self.label_index = label_index
         self.num_classes = num_classes
+        if label_index is not None and num_classes >= 2 \
+                and quarantine is not None and table.shape[0]:
+            # label validation belongs to ingest too: a row whose label
+            # is outside [0, num_classes) is a corrupt RECORD, not a
+            # reason to kill the run while the budget holds
+            raw = table[:, label_index]
+            idx = raw.astype(np.int64)
+            bad = (idx < 0) | (idx >= num_classes)
+            if bad.any():
+                for i in np.nonzero(bad)[0]:
+                    quarantine.charge(
+                        src_name, row=int(i),
+                        reason=f"label {raw[i]!r} outside "
+                               f"[0, {num_classes})")
+                table = np.ascontiguousarray(table[~bad])
         if label_index is None:
             self._features = table
             self._labels = None
@@ -113,7 +243,8 @@ class RecordReaderDataSetIterator:
             if num_classes >= 2:
                 # one-hot (CV path: numClasses=10 -> softmax labels)
                 idx = raw.astype(np.int64)
-                if idx.min() < 0 or idx.max() >= num_classes:
+                if table.shape[0] and (
+                        idx.min() < 0 or idx.max() >= num_classes):
                     raise ValueError(
                         f"label column has values outside [0, {num_classes})"
                     )
@@ -124,6 +255,10 @@ class RecordReaderDataSetIterator:
                 # numClasses=1: raw sigmoid target column (insurance path)
                 self._labels = raw.reshape(-1, 1).astype(dtype)
         self._cursor = 0
+        self._epoch = 0
+        self._shuffle = bool(shuffle)
+        self._shuffle_seed = int(shuffle_seed)
+        self._order = self._epoch_order(0) if self._shuffle else None
         self._preprocessor = None
 
     @property
@@ -146,12 +281,18 @@ class RecordReaderDataSetIterator:
         lo = self._cursor
         hi = min(lo + self.batch_size, self._features.shape[0])
         self._cursor = hi
-        feats = self._features[lo:hi]
-        labels = (
-            self._labels[lo:hi]
-            if self._labels is not None
-            else np.zeros((hi - lo, 0), dtype=feats.dtype)
-        )
+        if self._order is not None:
+            idx = self._order[lo:hi]
+            feats = self._features[idx]
+            labels = (self._labels[idx] if self._labels is not None
+                      else np.zeros((hi - lo, 0), dtype=feats.dtype))
+        else:
+            feats = self._features[lo:hi]
+            labels = (
+                self._labels[lo:hi]
+                if self._labels is not None
+                else np.zeros((hi - lo, 0), dtype=feats.dtype)
+            )
         ds = DataSet(feats, labels)
         if self._preprocessor is not None:
             # contract: preprocess REPLACES ds.features (the normalizers
@@ -171,7 +312,78 @@ class RecordReaderDataSetIterator:
         self._preprocessor = preprocessor
 
     def reset(self) -> None:
+        """Rewind for the next pass.  The epoch counter advances so a
+        SHUFFLED iterator re-permutes per pass (and ``state()`` can
+        name the pass); the ordered iterator's batch content is
+        untouched — every pass replays the file order, as before."""
         self._cursor = 0
+        self._epoch += 1
+        if self._shuffle:
+            self._order = self._epoch_order(self._epoch)
+
+    # -- O(1) resumable state (the resilient-data-plane contract) ------------
+
+    def _epoch_order(self, epoch: int) -> np.ndarray:
+        """Row permutation for ``epoch`` — a PURE function of
+        (shuffle_seed, epoch), so any epoch's order is recomputable
+        from two integers.  That property is what makes the iterator
+        state O(1): no RNG object to serialize, no replay needed."""
+        rng = np.random.RandomState(
+            (self._shuffle_seed * 1000003 + epoch) % (2 ** 31 - 1))
+        return rng.permutation(self._features.shape[0])
+
+    def state(self) -> dict:
+        """Resumable position in O(1): (epoch, cursor) plus the shuffle
+        contract.  An exhausted position normalizes to the NEXT epoch's
+        start — the wrap the consumer loops would perform anyway — so a
+        restored iterator always answers ``has_next()`` truthfully
+        instead of stranding a fresh prefetch worker on a spent pass."""
+        n = self._features.shape[0]
+        epoch, cursor = self._epoch, self._cursor
+        if n and cursor >= n:
+            epoch, cursor = epoch + 1, 0
+        return {"v": 1, "epoch": int(epoch), "cursor": int(cursor),
+                "shuffle": self._shuffle,
+                "shuffle_seed": self._shuffle_seed}
+
+    def restore_state(self, state: dict) -> None:
+        """Resume at a ``state()``/``state_for_step()`` position in
+        O(1) — the checkpoint-resume replacement for replaying every
+        consumed batch.  The shuffle contract must match: silently
+        resuming an ordered run from a shuffled checkpoint (or with a
+        different seed) would desynchronize the batch sequence."""
+        if state.get("v") != 1:
+            raise ValueError(f"unknown iterator state version: {state!r}")
+        if bool(state.get("shuffle", False)) != self._shuffle or (
+                self._shuffle
+                and int(state.get("shuffle_seed", 0)) != self._shuffle_seed):
+            raise ValueError(
+                "iterator state shuffle contract mismatch: checkpoint "
+                f"carries shuffle={state.get('shuffle')}/"
+                f"seed={state.get('shuffle_seed')}, iterator is "
+                f"shuffle={self._shuffle}/seed={self._shuffle_seed}")
+        self._epoch = int(state["epoch"])
+        self._cursor = int(state["cursor"])
+        if self._shuffle:
+            self._order = self._epoch_order(self._epoch)
+
+    def state_for_step(self, step: int) -> dict:
+        """The ``state()`` after ``step`` consumed FULL batches under
+        the training loops' canonical pattern (partial tails consumed-
+        and-skipped, exhaustion wraps) — pure O(1) arithmetic, no
+        iteration.  Used by the trainer to stamp checkpoints on paths
+        that never touch the host iterator (the device-resident loop
+        slices batches on device)."""
+        n = self._features.shape[0]
+        full = n // self.batch_size
+        if full <= 0:
+            raise ValueError(
+                f"no full batch of {self.batch_size} in {n} rows — the "
+                "consumption pattern never advances")
+        return {"v": 1, "epoch": int(step // full),
+                "cursor": int((step % full) * self.batch_size),
+                "shuffle": self._shuffle,
+                "shuffle_seed": self._shuffle_seed}
 
     def __iter__(self) -> Iterator[DataSet]:
         self.reset()

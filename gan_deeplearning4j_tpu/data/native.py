@@ -64,7 +64,15 @@ def available() -> bool:
 
 def read_csv(path: str, skip_lines: int, delimiter: str, dtype) -> Optional[np.ndarray]:
     """Decode a numeric CSV via the C++ parser; None if unavailable (caller
-    falls back to numpy)."""
+    falls back to numpy).
+
+    Resilience seam (data/resilient.py): this parser is ALL-OR-NOTHING
+    and carries no per-row provenance, so the row-tolerant quarantine
+    decode (``CSVRecordReader.read(..., quarantine=...)``) deliberately
+    bypasses it — corrupt-record handling needs file:line attribution
+    the C side doesn't produce.  Transient I/O faults (the open/read
+    below) surface as OSError and are retried by ``RetryingReader``
+    like any other reader's."""
     if dtype != np.float32 or len(delimiter) != 1:
         return None
     lib = _load()

@@ -50,15 +50,35 @@ class PrefetchIterator:
         self.sharding = sharding
         self.loop = loop
         self.min_rows = min_rows
+        self.prefetch_depth = prefetch_depth
         # first worker exception, kept OUT of band as well as enqueued:
         # close() may drain the queue while the worker is still putting,
         # and a decode error must survive that drain (retrievable via
         # ``error`` / raised by a post-close __next__), never be dropped
         self.error: Optional[BaseException] = None
+        # O(1) resumable-state tracking (data/resilient.py contract):
+        # the worker runs AHEAD of the consumer, so the source's live
+        # cursor describes staged batches, not consumed ones — each
+        # enqueued item therefore CARRIES the source state as of right
+        # after it was pulled, and __next__ publishes it on delivery.
+        # ``state()`` then answers "where is everything the consumer
+        # has actually consumed" without touching the racing source.
+        self._consumed_state = self._source_state()
         self._q: queue.Queue = queue.Queue(maxsize=prefetch_depth)
         self._stop = threading.Event()
         self._thread = threading.Thread(target=self._worker, daemon=True)
         self._thread.start()
+
+    def _source_state(self):
+        """The wrapped source's ``state()`` if it has one (None
+        otherwise — state capture is strictly optional)."""
+        fn = getattr(self.source, "state", None)
+        if fn is None:
+            return None
+        try:
+            return fn()
+        except Exception:
+            return None  # a broken state feed must not break the stream
 
     def _convert(self, ds):
         if self.sharding is not None:
@@ -82,8 +102,9 @@ class PrefetchIterator:
                 ds = self.source.next()
                 if self.min_rows and ds.num_examples() < self.min_rows:
                     continue  # partial tail: skip (wraps via has_next above)
+                st = self._source_state()
                 item = self._convert(ds)
-                if not self._put_stop_aware(item):
+                if not self._put_stop_aware((item, st)):
                     return
                 emitted_this_pass += 1
             self._put_stop_aware(None)  # sentinel: exhausted
@@ -142,7 +163,57 @@ class PrefetchIterator:
             if item is self.error:
                 self.error = None  # delivered; don't re-raise at close
             raise item
-        return item
+        payload, st = item  # data entries carry (batch, source state)
+        if st is not None:
+            self._consumed_state = st
+        return payload
+
+    # -- O(1) resumable state -------------------------------------------------
+
+    def state(self):
+        """Source state as of the batches already DELIVERED to the
+        consumer (None when the source doesn't expose ``state()``):
+        restoring a fresh source to this state and re-wrapping yields
+        exactly the batches the consumer has not seen yet — the value a
+        checkpoint records.  O(1): a dict handoff per delivered batch,
+        no source access here."""
+        return self._consumed_state
+
+    def restore_state(self, state) -> None:
+        """Reposition the WHOLE pipeline at ``state``: quiesce the
+        worker, discard everything staged (those batches predate the
+        restore point), restore the underlying source, and restart a
+        fresh worker from there.  Only legal on sources that implement
+        ``restore_state``; the dedup chunk tier refuses (its shipped
+        distinct-row table is assembled from the first pass, which a
+        mid-pass restore would tear)."""
+        if getattr(self, "dedup", False):
+            raise RuntimeError(
+                "restore_state is not supported in dedup chunk mode — "
+                "restore the raw source before wrapping instead")
+        restore = getattr(self.source, "restore_state", None)
+        if restore is None:
+            raise AttributeError(
+                f"{type(self.source).__name__} does not expose "
+                "restore_state")
+        self._stop.set()
+        try:
+            while True:  # unblock a worker parked mid-put; drop staged
+                self._q.get_nowait()
+        except queue.Empty:
+            pass
+        self._thread.join(timeout=10.0)
+        if self._thread.is_alive():
+            raise RuntimeError(
+                "prefetch worker did not quiesce for restore_state "
+                "(source wedged in next()?)")
+        self.error = None  # pre-restore failures died with the worker
+        restore(state)
+        self._consumed_state = self._source_state()
+        self._q = queue.Queue(maxsize=self.prefetch_depth)
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._worker, daemon=True)
+        self._thread.start()
 
     def close(self, timeout: float = 5.0):
         """Stop the worker and release both sides.  Safe to call while
@@ -259,6 +330,7 @@ class ChunkPrefetchIterator(PrefetchIterator):
                 appended_this_pass += 1
                 if len(feats) < self.chunk_batches:
                     continue
+                st = self._source_state()  # position after the chunk
                 f_chunk = np.concatenate(feats)
                 if self.encode_features is not None:
                     f_chunk = self.encode_features(f_chunk)
@@ -267,7 +339,7 @@ class ChunkPrefetchIterator(PrefetchIterator):
                 if self.sharding is not None:
                     chunk = (jax.device_put(chunk[0], self.sharding),
                              jax.device_put(chunk[1], self.sharding))
-                if not self._put_stop_aware(chunk):
+                if not self._put_stop_aware((chunk, st)):
                     return
             self._put_stop_aware(None)
         except BaseException as e:  # surface decode errors to the consumer
@@ -339,11 +411,12 @@ class ChunkPrefetchIterator(PrefetchIterator):
                         tf = jax.device_put(tf, self.sharding)
                         tl = jax.device_put(tl, self.sharding)
                     table = (tf, tl)
+                st = self._source_state()  # position after the chunk
                 chunk_idx = np.concatenate(idx_parts)
                 idx_parts, appended = [], 0
                 if self.sharding is not None:
                     chunk_idx = jax.device_put(chunk_idx, self.sharding)
-                if not self._put_stop_aware((*table, chunk_idx)):
+                if not self._put_stop_aware(((*table, chunk_idx), st)):
                     return
             self._put_stop_aware(None)
         except BaseException as e:  # surface errors to the consumer

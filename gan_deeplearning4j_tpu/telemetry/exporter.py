@@ -27,6 +27,10 @@ Metric names (all ``gan4j_``-prefixed):
   gan4j_examples_per_sec       gauge    last per-step throughput sample
   gan4j_goodput_seconds{phase} gauge    GoodputTimer phase totals
   gan4j_goodput_compute_fraction  gauge the headline goodput number
+  gan4j_data_retries_total     counter  transient-I/O retries (resilient
+                                        data plane, data/resilient.py)
+  gan4j_data_quarantined_total counter  corrupt records quarantined
+  gan4j_data_last_error_age_seconds  gauge  age of the last data incident
 """
 
 from __future__ import annotations
@@ -67,8 +71,15 @@ class MetricsRegistry:
             ("gan4j_nonfinite_total", ()): 0.0,
             ("gan4j_watchdog_timeouts_total", ()): 0.0,
             ("gan4j_rollback_total", ()): 0.0,
+            ("gan4j_data_retries_total", ()): 0.0,
+            ("gan4j_data_quarantined_total", ()): 0.0,
         }
-        self._gauges: Dict[Tuple[str, tuple], float] = {}
+        self._gauges: Dict[Tuple[str, tuple], float] = {
+            # age since the last data-plane incident; 0 until one
+            # happens (pre-created so alert rules see the series from
+            # the first scrape, like the counters above)
+            ("gan4j_data_last_error_age_seconds", ()): 0.0,
+        }
         self._callbacks: List[Callable[["MetricsRegistry"], None]] = []
         self.run_id: Optional[str] = None
         self._last_record_wall: Optional[float] = None
@@ -77,6 +88,9 @@ class MetricsRegistry:
         # contract (503 once the heartbeat goes quiet past the deadline)
         # and the gan4j_watchdog_* series
         self._watchdog_fn: Optional[Callable[[], Optional[Dict]]] = None
+        # data-plane feed (data/resilient.py DataHealth.report): drives
+        # the gan4j_data_* series and the /healthz "data" block
+        self._data_fn: Optional[Callable[[], Optional[Dict]]] = None
 
     @staticmethod
     def _key(name: str, labels: Optional[Dict]) -> Tuple[str, tuple]:
@@ -177,6 +191,30 @@ class MetricsRegistry:
 
         self.add_callback(cb)
 
+    def observe_data(self, report_fn: Callable[[], Optional[Dict]]) -> None:
+        """Register the data-plane feed: ``report_fn`` returns a
+        ``DataHealth.report()`` dict (data/resilient.py — retry and
+        quarantine totals, last-incident age, budget verdict).  Scrapes
+        mirror it into the ``gan4j_data_*`` series and ``/healthz``
+        carries it as the ``"data"`` block, so a run chewing through
+        its quarantine budget is visible BEFORE the budget-exhaustion
+        fatality."""
+        self._data_fn = report_fn
+
+        def cb(reg: "MetricsRegistry") -> None:
+            rep = report_fn()
+            if not rep:
+                return
+            reg.set_counter("gan4j_data_retries_total",
+                            float(rep.get("retries_total", 0)))
+            reg.set_counter("gan4j_data_quarantined_total",
+                            float(rep.get("quarantined_total", 0)))
+            age = rep.get("last_error_age_s")
+            if isinstance(age, (int, float)):
+                reg.set("gan4j_data_last_error_age_seconds", age)
+
+        self.add_callback(cb)
+
     # -- render ---------------------------------------------------------------
 
     def render(self) -> str:
@@ -218,12 +256,33 @@ class MetricsRegistry:
                 beat_age = rep.get("last_beat_age_s")
             except Exception:
                 pass  # a broken feed must not take down the probe
+        # the data-plane block: from the live feed when one is
+        # registered, else the registry's own (pre-created) counters —
+        # the block is ALWAYS present, so probes can key on it
+        data = None
+        dfn = self._data_fn
+        if dfn is not None:
+            try:
+                rep = dfn() or {}
+                data = {"retries_total": int(rep.get("retries_total", 0)),
+                        "quarantined_total": int(
+                            rep.get("quarantined_total", 0)),
+                        "last_error_age_s": rep.get("last_error_age_s"),
+                        "ok": bool(rep.get("ok", True))}
+            except Exception:
+                pass  # a broken feed must not take down the probe
         with self._lock:
+            if data is None:
+                data = {"retries_total": int(self._counters.get(
+                            ("gan4j_data_retries_total", ()), 0.0)),
+                        "quarantined_total": int(self._counters.get(
+                            ("gan4j_data_quarantined_total", ()), 0.0)),
+                        "last_error_age_s": None, "ok": True}
             age = (None if self._last_record_wall is None
                    else round(time.time() - self._last_record_wall, 3))
             doc = {"status": "stalled" if stalled else "ok",
                    "stalled": stalled, "run_id": self.run_id,
-                   "last_record_age_s": age}
+                   "last_record_age_s": age, "data": data}
             if beat_age is not None:
                 doc["last_beat_age_s"] = round(float(beat_age), 3)
             return doc

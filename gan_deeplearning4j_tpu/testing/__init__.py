@@ -3,11 +3,15 @@ the training path imports this package)."""
 
 from gan_deeplearning4j_tpu.testing.chaos import (
     ChaosInjector,
+    CorruptRecordSource,
+    FlakyReader,
+    FlakySource,
     HangingSource,
     InjectedCrash,
     NanSource,
     StallingSource,
 )
 
-__all__ = ["ChaosInjector", "HangingSource", "InjectedCrash", "NanSource",
+__all__ = ["ChaosInjector", "CorruptRecordSource", "FlakyReader",
+           "FlakySource", "HangingSource", "InjectedCrash", "NanSource",
            "StallingSource"]

@@ -32,6 +32,16 @@ the seeded, deterministic injector that does all four, driven by
   batch (the classic bad-record path to non-finite grads), driving the
   telemetry NaN alarm — and the rollback-with-perturbation heal path —
   end to end.
+* **flaky-reads** — ``FlakySource`` (a source whose ``next()`` raises a
+  transient ``OSError`` N times starting at a chosen call, then
+  recovers — an NFS blip) and ``FlakyReader`` (the same for a CSV
+  reader's ``read()``) drive the bounded-retry layer
+  (data/resilient.py ``RetryingSource``/``RetryingReader``) end to end.
+* **corrupt-records** — ``CorruptRecordSource`` yields malformed
+  batches at chosen emitted indices (seeded NaN rows, or a wrong-width
+  table) and ``ChaosInjector.corrupt_csv_rows`` rewrites seeded lines
+  of an on-disk CSV as garbage — both feed the quarantine layer
+  (``ValidatingSource`` / the row-tolerant ``CSVRecordReader.read``).
 
 Everything is parameterized by an explicit seed: a chaos failure must
 replay exactly.
@@ -135,6 +145,23 @@ class ChaosInjector:
         path = os.path.join(ckpt_dir, name)
         os.remove(path)
         return path
+
+    def corrupt_csv_rows(self, path: str, n_rows: int = 1,
+                         skip_lines: int = 0) -> List[int]:
+        """Rewrite ``n_rows`` seeded data lines of an on-disk CSV as
+        unparseable garbage (silent upstream-producer corruption /
+        bit-rot that still splits into lines).  Returns the 1-based
+        line numbers hit — exactly what ``quarantine.jsonl`` must name
+        back."""
+        with open(path) as f:
+            lines = f.read().splitlines()
+        eligible = list(range(skip_lines, len(lines)))
+        hit = sorted(self.rng.sample(eligible, min(n_rows, len(eligible))))
+        for i in hit:
+            lines[i] = f"#CORRUPT#,{self.rng.random()},###"
+        with open(path, "w") as f:
+            f.write("\n".join(lines) + "\n")
+        return [i + 1 for i in hit]
 
     # -- hangs -----------------------------------------------------------------
 
@@ -280,6 +307,116 @@ class HangingSource:
             while True:  # never released — the watchdog's problem now
                 time.sleep(0.05)
         return self.source.next()
+
+    def __getattr__(self, name):
+        return getattr(self.source, name)
+
+
+class FlakySource:
+    """DataSet-iterator wrapper whose ``next()`` raises a TRANSIENT
+    ``OSError`` on ``failures`` consecutive calls starting at call
+    index ``at``, then succeeds forever — an NFS blip / flaky disk
+    under the reader.  The failure happens BEFORE the delegate is
+    touched, so a retried call replays the exact same batch sequence
+    (the property the bit-identical-resume tests lean on).  Seeded:
+    the error payload carries the seed so a chaos failure replays
+    exactly."""
+
+    def __init__(self, source, failures: int = 1, at: int = 0,
+                 seed: int = 0):
+        self.source = source
+        self.failures = failures
+        self.at = at
+        self.seed = seed
+        self.calls = 0
+        self.raised = 0
+
+    def has_next(self):
+        return self.source.has_next()
+
+    def reset(self):
+        return self.source.reset()
+
+    def next(self):
+        call = self.calls
+        self.calls += 1
+        if self.at <= call < self.at + self.failures:
+            self.raised += 1
+            raise OSError(
+                f"injected transient read failure "
+                f"{self.raised}/{self.failures} (seed {self.seed})")
+        return self.source.next()
+
+    def __getattr__(self, name):
+        return getattr(self.source, name)
+
+
+class FlakyReader:
+    """CSV-reader wrapper whose ``read()`` raises a transient
+    ``OSError`` the first ``failures`` calls, then delegates — the
+    ingest-time counterpart of ``FlakySource`` (drives
+    ``RetryingReader``)."""
+
+    def __init__(self, reader, failures: int = 1, seed: int = 0):
+        self.reader = reader
+        self.failures = failures
+        self.seed = seed
+        self.calls = 0
+
+    def read(self, *a, **kw):
+        call = self.calls
+        self.calls += 1
+        if call < self.failures:
+            raise OSError(
+                f"injected transient decode failure "
+                f"{call + 1}/{self.failures} (seed {self.seed})")
+        return self.reader.read(*a, **kw)
+
+    def __getattr__(self, name):
+        return getattr(self.reader, name)
+
+
+class CorruptRecordSource:
+    """DataSet-iterator wrapper that yields MALFORMED batches at the
+    chosen emitted indices — the runtime-corruption counterpart of
+    ``corrupt_csv_rows``.  ``mode="nan"`` poisons one seeded row per
+    hit batch with NaNs (a bad record that parsed); ``mode="shape"``
+    emits the batch with an extra feature column (a producer schema
+    break).  Drives the quarantine layer (data/resilient.py
+    ``ValidatingSource``): NaN rows must be skipped-and-charged
+    row-by-row, shape breaks quarantined as a batch."""
+
+    def __init__(self, source, corrupt_at=(0,), mode: str = "nan",
+                 rng: Optional[random.Random] = None):
+        if mode not in ("nan", "shape"):
+            raise ValueError(f"unknown corruption mode {mode!r}")
+        self.source = source
+        self.corrupt_at = frozenset(corrupt_at)
+        self.mode = mode
+        self.rng = rng or random.Random(0)
+        self.emitted = 0
+        self.corrupted = 0
+
+    def has_next(self):
+        return self.source.has_next()
+
+    def reset(self):
+        return self.source.reset()
+
+    def next(self):
+        ds = self.source.next()
+        if self.emitted in self.corrupt_at:
+            self.corrupted += 1
+            feats = np.array(ds.features, copy=True)
+            if self.mode == "nan":
+                feats[self.rng.randrange(max(1, feats.shape[0]))] = np.nan
+            else:  # "shape": one extra column — the record width broke
+                feats = np.concatenate(
+                    [feats, np.zeros((feats.shape[0], 1), feats.dtype)],
+                    axis=1)
+            ds.features = feats
+        self.emitted += 1
+        return ds
 
     def __getattr__(self, name):
         return getattr(self.source, name)

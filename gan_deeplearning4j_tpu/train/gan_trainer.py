@@ -45,8 +45,16 @@ from gan_deeplearning4j_tpu.checkpoint import (
     TrainCheckpointer,
 )
 from gan_deeplearning4j_tpu.data import (
+    CSVRecordReader,
     RecordReaderDataSetIterator,
     write_csv_matrix,
+)
+from gan_deeplearning4j_tpu.data.resilient import (
+    DataHealth,
+    RecordQuarantine,
+    RetryingReader,
+    RetryingSource,
+    ValidatingSource,
 )
 from gan_deeplearning4j_tpu.graph import serialization
 from gan_deeplearning4j_tpu.parallel import DataParallelGraph, data_mesh
@@ -199,6 +207,23 @@ class GANTrainerConfig:
     watchdog_warmup_s: float = 300.0
     watchdog_scale: float = 20.0
     watchdog_min_deadline_s: float = 5.0
+    # -- resilient data plane (data/resilient.py) --
+    # Bounded retries on TRANSIENT data-source I/O errors (OSError /
+    # truncated reads), exponential backoff + jitter, at both the CSV
+    # read and the streaming next() — exhaustion raises DataSourceError,
+    # which train_with_recovery restarts instead of dying.  0 = the
+    # reference's die-on-first-error behavior.
+    data_retries: int = 3
+    data_retry_backoff_s: float = 0.1
+    # Corrupt-record quarantine budget: > 0 arms row-tolerant ingest —
+    # malformed records (bad width/parse/non-finite, out-of-range
+    # labels) are skipped, logged to res_path/quarantine.jsonl with
+    # file:line provenance, and charged here; EXCEEDING the budget
+    # raises DataQuarantineError, FATAL in the recovery wrapper (a
+    # restart re-reads the same poison — the rollback-budget
+    # semantics).  0 = strict: the first malformed record raises with
+    # file:line provenance (CSVRowError).
+    max_quarantine: int = 0
     # Structured event tracing (telemetry/events.py): spans/instants for
     # checkpoint stages, preemption, recovery, prefetch stalls etc. to
     # res_path/events.jsonl plus the always-on flight-recorder ring.
@@ -288,10 +313,19 @@ def train_with_recovery(make_trainer: Callable[[bool], "GANTrainer"],
     before the bad step with a cut LR and a perturbed noise stream.
     ``RollbackError`` (budget exhausted) and ``DivergenceError`` (the
     sentinel's abort action — a deterministic replay re-diverges
-    identically) join the fatal class."""
+    identically) join the fatal class.
+
+    Data-plane classification (data/resilient.py): ``DataSourceError``
+    (a source still failing after its bounded retries) stays in the
+    RETRYABLE class — the restart rebuilds the reader stack with fresh
+    file handles, exactly the medicine for storage flakiness that
+    outlives one read — while ``DataQuarantineError`` (corrupt-record
+    budget exhausted) is FATAL: a restart re-reads the same poisoned
+    dataset and re-exhausts the same budget."""
     import random as _random
 
     from gan_deeplearning4j_tpu.checkpoint import CheckpointCorruptError
+    from gan_deeplearning4j_tpu.data.resilient import DataQuarantineError
     from gan_deeplearning4j_tpu.telemetry import NanAlarmError
     from gan_deeplearning4j_tpu.train.divergence import DivergenceError
     from gan_deeplearning4j_tpu.train.preemption import PreemptionError
@@ -327,7 +361,8 @@ def train_with_recovery(make_trainer: Callable[[bool], "GANTrainer"],
         except (KeyboardInterrupt, PreemptionError):
             raise  # preemption: checkpointed; the scheduler requeues
         except (ValueError, TypeError, CheckpointCorruptError,
-                NanAlarmError, DivergenceError, RollbackError):
+                NanAlarmError, DivergenceError, RollbackError,
+                DataQuarantineError):
             raise  # fatal class: a restart replays the identical failure
         except RollbackRequested as e:
             # in-process heal: no budget burned here (the manager's was
@@ -435,6 +470,35 @@ def add_health_args(parser) -> None:
         "--watchdog-deadline", type=float, default=None, metavar="SEC",
         help="fixed watchdog deadline in seconds (default: auto-scale "
              "from the measured steady-state step time)")
+
+
+def add_data_args(parser) -> None:
+    """Shared CLI flags for the resilient data plane
+    (data/resilient.py) — one definition so the protocol mains cannot
+    drift apart, like ``add_health_args``."""
+    parser.add_argument(
+        "--data-retries", type=int, default=3, metavar="N",
+        help="bounded retries (exponential backoff + jitter) on "
+             "transient data-source I/O errors — a flaky disk or NFS "
+             "blip becomes gan4j_data_retries_total instead of a dead "
+             "run; exhaustion is a retryable DataSourceError for "
+             "--max-restarts (0 = die on the first I/O error)")
+    parser.add_argument(
+        "--max-quarantine", type=int, default=0, metavar="N",
+        help="corrupt-record tolerance: skip up to N malformed records "
+             "(bad width/parse/non-finite/label), logging each to "
+             "res-path/quarantine.jsonl with file:line provenance; "
+             "EXCEEDING the budget is a fatal DataQuarantineError "
+             "(docs/FAULT_TOLERANCE.md).  0 = strict: the first "
+             "malformed record raises, naming its file:line")
+
+
+def data_config_kwargs(args) -> Dict:
+    """The add_data_args flags as GANTrainerConfig overrides."""
+    return dict(
+        data_retries=args.data_retries,
+        max_quarantine=args.max_quarantine,
+    )
 
 
 def health_config_kwargs(args) -> Dict:
@@ -670,12 +734,40 @@ class GANTrainer:
         self.registry.observe_goodput(
             lambda: self.goodput.report()
             if getattr(self, "goodput", None) is not None else None)
+        # resilient data plane (data/resilient.py): one health feed for
+        # the gan4j_data_* series and the /healthz "data" block, plus
+        # the per-run corrupt-record quarantine when a budget is set
+        if config.data_retries < 0:
+            raise ValueError(
+                f"data_retries must be >= 0, got {config.data_retries}")
+        if config.max_quarantine < 0:
+            raise ValueError(
+                f"max_quarantine must be >= 0, got {config.max_quarantine}")
+        self.data_health = DataHealth()
+        self.registry.observe_data(self.data_health.report)
+        self._quarantine = None
+        if config.max_quarantine:
+            self._quarantine = RecordQuarantine(
+                os.path.join(config.res_path, "quarantine.jsonl"),
+                budget=config.max_quarantine, health=self.data_health)
+        # O(1) resumable iterator state: the live train iterator and
+        # (on streaming paths) the consuming prefetch wrapper, read by
+        # _checkpoint_extra to stamp every checkpoint with the consumed
+        # data position (data/csv.py state contract)
+        self._train_iter = None
+        self._data_stream = None
+        self._iter_state_consumed = None
         self.metrics_port: Optional[int] = None  # resolved in train()
         self._events: Optional[events.EventRecorder] = None
         self.metrics = MetricsLogger(
             os.path.join(config.res_path, f"{config.dataset_name}_metrics.jsonl")
             if config.metrics else None,
             on_record=self._observe_record,
+            # a resumed incarnation APPENDS to its own history — the
+            # same one-contiguous-timeline discipline as events.jsonl,
+            # and what lets a post-crash resume be compared bit-for-bit
+            # against an uninterrupted run's full timeline
+            append=config.resume,
         )
         # a checkpointer also exists for resume-only runs and preemption-
         # armed runs (the emergency save needs somewhere durable to land
@@ -803,12 +895,43 @@ class GANTrainer:
         return {"dis": self.dis, "gen": self.gen, "gan": self.gan,
                 "classifier": self.classifier}
 
+    def _iter_state(self) -> Optional[Dict]:
+        """O(1) consumed-position of the training data, for the
+        checkpoint ``extra`` dict.  Streaming paths read the snapshot
+        the bookkeeping stashed at the last STEP BOUNDARY (exact for
+        ANY source that exposes ``state()``, including non-tabular
+        ones — and, being boundary-aligned, safe for the watchdog's
+        emergency checkpoint, which fires while the training thread
+        may have already consumed the next batch); the resident path —
+        which never consumes the host iterator — derives the canonical
+        position arithmetically from the step counter.  None when
+        neither is available (the resume then falls back to the legacy
+        replay)."""
+        st = self._iter_state_consumed
+        if st is not None:
+            return st
+        fn = getattr(self._train_iter, "state_for_step", None)
+        if fn is not None:
+            try:
+                return fn(self.batch_counter)
+            except ValueError:
+                return None  # no full batch: nothing derivable
+        return None
+
     def _checkpoint_extra(self) -> Dict:
         """Run state the graphs' params don't carry.  No RNG state
         needed: the z-stream is counter-based, derived from
-        batch_counter (the checkpoint step) alone."""
+        batch_counter (the checkpoint step) alone.  The data-iterator
+        position DOES ride along (``iter_state``, a JSON scalar): it is
+        what lets ``_maybe_resume`` restore the data plane in O(1)
+        instead of replaying every consumed batch."""
         extra = {"soften_real": self.soften_real,
                  "soften_fake": self.soften_fake}
+        it_state = self._iter_state()
+        if it_state is not None:
+            import json as _json
+
+            extra["iter_state"] = _json.dumps(it_state, sort_keys=True)
         # the generator EMA is state the graphs' params don't carry;
         # without it a crash-resume would silently restart the
         # trajectory average from the current weights
@@ -959,18 +1082,73 @@ class GANTrainer:
                 layer: ema.get(layer, {}) for layer in self.gen.params}
         # (older checkpoints carried a "z_key" entry; the z-stream is now
         # counter-based and needs no restored state)
-        # Fast-forward the data iterator (views, cheap), replaying the
-        # training loop's exact consumption pattern: partial epoch tails are
-        # consumed-and-skipped WITHOUT counting as a step, and exhaustion
-        # wraps (mirrors train() so a resumed run sees identical batches).
+        # Data-plane position: O(1) restore from the checkpoint's
+        # iter_state when it carries one (data/csv.py state contract) —
+        # constant-time regardless of step, the property a true
+        # streaming source needs.  Checkpoints from before the resilient
+        # data plane (or foreign iterators without restore_state) fall
+        # back to the legacy replay of the consumption pattern.
+        restored = False
+        raw_state = extra.get("iter_state")
+        restore = getattr(iter_train, "restore_state", None)
+        if raw_state is not None and restore is not None:
+            import json as _json
+            import logging
+
+            try:
+                it_state = _json.loads(raw_state)
+                restore(it_state)
+                restored = True
+                events.instant("data.resume_state", step=step,
+                               epoch=it_state.get("epoch"),
+                               cursor=it_state.get("cursor"))
+            except ValueError as e:
+                # shuffle-contract mismatch / undecodable state: the
+                # replay below reproduces the position the hard way —
+                # unless the contract REALLY changed, in which case the
+                # replayed order differs too and only the config owner
+                # can fix it; warn either way
+                logging.getLogger(__name__).warning(
+                    "checkpoint iter_state not restorable (%s); "
+                    "falling back to replay fast-forward", e)
+        if restored:
+            return
+        self._replay_fast_forward(iter_train, step)
+
+    def _replay_fast_forward(self, iter_train, step: int) -> None:
+        """Legacy O(step) resume: replay the training loop's exact
+        consumption pattern — partial epoch tails are consumed-and-
+        skipped WITHOUT counting as a step, and exhaustion wraps
+        (mirrors train() so a resumed run sees identical batches).
+        Guarded against a source that can never yield a full batch:
+        two consecutive wraps without progress (or an exhausted-empty
+        source) raise a clear ValueError instead of spinning forever —
+        the zero-batch reset loop a short tail's ``continue`` used to
+        enter."""
         steps_done = 0
+        fruitless_wraps = 0
         while steps_done < step:
             if not iter_train.has_next():
                 iter_train.reset()
-            ds = iter_train.next()
+            try:
+                ds = iter_train.next()
+            except StopIteration:
+                raise ValueError(
+                    f"cannot fast-forward to step {step}: the data "
+                    "source is empty even after reset") from None
             if ds.num_examples() < self.c.batch_size:
                 iter_train.reset()
+                fruitless_wraps += 1
+                if fruitless_wraps > 1:
+                    # a WHOLE pass produced no full batch: every later
+                    # pass replays the same rows and spins identically
+                    raise ValueError(
+                        f"cannot fast-forward to step {step}: the data "
+                        f"source never yields a full batch of "
+                        f"{self.c.batch_size} (pass exhausted after "
+                        f"{steps_done} full batches)")
                 continue
+            fruitless_wraps = 0
             steps_done += 1
             if not iter_train.has_next():
                 iter_train.reset()
@@ -1109,10 +1287,26 @@ class GANTrainer:
         with self.goodput.phase("data_wait"), \
                 events.span("data.prepare"):
             train_csv, test_csv = self.w.ensure_data(c.res_path)
+            # resilient ingest: the CSV decode retries transient I/O
+            # errors, and (with a quarantine budget) tolerates corrupt
+            # records row-by-row instead of dying on the first one
+            reader = CSVRecordReader()
+            if c.data_retries:
+                reader = RetryingReader(
+                    reader, retries=c.data_retries,
+                    backoff_s=c.data_retry_backoff_s,
+                    health=self.data_health, seed=c.seed)
+            iter_kw = dict(reader=reader)
+            if self._quarantine is not None:
+                iter_kw["quarantine"] = self._quarantine
             iter_train = RecordReaderDataSetIterator(
-                train_csv, c.batch_size, c.label_index, c.num_classes)
+                train_csv, c.batch_size, c.label_index, c.num_classes,
+                **iter_kw)
             iter_test = RecordReaderDataSetIterator(
-                test_csv, c.batch_size_pred, c.label_index, c.num_classes)
+                test_csv, c.batch_size_pred, c.label_index, c.num_classes,
+                **iter_kw)
+            self._train_iter = iter_train
+            self._iter_state_consumed = None
         with self.goodput.phase("checkpoint"), \
                 events.span("train.resume"):
             self._maybe_resume(iter_train)
@@ -1288,13 +1482,16 @@ class GANTrainer:
                 encode = (self._codec_lib.u8x100_encode
                           if self._stream_codec == "u8x100" else None)
                 chunks = ChunkPrefetchIterator(
-                    iter_train, self._steps_per_call, c.batch_size,
+                    self._wrap_stream(iter_train), self._steps_per_call,
+                    c.batch_size,
                     prefetch_depth=1, sharding=chunk_sh,
                     encode_features=encode, dedup=self._stream_dedup)
+                self._data_stream = chunks
                 try:
                     self._chunked_stream_loop(chunks, iter_test,
                                               fused_state, log)
                 finally:
+                    self._data_stream = None
                     chunks.close()
             else:
                 # Background prefetch (SURVEY.md §3.2 hot-loop note: the
@@ -1315,12 +1512,15 @@ class GANTrainer:
                         sharding = jax.sharding.SingleDeviceSharding(
                             jax.devices()[0])
                 prefetch = PrefetchIterator(
-                    iter_train, prefetch_depth=2, sharding=sharding,
+                    self._wrap_stream(iter_train), prefetch_depth=2,
+                    sharding=sharding,
                     loop=True, min_rows=c.batch_size)
+                self._data_stream = prefetch
                 try:
                     self._train_loop(prefetch, iter_test, fused_state, ones,
                                      y_dis, log)
                 finally:
+                    self._data_stream = None
                     prefetch.close()
 
         if self._fused_step is not None and self._final_state is not None:
@@ -1478,6 +1678,28 @@ class GANTrainer:
                 "must divide the artifact cadences and the resume step "
                 "so chunks stay aligned")
         return k
+
+    def _wrap_stream(self, iter_train):
+        """Resilience wrappers for the STREAMING consumption paths
+        (data/resilient.py): transient next()/reset() errors retry
+        with backoff (RetryingSource), and — with a quarantine budget —
+        every emitted batch passes the per-record shape/finite contract
+        (ValidatingSource), bad rows skipped and charged.  The resident
+        path never goes through here: its table was already validated
+        at ingest and it performs no runtime reads to retry.  The
+        wrappers delegate ``state``/``features``/... so the prefetch
+        state capture and the dedup verification see through them."""
+        src = iter_train
+        c = self.c
+        if c.data_retries:
+            src = RetryingSource(src, retries=c.data_retries,
+                                 backoff_s=c.data_retry_backoff_s,
+                                 health=self.data_health, seed=c.seed)
+        if self._quarantine is not None:
+            src = ValidatingSource(src, self._quarantine,
+                                   num_features=c.num_features,
+                                   name=f"{c.dataset_name}:train-stream")
+        return src
 
     def _resident_data_ok(self, iter_train, codec=None) -> bool:
         """Decide the device-resident data path (config override, else
@@ -1729,6 +1951,7 @@ class GANTrainer:
         self.batch_counter += n
         if self._watchdog is not None:
             self._watchdog.beat(step=self.batch_counter)
+        self._stash_iter_state()
         # examples=0: on the async resident path the host free-runs ahead
         # of the device, so inter-chunk wall time measures dispatch, not
         # compute — a per-step examples_per_sec from it would be fiction.
@@ -1748,6 +1971,7 @@ class GANTrainer:
         self.batch_counter += 1
         if self._watchdog is not None:
             self._watchdog.beat(step=self.batch_counter)
+        self._stash_iter_state()
         self.metrics.log_step(
             self.batch_counter, examples=c.batch_size,
             d_loss=d_loss, g_loss=g_loss, classifier_loss=c_loss,
@@ -1756,6 +1980,20 @@ class GANTrainer:
         if self.batch_counter % 100 == 0:
             log(f"Completed Batch {self.batch_counter}!")
         self._boundary_bookkeeping(iter_test)
+
+    def _stash_iter_state(self) -> None:
+        """Snapshot the stream's consumed-position at this step/chunk
+        boundary — the one moment it is guaranteed aligned with
+        ``batch_counter``.  Checkpoints (periodic, emergency, watchdog)
+        read the stash, never the live stream: between boundaries the
+        training thread may have consumed the NEXT batch already, and
+        stamping that position against the current step would shift
+        the resumed run's batch sequence by one."""
+        stream = self._data_stream
+        if stream is not None:
+            st = stream.state()
+            if st is not None:
+                self._iter_state_consumed = st
 
     def _boundary_bookkeeping(self, iter_test) -> None:
         """Artifact/checkpoint cadence triggers at the current counter

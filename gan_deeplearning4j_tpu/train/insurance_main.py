@@ -23,8 +23,10 @@ from gan_deeplearning4j_tpu.train.gan_trainer import (
     GANTrainer,
     GANTrainerConfig,
     Workload,
+    add_data_args,
     add_health_args,
     check_recovery_args,
+    data_config_kwargs,
     health_config_kwargs,
     run_with_recovery,
 )
@@ -155,6 +157,7 @@ def main(argv=None) -> Dict[str, float]:
                         "stream so the replay differs (needs "
                         "--checkpoint-every; docs/FAULT_TOLERANCE.md)")
     add_health_args(p)
+    add_data_args(p)
     from gan_deeplearning4j_tpu.runtime import backend
 
     backend.add_bf16_flag(p)
@@ -188,6 +191,7 @@ def main(argv=None) -> Dict[str, float]:
         nan_alarm=args.nan_alarm,
         metrics_port=args.metrics_port,
         **health_config_kwargs(args),
+        **data_config_kwargs(args),
     )
     from gan_deeplearning4j_tpu.utils import maybe_trace, print_trace_summary
 
