@@ -1,0 +1,34 @@
+"""gan4j-lint: JAX-aware static analysis + runtime trace sanitizers.
+
+The static half (engine.py + rules_jax.py + rules_concurrency.py) is
+an AST rule engine with per-line suppressions, a baseline mechanism
+and human/JSON reporters, shipped as the ``gan4j-lint`` console entry
+(cli.py) and enforced as a zero-findings CI gate (tier1.yml).  The
+runtime half (sanitizers.py) proves on the REAL program what the AST
+can only pattern-match: zero post-warmup recompiles
+(``RecompileSentinel``) and zero implicit transfers
+(``no_implicit_transfers``) in the fused hot loop.
+
+docs/STATIC_ANALYSIS.md is the operator manual: rule catalogue,
+suppression/baseline semantics, sanitizer wiring.
+"""
+
+from gan_deeplearning4j_tpu.analysis.engine import (  # noqa: F401
+    FileContext,
+    Finding,
+    LintResult,
+    Rule,
+    all_rules,
+    lint_package,
+    lint_paths,
+    package_root,
+    register,
+)
+from gan_deeplearning4j_tpu.analysis.sanitizers import (  # noqa: F401
+    RECOMPILE_EVENT,
+    RECOMPILE_METRIC,
+    RecompileError,
+    RecompileSentinel,
+    TransferGuardError,
+    no_implicit_transfers,
+)

@@ -1,0 +1,61 @@
+"""Baseline file: findings a codebase tolerates while paying down debt.
+
+THIS repo ships with an empty baseline (the PR 6 dogfooding pass fixed
+every finding instead of grandfathering them — docs/STATIC_ANALYSIS.md)
+but the mechanism exists so the linter can be adopted anywhere without
+a fix-everything-first flag day: ``gan4j-lint --baseline lint_baseline
+.json --write-baseline`` freezes today's findings; the gate then fails
+only on NEW ones, and the frozen set shrinks monotonically (a fixed
+finding simply stops matching — stale entries are reported so they get
+pruned).
+
+Fingerprints are content-addressed (rule + path + stripped source line
++ occurrence index, engine.Finding.fingerprint): insertions above a
+baselined finding do not un-baseline it, and FIXING the line does."""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Dict, List, Set, Tuple
+
+from gan_deeplearning4j_tpu.analysis.engine import Finding
+
+BASELINE_VERSION = 1
+
+
+def load(path: str) -> Set[str]:
+    """The fingerprint set of a baseline file; empty set when the file
+    does not exist (absent baseline == empty baseline, the strict
+    default)."""
+    if not os.path.exists(path):
+        return set()
+    with open(path) as f:
+        doc = json.load(f)
+    if doc.get("version") != BASELINE_VERSION:
+        raise ValueError(
+            f"baseline {path} has version {doc.get('version')!r}, "
+            f"expected {BASELINE_VERSION} — regenerate with "
+            f"--write-baseline")
+    return set(doc.get("fingerprints", {}))
+
+
+def write(path: str, findings: List[Finding]) -> int:
+    """Freeze ``findings`` as the new baseline (sorted, with enough
+    context per entry that a human can audit what was grandfathered).
+    Returns the number of fingerprints written."""
+    seen: Dict[Tuple[str, str, str], int] = {}
+    entries: Dict[str, Dict] = {}
+    for f in sorted(findings, key=lambda f: (f.path, f.line, f.rule)):
+        key = (f.rule, f.path, f.snippet)
+        idx = seen.get(key, 0)
+        seen[key] = idx + 1
+        entries[f.fingerprint(idx)] = {
+            "rule": f.rule, "path": f.path, "line": f.line,
+            "snippet": f.snippet,
+        }
+    doc = {"version": BASELINE_VERSION, "fingerprints": entries}
+    with open(path, "w") as f:
+        json.dump(doc, f, indent=1, sort_keys=True)
+        f.write("\n")
+    return len(entries)

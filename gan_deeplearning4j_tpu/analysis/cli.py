@@ -1,0 +1,124 @@
+"""``gan4j-lint`` console entry point — the zero-findings CI gate.
+
+Exit codes (the CI contract, tier1.yml lint lane):
+
+  0  no active findings (suppressed/baselined ones do not count)
+  1  at least one active finding or parse error
+  2  usage error (unknown rule, bad baseline version)
+
+With no paths, lints the installed ``gan_deeplearning4j_tpu`` package —
+``gan4j-lint`` alone IS the repo gate.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+from typing import Optional
+
+from gan_deeplearning4j_tpu.analysis import baseline as baseline_mod
+from gan_deeplearning4j_tpu.analysis import reporters
+from gan_deeplearning4j_tpu.analysis.engine import (
+    all_rules,
+    lint_paths,
+    package_root,
+)
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="gan4j-lint", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    p.add_argument("paths", nargs="*",
+                   help="files/directories to lint (default: the "
+                        "installed gan_deeplearning4j_tpu package)")
+    p.add_argument("--format", choices=("human", "json"),
+                   default="human", help="report format (json is the "
+                                         "CI artifact format)")
+    p.add_argument("--output", default=None, metavar="FILE",
+                   help="write the report there instead of stdout "
+                        "(the exit code is unchanged)")
+    p.add_argument("--baseline", default=None, metavar="FILE",
+                   help="fingerprint file of tolerated findings "
+                        "(absent file = empty baseline); this repo "
+                        "ships with an empty one")
+    p.add_argument("--write-baseline", action="store_true",
+                   help="freeze the current active findings into "
+                        "--baseline and exit 0 (adoption mode)")
+    p.add_argument("--rules", default=None, metavar="LIST",
+                   help="comma-separated rule names to run "
+                        "(default: all)")
+    p.add_argument("--disable", default="", metavar="LIST",
+                   help="comma-separated rule names to skip")
+    p.add_argument("--list-rules", action="store_true",
+                   help="print the rule catalogue and exit")
+    p.add_argument("--verbose", action="store_true",
+                   help="human format: also list suppressed/baselined "
+                        "findings")
+    return p
+
+
+def main(argv: Optional[list] = None) -> int:
+    parser = build_parser()
+    args = parser.parse_args(argv)
+
+    if args.list_rules:
+        for name, cls in sorted(all_rules().items()):
+            print(f"{name}: {cls.summary}")
+        return 0
+    if args.write_baseline and not args.baseline:
+        parser.error("--write-baseline requires --baseline FILE")
+
+    paths = args.paths or [package_root()]
+    # a gate that lints nothing must not answer green: a typo'd path
+    # (or a moved package dir) is a usage error, not a pass
+    missing = [p for p in paths if not os.path.exists(p)]
+    if missing:
+        print(f"gan4j-lint: error: no such path(s): "
+              f"{', '.join(missing)}", file=sys.stderr)
+        return 2
+    rules = ([r.strip() for r in args.rules.split(",") if r.strip()]
+             if args.rules else None)
+    disable = [r.strip() for r in args.disable.split(",") if r.strip()]
+
+    try:
+        fingerprints = (baseline_mod.load(args.baseline)
+                        if args.baseline and not args.write_baseline
+                        else set())
+        result = lint_paths(paths, rules=rules, disable=disable,
+                            baseline_fingerprints=fingerprints)
+    except ValueError as e:
+        print(f"gan4j-lint: error: {e}", file=sys.stderr)
+        return 2
+    if result.files_checked == 0:
+        print("gan4j-lint: error: no .py files under the given "
+              "path(s) — refusing to report a vacuous pass",
+              file=sys.stderr)
+        return 2
+
+    if args.write_baseline:
+        n = baseline_mod.write(args.baseline, result.findings)
+        print(f"gan4j-lint: baseline written: {n} fingerprint(s) -> "
+              f"{args.baseline}")
+        return 0
+
+    report = (reporters.render_json(result) if args.format == "json"
+              else reporters.render_human(result, verbose=args.verbose))
+    if args.output:
+        with open(args.output, "w") as f:
+            f.write(report)
+        # a one-line verdict still lands in the log next to the gate
+        print(f"gan4j-lint: {len(result.findings)} finding(s) "
+              f"({'ok' if result.ok else 'FAIL'}) -> {args.output}")
+    else:
+        sys.stdout.write(report)
+    return 0 if result.ok else 1
+
+
+def cli(argv: Optional[list] = None) -> None:
+    sys.exit(main(argv))
+
+
+if __name__ == "__main__":
+    cli()

@@ -1,0 +1,346 @@
+"""gan4j-lint core: file walking, AST parsing, suppressions, registry.
+
+Generic linters cannot see the hazards this codebase actually dies of:
+a PRNG key consumed twice (silently correlated noise — the
+rollback-with-perturbation replay depends on ``fold_in`` discipline), a
+closure mutated under ``jit`` (runs once at trace time, then never
+again), a host sync inside the fused hot loop (the MFU headline dies on
+one silent ``float()``), a jit-wrap inside a loop (a recompile per
+iteration), an unlocked shared-attribute write in the thread-heavy ops
+layer, or a swallowed exception (the PR 4 restart-marker bug class).
+Each is a RULE here (rules_jax.py / rules_concurrency.py); this module
+is the engine that runs them.
+
+Vocabulary shared by every rule:
+
+* **suppression** — ``# gan4j-lint: disable=<rule>[,<rule>] <reason>``
+  on the finding's line or the line directly above silences exactly
+  those rules there (``disable=all`` silences everything).  The policy
+  (docs/STATIC_ANALYSIS.md): a suppression without a reason is a review
+  rejection — the comment IS the justification record.
+* **hot-path marker** — ``# gan4j-lint: hot-path`` on or above a
+  ``def`` opts the whole function into host-sync-in-hot-path's loop
+  scrutiny even when the engine's step-call heuristic would not
+  recognize its loops as hot.
+* **baseline** — a fingerprint file (baseline.py) of findings to
+  tolerate; this repo ships with an EMPTY one (the dogfooding pass
+  fixed everything), the knob exists for adopting the linter on a
+  codebase that cannot fix all debt at once.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import hashlib
+import os
+import re
+import tokenize
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+SUPPRESS_RE = re.compile(r"#\s*gan4j-lint:\s*disable=([A-Za-z0-9_,\-]+)")
+HOT_PATH_RE = re.compile(r"#\s*gan4j-lint:\s*hot-path")
+
+
+@dataclasses.dataclass
+class Finding:
+    """One rule violation at one source location."""
+
+    rule: str
+    path: str            # as given to the engine (report-facing)
+    line: int            # 1-based
+    message: str
+    snippet: str = ""    # stripped source line, for reports + baseline
+
+    def fingerprint(self, index: int = 0) -> str:
+        """Content-addressed identity for the baseline: rule + path +
+        the STRIPPED offending line (+ an occurrence index so two
+        identical lines in one file stay distinct) — line numbers are
+        deliberately excluded, so unrelated edits above a baselined
+        finding do not un-baseline it."""
+        basis = f"{self.rule}\x00{self.path}\x00{self.snippet}\x00{index}"
+        return hashlib.sha256(basis.encode()).hexdigest()[:16]
+
+    def to_dict(self) -> Dict:
+        return dataclasses.asdict(self)
+
+
+class FileContext:
+    """Parsed view of one source file handed to every rule: the AST,
+    raw lines, per-line suppressions and hot-path markers."""
+
+    def __init__(self, path: str, source: str):
+        self.path = path
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree = ast.parse(source, filename=path)
+        # line (1-based) -> set of suppressed rule names (or {"all"})
+        self.suppressions: Dict[int, Set[str]] = {}
+        self.hot_lines: Set[int] = set()
+        for lineno, text in enumerate(self.lines, start=1):
+            m = SUPPRESS_RE.search(text)
+            if m:
+                self.suppressions[lineno] = {
+                    r.strip() for r in m.group(1).split(",") if r.strip()}
+            if HOT_PATH_RE.search(text):
+                self.hot_lines.add(lineno)
+
+    def line_text(self, lineno: int) -> str:
+        if 1 <= lineno <= len(self.lines):
+            return self.lines[lineno - 1].strip()
+        return ""
+
+    def suppressed(self, lineno: int, rule: str) -> bool:
+        """A finding at ``lineno`` is suppressed by a directive on the
+        SAME line or the line DIRECTLY above (the convention that
+        survives black-style reflowing of long lines)."""
+        for cand in (lineno, lineno - 1):
+            rules = self.suppressions.get(cand)
+            if rules and ("all" in rules or rule in rules):
+                return True
+        return False
+
+    def is_hot_marked(self, node: ast.AST) -> bool:
+        """``# gan4j-lint: hot-path`` on the def line, the line above
+        it, or the line above its first decorator."""
+        lineno = getattr(node, "lineno", None)
+        if lineno is None:
+            return False
+        candidates = {lineno, lineno - 1}
+        for dec in getattr(node, "decorator_list", []):
+            candidates.add(dec.lineno - 1)
+        return bool(candidates & self.hot_lines)
+
+    def finding(self, rule: str, node_or_line, message: str) -> Finding:
+        lineno = (node_or_line if isinstance(node_or_line, int)
+                  else node_or_line.lineno)
+        return Finding(rule=rule, path=self.path, line=lineno,
+                       message=message, snippet=self.line_text(lineno))
+
+
+# -- rule registry ------------------------------------------------------------
+
+
+class Rule:
+    """A named check over one FileContext.  Subclasses set ``name`` and
+    ``summary`` and implement ``check``; ``@register`` adds them to the
+    engine's default set."""
+
+    name: str = ""
+    summary: str = ""
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        raise NotImplementedError
+
+
+_REGISTRY: Dict[str, type] = {}
+
+
+def register(cls):
+    assert cls.name and cls.name not in _REGISTRY, cls
+    _REGISTRY[cls.name] = cls
+    return cls
+
+
+def all_rules() -> Dict[str, type]:
+    """name -> Rule class, importing the rule modules on first use (the
+    registry is populated by their ``@register`` decorators)."""
+    from gan_deeplearning4j_tpu.analysis import (  # noqa: F401
+        rules_concurrency,
+        rules_jax,
+    )
+
+    return dict(_REGISTRY)
+
+
+# -- shared AST helpers (used by both rule modules) ---------------------------
+
+
+def dotted_name(node: ast.AST) -> Optional[str]:
+    """``jax.random.uniform`` for the matching Attribute/Name chain,
+    None for anything dynamic (subscripts, calls)."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def last_segment(node: ast.AST) -> Optional[str]:
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    return None
+
+
+def walk_skipping_defs(node: ast.AST) -> Iterable[ast.AST]:
+    """Yield descendants of ``node`` WITHOUT entering nested function/
+    class definitions (their scopes have their own rule context)."""
+    for child in ast.iter_child_nodes(node):
+        if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.ClassDef, ast.Lambda)):
+            continue
+        yield child
+        yield from walk_skipping_defs(child)
+
+
+def function_defs(tree: ast.Module):
+    """Every (Async)FunctionDef in the module, at any nesting depth."""
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            yield node
+
+
+def bound_names(fn) -> Set[str]:
+    """Names the function binds locally: params, assignment targets,
+    for/with/except targets, comprehension targets, imports and nested
+    def/class names — the complement is its free (closed-over) names."""
+    names: Set[str] = set()
+    a = fn.args
+    for arg in (list(a.posonlyargs) + list(a.args) + list(a.kwonlyargs)
+                + ([a.vararg] if a.vararg else [])
+                + ([a.kwarg] if a.kwarg else [])):
+        names.add(arg.arg)
+
+    def targets(t):
+        if isinstance(t, ast.Name):
+            names.add(t.id)
+        elif isinstance(t, (ast.Tuple, ast.List)):
+            for e in t.elts:
+                targets(e)
+        elif isinstance(t, ast.Starred):
+            targets(t.value)
+
+    body = fn.body if not isinstance(fn, ast.Lambda) else []
+    for node in body:
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Assign):
+                for t in sub.targets:
+                    targets(t)
+            elif isinstance(sub, (ast.AugAssign, ast.AnnAssign)):
+                targets(sub.target)
+            elif isinstance(sub, (ast.For, ast.AsyncFor)):
+                targets(sub.target)
+            elif isinstance(sub, (ast.With, ast.AsyncWith)):
+                for item in sub.items:
+                    if item.optional_vars is not None:
+                        targets(item.optional_vars)
+            elif isinstance(sub, ast.ExceptHandler) and sub.name:
+                names.add(sub.name)
+            elif isinstance(sub, (ast.Import, ast.ImportFrom)):
+                for alias in sub.names:
+                    names.add((alias.asname or alias.name).split(".")[0])
+            elif isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.ClassDef)):
+                names.add(sub.name)
+            elif isinstance(sub, ast.comprehension):
+                targets(sub.target)
+            elif isinstance(sub, (ast.Global, ast.Nonlocal)):
+                # declared, but NOT local — handled by the caller
+                pass
+    return names
+
+
+# -- the engine ---------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class LintResult:
+    findings: List[Finding]          # active (not suppressed/baselined)
+    suppressed: List[Finding]        # silenced by an inline directive
+    baselined: List[Finding]         # silenced by the baseline file
+    errors: List[Finding]            # unparseable files (rule parse-error)
+    files_checked: int = 0
+
+    @property
+    def ok(self) -> bool:
+        return not self.findings and not self.errors
+
+
+def iter_python_files(paths: Sequence[str]) -> Iterable[str]:
+    for path in paths:
+        if os.path.isfile(path):
+            if path.endswith(".py"):
+                yield path
+            continue
+        for root, dirs, files in os.walk(path):
+            dirs[:] = sorted(d for d in dirs
+                             if d != "__pycache__"
+                             and not d.startswith("."))
+            for name in sorted(files):
+                if name.endswith(".py"):
+                    yield os.path.join(root, name)
+
+
+def lint_paths(paths: Sequence[str],
+               rules: Optional[Sequence[str]] = None,
+               disable: Sequence[str] = (),
+               baseline_fingerprints: Optional[Set[str]] = None,
+               ) -> LintResult:
+    """Run the (selected) rules over every ``.py`` under ``paths``.
+
+    ``rules``: restrict to these names (default: all registered);
+    ``disable``: drop these from whatever was selected;
+    ``baseline_fingerprints``: findings whose fingerprint is in here are
+    reported as ``baselined`` instead of active."""
+    registry = all_rules()
+    selected = list(rules) if rules else sorted(registry)
+    unknown = [r for r in list(selected) + list(disable)
+               if r not in registry]
+    if unknown:
+        raise ValueError(f"unknown rule(s): {', '.join(unknown)}; "
+                         f"known: {', '.join(sorted(registry))}")
+    instances = [registry[r]() for r in selected if r not in set(disable)]
+    baseline_fingerprints = baseline_fingerprints or set()
+
+    result = LintResult([], [], [], [])
+    for path in iter_python_files(paths):
+        result.files_checked += 1
+        try:
+            with tokenize.open(path) as f:   # honors coding declarations
+                source = f.read()
+            ctx = FileContext(path, source)
+        except (SyntaxError, UnicodeDecodeError, OSError) as e:
+            lineno = getattr(e, "lineno", None) or 1
+            result.errors.append(Finding(
+                rule="parse-error", path=path, line=int(lineno),
+                message=f"could not parse: {e.__class__.__name__}: {e}"))
+            continue
+        file_findings: List[Finding] = []
+        for rule in instances:
+            file_findings.extend(rule.check(ctx))
+        file_findings.sort(key=lambda f: (f.line, f.rule))
+        # occurrence index per (rule, snippet) so identical lines get
+        # distinct baseline fingerprints
+        seen: Dict[Tuple[str, str], int] = {}
+        for f in file_findings:
+            if ctx.suppressed(f.line, f.rule):
+                result.suppressed.append(f)
+                continue
+            key = (f.rule, f.snippet)
+            idx = seen.get(key, 0)
+            seen[key] = idx + 1
+            if f.fingerprint(idx) in baseline_fingerprints:
+                result.baselined.append(f)
+            else:
+                result.findings.append(f)
+    return result
+
+
+def package_root() -> str:
+    """The installed ``gan_deeplearning4j_tpu`` package directory — the
+    default lint target for ``gan4j-lint`` with no arguments and for the
+    bench ``--dryrun`` lint gate."""
+    import gan_deeplearning4j_tpu
+
+    return os.path.dirname(os.path.abspath(gan_deeplearning4j_tpu.__file__))
+
+
+def lint_package(**kw) -> LintResult:
+    """Lint the whole installed package with the default rule set and no
+    baseline — the zero-findings contract bench ``--dryrun`` asserts."""
+    return lint_paths([package_root()], **kw)

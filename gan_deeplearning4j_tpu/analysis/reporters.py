@@ -1,0 +1,58 @@
+"""Report rendering for gan4j-lint: human text and machine JSON.
+
+Human format is the conventional ``path:line: rule: message`` one line
+per finding (editors and CI log scrapers both parse it); JSON is the
+CI-artifact format tier1.yml uploads — stable keys, a summary block,
+and the full finding list including what was suppressed/baselined (the
+gate keys on ``findings`` alone, but the artifact shows the whole
+picture)."""
+
+from __future__ import annotations
+
+import json
+from typing import Dict
+
+from gan_deeplearning4j_tpu.analysis.engine import LintResult
+
+
+def render_human(result: LintResult, verbose: bool = False) -> str:
+    lines = []
+    for f in result.errors:
+        lines.append(f"{f.path}:{f.line}: {f.rule}: {f.message}")
+    for f in result.findings:
+        lines.append(f"{f.path}:{f.line}: {f.rule}: {f.message}")
+        if f.snippet:
+            lines.append(f"    {f.snippet}")
+    if verbose:
+        for f in result.suppressed:
+            lines.append(f"{f.path}:{f.line}: {f.rule}: suppressed "
+                         f"inline: {f.message}")
+        for f in result.baselined:
+            lines.append(f"{f.path}:{f.line}: {f.rule}: baselined: "
+                         f"{f.message}")
+    lines.append(
+        f"gan4j-lint: {len(result.findings)} finding(s), "
+        f"{len(result.suppressed)} suppressed, "
+        f"{len(result.baselined)} baselined, "
+        f"{len(result.errors)} parse error(s) "
+        f"in {result.files_checked} file(s)")
+    return "\n".join(lines) + "\n"
+
+
+def render_json(result: LintResult) -> str:
+    doc: Dict = {
+        "tool": "gan4j-lint",
+        "summary": {
+            "findings": len(result.findings),
+            "suppressed": len(result.suppressed),
+            "baselined": len(result.baselined),
+            "parse_errors": len(result.errors),
+            "files_checked": result.files_checked,
+            "ok": result.ok,
+        },
+        "findings": [f.to_dict() for f in result.findings],
+        "suppressed": [f.to_dict() for f in result.suppressed],
+        "baselined": [f.to_dict() for f in result.baselined],
+        "errors": [f.to_dict() for f in result.errors],
+    }
+    return json.dumps(doc, indent=1) + "\n"

@@ -1,0 +1,249 @@
+"""Concurrency lint rules for the thread-heavy ops layer.
+
+The trainer shares state with six background threads (checkpoint
+worker, prefetch worker, metrics logger, watchdog poller, exporter
+handler threads, artifact writer).  Two bug classes have actually
+bitten or nearly bitten:
+
+* ``unlocked-shared-write`` — a shared attribute written outside the
+  instance's lock (a torn read on a scrape thread is a wrong /healthz
+  answer, not a crash — the worst kind);
+* ``swallowed-exception``  — ``except: pass`` with no trace left.  The
+  PR 4 restart-marker bug was exactly this shape (an over-narrow
+  swallow masking real errors); the rule makes the pattern
+  un-reintroducible without a written justification.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List, Optional, Set
+
+from gan_deeplearning4j_tpu.analysis.engine import (
+    FileContext,
+    Finding,
+    Rule,
+    last_segment,
+    register,
+)
+
+LOCK_FACTORIES = {"Lock", "RLock", "Condition", "Semaphore",
+                  "BoundedSemaphore"}
+# methods exempt from the lock discipline: construction happens-before
+# publication; *_locked is the repo's documented "caller holds the
+# lock" convention (telemetry/exporter.py, telemetry/events.py).
+EXEMPT_METHODS = {"__init__", "__new__", "__post_init__"}
+
+
+def _lock_attrs(cls: ast.ClassDef) -> Set[str]:
+    """self.<attr> names assigned a threading lock anywhere in the
+    class (usually __init__)."""
+    out: Set[str] = set()
+    for node in ast.walk(cls):
+        if not isinstance(node, ast.Assign):
+            continue
+        if not (isinstance(node.value, ast.Call)
+                and last_segment(node.value.func) in LOCK_FACTORIES):
+            continue
+        for t in node.targets:
+            if (isinstance(t, ast.Attribute)
+                    and isinstance(t.value, ast.Name)
+                    and t.value.id == "self"):
+                out.add(t.attr)
+    return out
+
+
+def _with_holds_lock(item: ast.withitem, locks: Set[str]) -> bool:
+    """True when the with-item's context expression mentions one of the
+    instance's lock attributes (``with self._lock:``, ``with
+    self._lock, open(...)``, or a helper like
+    ``self._lock.acquire_timeout(...)``)."""
+    for node in ast.walk(item.context_expr):
+        if (isinstance(node, ast.Attribute) and node.attr in locks
+                and isinstance(node.value, ast.Name)
+                and node.value.id == "self"):
+            return True
+    return False
+
+
+@register
+class UnlockedSharedWrite(Rule):
+    """In a class that OWNS a lock (``self._lock = threading.Lock()``
+    et al.), every ``self.<attr> = ...`` in a regular method must
+    happen inside ``with self._lock:`` (or a with-statement whose
+    expression mentions the lock).  Exempt: ``__init__``-family methods
+    (construction happens-before publication), methods named
+    ``*_locked`` (the documented caller-holds-the-lock convention), the
+    lock attributes themselves, and explicit ``.acquire()``-balanced
+    regions the heuristic tracks within a straight-line body.
+
+    The class owning a lock is the signal that its state IS shared —
+    that is exactly when an unlocked write is a torn-read bug waiting
+    for a scrape/worker thread to find it."""
+
+    name = "unlocked-shared-write"
+    summary = ("shared attribute written outside the instance's lock "
+               "in a lock-owning class")
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        findings: List[Finding] = []
+        for cls in ast.walk(ctx.tree):
+            if not isinstance(cls, ast.ClassDef):
+                continue
+            locks = _lock_attrs(cls)
+            if not locks:
+                continue
+            for method in cls.body:
+                if not isinstance(method, (ast.FunctionDef,
+                                           ast.AsyncFunctionDef)):
+                    continue
+                if (method.name in EXEMPT_METHODS
+                        or method.name.endswith("_locked")):
+                    continue
+                self._check_body(method.body, locks, False, ctx,
+                                 findings, method.name)
+        return findings
+
+    def _check_body(self, body: List[ast.stmt], locks: Set[str],
+                    held: bool, ctx: FileContext,
+                    findings: List[Finding], method: str) -> None:
+        for stmt in body:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef)):
+                continue  # nested scope: its own thread context
+            if isinstance(stmt, (ast.With, ast.AsyncWith)):
+                now_held = held or any(
+                    _with_holds_lock(i, locks) for i in stmt.items)
+                self._check_body(stmt.body, locks, now_held, ctx,
+                                 findings, method)
+                continue
+            # explicit acquire()/release() in straight-line code
+            if self._is_lock_call(stmt, locks, "acquire"):
+                held = True
+                continue
+            if self._is_lock_call(stmt, locks, "release"):
+                held = False
+                continue
+            if isinstance(stmt, (ast.If,)):
+                self._check_body(stmt.body, locks, held, ctx, findings,
+                                 method)
+                self._check_body(stmt.orelse, locks, held, ctx,
+                                 findings, method)
+            elif isinstance(stmt, (ast.For, ast.AsyncFor, ast.While)):
+                self._check_body(list(stmt.body), locks, held, ctx,
+                                 findings, method)
+                self._check_body(list(stmt.orelse), locks, held, ctx,
+                                 findings, method)
+            elif isinstance(stmt, ast.Try):
+                for block in (stmt.body, stmt.orelse, stmt.finalbody):
+                    self._check_body(block, locks, held, ctx, findings,
+                                     method)
+                for handler in stmt.handlers:
+                    self._check_body(handler.body, locks, held, ctx,
+                                     findings, method)
+            elif not held:
+                self._flag_writes(stmt, locks, ctx, findings, method)
+
+    @staticmethod
+    def _is_lock_call(stmt: ast.stmt, locks: Set[str],
+                      which: str) -> bool:
+        return (isinstance(stmt, ast.Expr)
+                and isinstance(stmt.value, ast.Call)
+                and isinstance(stmt.value.func, ast.Attribute)
+                and stmt.value.func.attr == which
+                and isinstance(stmt.value.func.value, ast.Attribute)
+                and stmt.value.func.value.attr in locks
+                and isinstance(stmt.value.func.value.value, ast.Name)
+                and stmt.value.func.value.value.id == "self")
+
+    def _flag_writes(self, stmt: ast.stmt, locks: Set[str],
+                     ctx: FileContext, findings: List[Finding],
+                     method: str) -> None:
+        targets: List[ast.AST] = []
+        if isinstance(stmt, ast.Assign):
+            targets = list(stmt.targets)
+        elif isinstance(stmt, (ast.AugAssign, ast.AnnAssign)):
+            targets = [stmt.target]
+        for t in targets:
+            base: Optional[ast.AST] = t
+            if isinstance(t, ast.Subscript):
+                base = t.value  # self.d[k] = v mutates shared self.d
+            if (isinstance(base, ast.Attribute)
+                    and isinstance(base.value, ast.Name)
+                    and base.value.id == "self"
+                    and base.attr not in locks):
+                findings.append(ctx.finding(
+                    self.name, stmt,
+                    f"'self.{base.attr}' written outside the lock in "
+                    f"'{method}' of a lock-owning class — take the "
+                    f"lock, or rename the method '*_locked' if the "
+                    f"caller holds it"))
+
+
+@register
+class SwallowedException(Rule):
+    """Exception handlers that destroy the evidence:
+
+    * a handler whose body is ONLY ``pass``/``...``/``continue`` —
+      nothing logged, nothing recorded, nothing re-raised;
+    * a BARE ``except:`` that does not re-raise — it also eats
+      ``KeyboardInterrupt``/``SystemExit`` (and a watchdog's async-
+      raised ``WatchdogTimeout``), turning every cancellation path
+      into silence.
+
+    Some swallows are legitimate (best-effort cleanup where the
+    original error must not be masked) — those carry a justified
+    ``# gan4j-lint: disable=swallowed-exception`` on the handler line,
+    which doubles as the written record the review asks for anyway."""
+
+    name = "swallowed-exception"
+    summary = "except:-pass / bare except without re-raise"
+
+    SILENT = (ast.Pass, ast.Continue, ast.Break)
+    # exception classes that ARE control flow, not errors: catching and
+    # dropping them is the documented way to poll a bounded queue or
+    # drain an iterator — no evidence is destroyed
+    CONTROL_FLOW = {"Empty", "Full", "StopIteration",
+                    "StopAsyncIteration", "BlockingIOError",
+                    "InterruptedError", "GeneratorExit"}
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        findings: List[Finding] = []
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.ExceptHandler):
+                continue
+            if self._control_flow_only(node.type):
+                continue
+            silent = all(
+                isinstance(s, self.SILENT)
+                or (isinstance(s, ast.Expr)
+                    and isinstance(s.value, ast.Constant))
+                for s in node.body)
+            if silent:
+                what = ("bare except" if node.type is None
+                        else "exception handler")
+                findings.append(ctx.finding(
+                    self.name, node,
+                    f"{what} swallows the error with no trace — log "
+                    f"it, record it, or re-raise (never-mask "
+                    f"discipline, docs/STATIC_ANALYSIS.md)"))
+                continue
+            if node.type is None and not self._reraises(node):
+                findings.append(ctx.finding(
+                    self.name, node,
+                    "bare except: catches KeyboardInterrupt/SystemExit "
+                    "(and async-raised watchdog timeouts) — name the "
+                    "exception class, or re-raise"))
+        return findings
+
+    @classmethod
+    def _control_flow_only(cls, type_node) -> bool:
+        if type_node is None:
+            return False
+        types = (type_node.elts if isinstance(type_node, ast.Tuple)
+                 else [type_node])
+        return all(last_segment(t) in cls.CONTROL_FLOW for t in types)
+
+    @staticmethod
+    def _reraises(handler: ast.ExceptHandler) -> bool:
+        return any(isinstance(n, ast.Raise) for n in ast.walk(handler))

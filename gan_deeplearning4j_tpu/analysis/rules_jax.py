@@ -1,0 +1,626 @@
+"""JAX-specific lint rules: the hazard classes generic linters miss.
+
+Every rule here encodes a failure mode this codebase has either hit or
+structurally depends on avoiding:
+
+* ``prng-key-reuse``     — correlated randomness (the rollback replay's
+                           ``fold_in`` discipline made checkable);
+* ``tracer-side-effect`` — Python effects inside traced functions run
+                           once at trace time, then never again;
+* ``host-sync-in-hot-path`` — one silent ``float()`` in the fused loop
+                           serializes a device round trip per step;
+* ``recompile-hazard``   — jit-wraps in loops / per-call lambdas /
+                           non-hashable statics, each a silent
+                           recompile that eats the MFU headline.
+
+Static analysis is heuristic by nature: each rule documents exactly
+what it matches, and a justified ``# gan4j-lint: disable=<rule>``
+(engine.py) is the escape hatch for the cases it cannot see past.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from gan_deeplearning4j_tpu.analysis.engine import (
+    FileContext,
+    Finding,
+    Rule,
+    bound_names,
+    dotted_name,
+    function_defs,
+    last_segment,
+    register,
+    walk_skipping_defs,
+)
+
+# jax.random samplers that CONSUME a key (first positional argument).
+SAMPLERS = {
+    "uniform", "normal", "randint", "bernoulli", "permutation", "choice",
+    "categorical", "gumbel", "truncated_normal", "laplace", "beta",
+    "gamma", "poisson", "exponential", "bits", "rademacher", "cauchy",
+    "dirichlet", "multivariate_normal", "t", "orthogonal", "ball",
+    "loggamma", "rayleigh", "maxwell", "weibull_min", "double_sided_maxwell",
+}
+# derivation ops: take a key, return fresh key(s) — the FIX for reuse,
+# so they never count as a consumption.
+KEY_DERIVERS = {"split", "fold_in", "clone", "wrap_key_data"}
+KEY_MAKERS = {"key", "PRNGKey"}
+
+# transforms whose function argument is traced (side effects run once).
+TRACE_WRAPPERS = {"jit", "pjit", "vmap", "pmap", "shard_map", "xmap"}
+TRACE_ENTRY = TRACE_WRAPPERS | {
+    "scan", "while_loop", "fori_loop", "cond", "switch", "checkpoint",
+    "remat", "grad", "value_and_grad", "custom_vjp", "custom_jvp",
+    "associative_scan", "map",
+}
+
+# callee names the hot-loop heuristic treats as "dispatches the step":
+# the repo's step-callable naming convention plus anything locally bound
+# from a jit/make_*_step constructor (detected per function).
+STEP_CALLEE_NAMES = {"step", "step_fn", "run_step", "_fused_step",
+                     "_fused_multi", "train_step", "fused_step"}
+STEP_CONSTRUCTORS = {"jit", "pjit", "make_protocol_step", "make_multistep"}
+
+# host-materialization calls that have no business inside a hot loop
+HOST_SYNC_CALLS = {"asarray", "array"}      # on a numpy module alias
+NUMPY_ALIASES = {"np", "numpy", "onp"}
+
+
+def _is_trace_entry(func: ast.AST) -> bool:
+    """True when the callee is a tracing entry point.  ``map`` and
+    ``checkpoint`` collide with non-tracing names everywhere
+    (``jax.tree.map``, checkpoint writers) — they only count with an
+    explicit ``lax``/``jax`` module context."""
+    seg = last_segment(func)
+    if seg not in TRACE_ENTRY:
+        return False
+    if seg == "map":        # only jax.lax.map traces; jax.tree.map maps
+        name = dotted_name(func) or ""
+        return "lax" in name.split(".")[:-1]
+    if seg == "checkpoint":  # only jax.checkpoint (remat) traces
+        name = dotted_name(func) or ""
+        return "jax" in name.split(".")[:-1]
+    return True
+
+
+def _is_random_chain(func: ast.AST) -> bool:
+    """True when the callee's dotted chain goes through a ``random``
+    module segment (``jax.random.uniform``, ``jrandom.split``) — the
+    guard that keeps ``str.split`` and friends out of the key rules."""
+    name = dotted_name(func)
+    if name is None:
+        return False
+    segments = name.split(".")
+    return "random" in segments[:-1] or segments[-1] in {
+        "PRNGKey", "fold_in"}
+
+
+def _sampler_call(node: ast.Call) -> Optional[str]:
+    """The consumed key NAME when ``node`` is a jax.random sampler
+    called with a Name as its key argument, else None."""
+    seg = last_segment(node.func)
+    if seg in SAMPLERS and _is_random_chain(node.func):
+        if node.args and isinstance(node.args[0], ast.Name):
+            return node.args[0].id
+        for kw in node.keywords:
+            if kw.arg == "key" and isinstance(kw.value, ast.Name):
+                return kw.value.id
+    return None
+
+
+@register
+class PrngKeyReuse(Rule):
+    """A PRNG key consumed by two or more random ops without a
+    ``split``/``fold_in`` between them, or consumed inside a loop whose
+    body never derives a fresh key — both produce CORRELATED samples
+    silently (jax keys are values, not stateful generators).
+
+    Matching model (per function scope, module top level included):
+    sequential statement walk tracking a per-name generation counter;
+    any assignment to the name bumps it.  ``if``/``else`` branches are
+    walked independently and merged by INTERSECTION (a key is "already
+    consumed" afterwards only if every branch consumed it) — runtime
+    executes one branch, so union would be a false positive."""
+
+    name = "prng-key-reuse"
+    summary = ("PRNG key consumed >= 2 times without split/fold_in "
+               "(correlated randomness)")
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        findings: List[Finding] = []
+        scopes = [ast.Module(body=ctx.tree.body, type_ignores=[])]
+        scopes.extend(function_defs(ctx.tree))
+        for scope in scopes:
+            body = scope.body
+            if not isinstance(scope, ast.Module):
+                # nested defs get their own scope entry — skip them in
+                # the parent's statement walk (_walk does too)
+                pass
+            gen: Dict[str, int] = {}
+            consumed: Dict[Tuple[str, int], int] = {}
+            self._walk(body, gen, consumed, findings, ctx)
+        return findings
+
+    # -- sequential consumption tracking --------------------------------------
+
+    def _bump(self, stmt: ast.stmt, gen: Dict[str, int]) -> None:
+        """Any assignment to a name starts a new key generation."""
+        targets: List[ast.AST] = []
+        if isinstance(stmt, ast.Assign):
+            targets = list(stmt.targets)
+        elif isinstance(stmt, (ast.AugAssign, ast.AnnAssign)):
+            targets = [stmt.target]
+        for t in targets:
+            for node in ast.walk(t):
+                if isinstance(node, ast.Name):
+                    gen[node.id] = gen.get(node.id, 0) + 1
+
+    def _scan_expr(self, stmt: ast.AST, gen, consumed, findings,
+                   ctx) -> None:
+        for node in [stmt, *walk_skipping_defs(stmt)]:
+            if not isinstance(node, ast.Call):
+                continue
+            key_name = _sampler_call(node)
+            if key_name is None:
+                continue
+            ident = (key_name, gen.get(key_name, 0))
+            first = consumed.get(ident)
+            if first is not None:
+                findings.append(ctx.finding(
+                    self.name, node,
+                    f"PRNG key '{key_name}' already consumed by a "
+                    f"random op at line {first}; derive a fresh key "
+                    f"(jax.random.split / fold_in) before reusing it"))
+            else:
+                consumed[ident] = node.lineno
+
+    def _walk(self, body: List[ast.stmt], gen, consumed, findings,
+              ctx) -> None:
+        for stmt in body:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef)):
+                continue  # separate scope (checked independently)
+            if isinstance(stmt, ast.If):
+                # walk branches on INDEPENDENT copies, merge by
+                # intersection (see class docstring)
+                self._scan_expr(stmt.test, gen, consumed, findings, ctx)
+                self._branches([stmt.body, stmt.orelse], gen, consumed,
+                               findings, ctx)
+            elif isinstance(stmt, ast.Match):
+                # match/case: one case runs at runtime, same merge
+                # discipline as if/else.  A non-exhaustive match may
+                # run NO case, so the unchanged pre-match state joins
+                # the intersection — unless the last case is an
+                # unguarded wildcard (`case _:` / `case x:`), which
+                # always matches
+                self._scan_expr(stmt.subject, gen, consumed, findings,
+                                ctx)
+                bodies = [case.body for case in stmt.cases]
+                last = stmt.cases[-1] if stmt.cases else None
+                exhaustive = (
+                    last is not None and last.guard is None
+                    and isinstance(last.pattern, ast.MatchAs)
+                    and last.pattern.pattern is None)
+                if not exhaustive:
+                    bodies.append([])
+                self._branches(bodies, gen, consumed, findings, ctx)
+            elif isinstance(stmt, (ast.For, ast.AsyncFor, ast.While)):
+                self._loop(stmt, gen, consumed, findings, ctx)
+            elif isinstance(stmt, (ast.With, ast.AsyncWith)):
+                for item in stmt.items:
+                    self._scan_expr(item.context_expr, gen, consumed,
+                                    findings, ctx)
+                self._walk(stmt.body, gen, consumed, findings, ctx)
+            elif isinstance(stmt, ast.Try):
+                self._walk(stmt.body, gen, consumed, findings, ctx)
+                for handler in stmt.handlers:
+                    self._walk(handler.body, gen, consumed, findings, ctx)
+                self._walk(stmt.orelse, gen, consumed, findings, ctx)
+                self._walk(stmt.finalbody, gen, consumed, findings, ctx)
+            else:
+                self._scan_expr(stmt, gen, consumed, findings, ctx)
+                self._bump(stmt, gen)
+
+    def _branches(self, bodies, gen, consumed, findings, ctx) -> None:
+        """Walk mutually exclusive branch bodies on independent state
+        copies, then merge: generations by max, consumptions by
+        INTERSECTION (a key counts as already-consumed afterwards only
+        if EVERY branch consumed it — runtime executes one)."""
+        states = []
+        for body in bodies:
+            g, c = dict(gen), dict(consumed)
+            self._walk(list(body), g, c, findings, ctx)
+            states.append((g, c))
+        gen.clear()
+        for g, _ in states:
+            for k, v in g.items():
+                gen[k] = max(gen.get(k, 0), v)
+        merged = states[0][1]
+        for _, c in states[1:]:
+            merged = {k: v for k, v in merged.items() if k in c}
+        consumed.clear()
+        consumed.update(merged)
+
+    def _loop(self, stmt, gen, consumed, findings, ctx) -> None:
+        """A sampler consumption inside a loop body is a reuse unless
+        the loop body itself reassigns the key name (the per-iteration
+        ``key, sub = split(key)`` idiom)."""
+        if isinstance(stmt, ast.While):
+            self._scan_expr(stmt.test, gen, consumed, findings, ctx)
+        reassigned: Set[str] = set()
+        for node in walk_skipping_defs(stmt):
+            if isinstance(node, ast.Assign):
+                for t in node.targets:
+                    for n in ast.walk(t):
+                        if isinstance(n, ast.Name):
+                            reassigned.add(n.id)
+            elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+                for n in ast.walk(node.target):
+                    if isinstance(n, ast.Name):
+                        reassigned.add(n.id)
+            elif isinstance(node, (ast.For, ast.AsyncFor)):
+                for n in ast.walk(node.target):
+                    if isinstance(n, ast.Name):
+                        reassigned.add(n.id)
+        if isinstance(stmt, (ast.For, ast.AsyncFor)):
+            loop_vars = {n.id for n in ast.walk(stmt.target)
+                         if isinstance(n, ast.Name)}
+        else:
+            loop_vars = set()
+        for node in walk_skipping_defs(stmt):
+            if not isinstance(node, ast.Call):
+                continue
+            key_name = _sampler_call(node)
+            if key_name is None:
+                continue
+            if key_name in reassigned:
+                continue  # fresh key each iteration
+            if key_name in loop_vars:
+                continue  # iterating over pre-split keys
+            findings.append(ctx.finding(
+                self.name, node,
+                f"PRNG key '{key_name}' consumed inside a loop without "
+                f"a per-iteration split/fold_in — every iteration "
+                f"draws the same randomness"))
+        # after the loop, treat names consumed in the body as consumed
+        self._walk(list(stmt.body), gen, consumed, [], ctx)
+
+
+@register
+class TracerSideEffect(Rule):
+    """Python side effects inside a function handed to ``jit``/``vmap``/
+    ``shard_map``/``scan``/... run ONCE at trace time and never again —
+    the classic silently-wrong-after-warmup bug.  Flags, inside traced
+    functions: ``global``/``nonlocal`` declarations, mutation calls
+    (``append``/``extend``/``add``/``update``/...) on closed-over
+    names, and subscript/attribute stores to closed-over names.
+
+    "Traced" = decorated with a trace wrapper (``@jax.jit``, including
+    through ``functools.partial``), or passed by name / as a lambda to
+    one (``jax.jit(f)``, ``jax.lax.scan(f, ...)``)."""
+
+    name = "tracer-side-effect"
+    summary = ("Python side effect inside a jit/vmap/shard_map/scan-"
+               "traced function (runs once at trace time)")
+
+    MUTATORS = {"append", "extend", "insert", "add", "update",
+                "setdefault", "remove", "discard", "clear", "pop",
+                "popitem", "appendleft", "extendleft", "write"}
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        findings: List[Finding] = []
+        defs = {fn.name: fn for fn in function_defs(ctx.tree)}
+        traced: List[ast.AST] = []
+        for fn in defs.values():
+            if any(self._is_trace_wrapper(d) for d in fn.decorator_list):
+                traced.append(fn)
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if not _is_trace_entry(node.func):
+                continue
+            for arg in list(node.args) + [kw.value for kw in node.keywords]:
+                if isinstance(arg, ast.Lambda):
+                    traced.append(arg)
+                elif isinstance(arg, ast.Name) and arg.id in defs:
+                    traced.append(defs[arg.id])
+        seen_ids = set()
+        for fn in traced:
+            if id(fn) in seen_ids:
+                continue
+            seen_ids.add(id(fn))
+            findings.extend(self._check_traced(fn, ctx))
+        return findings
+
+    @staticmethod
+    def _is_trace_wrapper(dec: ast.AST) -> bool:
+        if last_segment(dec) in TRACE_WRAPPERS:
+            return True
+        # @partial(jax.jit, ...) / @functools.partial(shard_map, ...)
+        if (isinstance(dec, ast.Call)
+                and last_segment(dec.func) == "partial" and dec.args):
+            return last_segment(dec.args[0]) in TRACE_WRAPPERS
+        return False
+
+    def _check_traced(self, fn, ctx: FileContext) -> Iterable[Finding]:
+        local = bound_names(fn) if not isinstance(fn, ast.Lambda) else {
+            a.arg for a in fn.args.args}
+        body = fn.body if isinstance(fn.body, list) else [fn.body]
+        for stmt in body:
+            for node in [stmt, *walk_skipping_defs(stmt)]:
+                if isinstance(node, (ast.Global, ast.Nonlocal)):
+                    kind = ("global" if isinstance(node, ast.Global)
+                            else "nonlocal")
+                    yield ctx.finding(
+                        self.name, node,
+                        f"{kind} mutation inside a traced function "
+                        f"executes once at trace time, not per call")
+                elif isinstance(node, ast.Call):
+                    seg = last_segment(node.func)
+                    if (seg in self.MUTATORS
+                            and isinstance(node.func, ast.Attribute)
+                            and isinstance(node.func.value, ast.Name)
+                            and node.func.value.id not in local):
+                        yield ctx.finding(
+                            self.name, node,
+                            f"'{node.func.value.id}.{seg}(...)' mutates "
+                            f"closed-over state inside a traced "
+                            f"function — the effect happens at trace "
+                            f"time only")
+                elif isinstance(node, (ast.Assign, ast.AugAssign)):
+                    targets = (node.targets
+                               if isinstance(node, ast.Assign)
+                               else [node.target])
+                    for t in targets:
+                        base: Optional[ast.AST] = None
+                        if isinstance(t, (ast.Subscript, ast.Attribute)):
+                            base = t.value
+                        if (isinstance(base, ast.Name)
+                                and base.id not in local):
+                            yield ctx.finding(
+                                self.name, t,
+                                f"store into closed-over "
+                                f"'{base.id}' inside a traced function "
+                                f"— the effect happens at trace time "
+                                f"only")
+
+
+def _jit_bound_names(fn) -> Set[str]:
+    """Local names bound from a jit/step-constructor call in ``fn`` —
+    ``step = jax.jit(f)`` makes later ``step(...)`` calls step-like."""
+    out: Set[str] = set()
+    for node in ast.walk(fn):
+        if (isinstance(node, ast.Assign)
+                and isinstance(node.value, ast.Call)
+                and last_segment(node.value.func) in STEP_CONSTRUCTORS):
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    out.add(t.id)
+    return out
+
+
+def _loop_calls_step(loop: ast.AST, step_names: Set[str]) -> bool:
+    for node in walk_skipping_defs(loop):
+        if isinstance(node, ast.Call):
+            seg = last_segment(node.func)
+            if seg in STEP_CALLEE_NAMES or seg in step_names:
+                return True
+    return False
+
+
+@register
+class HostSyncInHotPath(Rule):
+    """Host synchronization inside a hot loop.  Two match classes:
+
+    1. ``block_until_ready`` anywhere: on the tunneled PJRT backends
+       this repo targets it is NOT a fence (utils/device.py) — use
+       ``utils.device.device_fence`` / ``overlap_device_get``.
+    2. Inside a HOT loop — one that dispatches a step callable
+       (``step``/``step_fn``/``run_step``/``_fused_step``/
+       ``_fused_multi``/... or any name locally bound from
+       ``jax.jit``/``make_protocol_step``/``make_multistep``), or any
+       loop in a function marked ``# gan4j-lint: hot-path`` —
+       ``.item()``, ``float(...)``/``int(...)``, and numpy
+       materialization (``np.asarray``/``np.array``) are flagged: each
+       serializes a device round trip per iteration.  Materialize once
+       after the loop, or hand the values to the async writer."""
+
+    name = "host-sync-in-hot-path"
+    summary = ("host sync (.item()/float()/np.asarray/"
+               "block_until_ready) inside the hot loop")
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        findings: List[Finding] = []
+        for node in ast.walk(ctx.tree):
+            if (isinstance(node, ast.Call)
+                    and last_segment(node.func) == "block_until_ready"):
+                findings.append(ctx.finding(
+                    self.name, node,
+                    "block_until_ready is not a reliable fence on "
+                    "tunneled backends — use utils.device.device_fence "
+                    "(readback) instead"))
+        for fn in function_defs(ctx.tree):
+            step_names = _jit_bound_names(fn)
+            hot_fn = ctx.is_hot_marked(fn)
+            for node in walk_skipping_defs(fn):
+                if not isinstance(node, (ast.For, ast.AsyncFor,
+                                         ast.While)):
+                    continue
+                if not (hot_fn or _loop_calls_step(node, step_names)):
+                    continue
+                findings.extend(self._check_loop_body(node, ctx))
+        return findings
+
+    def _check_loop_body(self, loop, ctx: FileContext):
+        for node in walk_skipping_defs(loop):
+            if not isinstance(node, ast.Call):
+                continue
+            seg = last_segment(node.func)
+            if seg == "item" and isinstance(node.func, ast.Attribute):
+                yield ctx.finding(
+                    self.name, node,
+                    ".item() in a hot loop blocks on a device->host "
+                    "round trip every iteration — materialize after "
+                    "the loop (utils.device.overlap_device_get)")
+            elif (isinstance(node.func, ast.Name)
+                    and node.func.id in {"float", "int"} and node.args):
+                yield ctx.finding(
+                    self.name, node,
+                    f"{node.func.id}() in a hot loop forces a "
+                    f"synchronous device readback per iteration — "
+                    f"keep values on device until after the loop")
+            elif (seg in HOST_SYNC_CALLS
+                    and isinstance(node.func, ast.Attribute)
+                    and isinstance(node.func.value, ast.Name)
+                    and node.func.value.id in NUMPY_ALIASES):
+                yield ctx.finding(
+                    self.name, node,
+                    f"np.{seg}() in a hot loop materializes to host "
+                    f"every iteration — batch the readback after the "
+                    f"loop (utils.device.overlap_device_get)")
+
+
+@register
+class RecompileHazard(Rule):
+    """Constructs that silently retrace/recompile a jitted program:
+
+    1. jit/vmap/pmap/shard_map wrapping INSIDE a loop — a fresh
+       callable (and compile-cache entry) per iteration;
+    2. a lambda passed per-iteration to a trace entry point or to a
+       locally jit-bound callable — fresh identity, fresh trace;
+    3. a list/dict/set literal passed in a ``static_argnums`` position
+       (or by ``static_argnames`` keyword) of a locally-bound jitted
+       callable — unhashable static = TypeError at best, a retrace per
+       call if converted blindly.
+
+    The RecompileSentinel (analysis/sanitizers.py) is the RUNTIME half
+    of this rule: whatever slips past the static patterns shows up as a
+    post-warmup compile in bench ``--dryrun``."""
+
+    name = "recompile-hazard"
+    summary = ("jit-wrap inside a loop / per-call lambda / non-hashable "
+               "static arg (silent recompiles)")
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        findings: List[Finding] = []
+        scopes: List[ast.AST] = [ctx.tree, *function_defs(ctx.tree)]
+        for scope in scopes:
+            static_specs = self._static_specs(scope)
+            jit_names = _jit_bound_names(scope) if not isinstance(
+                scope, ast.Module) else set()
+            for loop in walk_skipping_defs(scope):
+                if not isinstance(loop, (ast.For, ast.AsyncFor,
+                                         ast.While)):
+                    continue
+                findings.extend(
+                    self._check_loop(loop, jit_names, ctx))
+            findings.extend(self._check_statics(scope, static_specs, ctx))
+        # dedupe (nested loops are walked from every enclosing scope)
+        unique = {}
+        for f in findings:
+            unique[(f.line, f.message)] = f
+        return list(unique.values())
+
+    def _check_loop(self, loop, jit_names: Set[str], ctx: FileContext):
+        for node in walk_skipping_defs(loop):
+            if not isinstance(node, ast.Call):
+                continue
+            seg = last_segment(node.func)
+            if seg in TRACE_WRAPPERS:
+                yield ctx.finding(
+                    self.name, node,
+                    f"{seg}(...) inside a loop builds a fresh traced "
+                    f"callable every iteration — hoist the wrap out of "
+                    f"the loop")
+                continue
+            if (seg == "partial" and node.args
+                    and last_segment(node.args[0]) in TRACE_WRAPPERS):
+                yield ctx.finding(
+                    self.name, node,
+                    "partial(jit, ...) inside a loop builds a fresh "
+                    "traced callable every iteration — hoist it")
+                continue
+            if _is_trace_entry(node.func) or seg in jit_names:
+                for arg in list(node.args) + [kw.value
+                                              for kw in node.keywords]:
+                    if isinstance(arg, ast.Lambda):
+                        yield ctx.finding(
+                            self.name, arg,
+                            f"lambda passed to {seg}(...) inside a loop "
+                            f"is a fresh callable identity per "
+                            f"iteration — a retrace every call; define "
+                            f"it once outside")
+
+    def _static_specs(self, scope) -> Dict[str, Tuple[Set[int], Set[str]]]:
+        """name -> (static positional indices, static kwarg names) for
+        locals bound as ``f = jax.jit(g, static_argnums=..., ...)``."""
+        specs: Dict[str, Tuple[Set[int], Set[str]]] = {}
+        for node in walk_skipping_defs(scope):
+            if not (isinstance(node, ast.Assign)
+                    and isinstance(node.value, ast.Call)
+                    and last_segment(node.value.func) in {"jit", "pjit"}):
+                continue
+            nums: Set[int] = set()
+            names: Set[str] = set()
+            for kw in node.value.keywords:
+                if kw.arg == "static_argnums":
+                    nums |= self._int_values(kw.value)
+                elif kw.arg == "static_argnames":
+                    names |= self._str_values(kw.value)
+            if not nums and not names:
+                continue
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    specs[t.id] = (nums, names)
+        return specs
+
+    @staticmethod
+    def _int_values(node) -> Set[int]:
+        out: Set[int] = set()
+        elts = node.elts if isinstance(node, (ast.Tuple, ast.List)) \
+            else [node]
+        for e in elts:
+            if isinstance(e, ast.Constant) and isinstance(e.value, int):
+                out.add(e.value)
+        return out
+
+    @staticmethod
+    def _str_values(node) -> Set[str]:
+        out: Set[str] = set()
+        elts = node.elts if isinstance(node, (ast.Tuple, ast.List)) \
+            else [node]
+        for e in elts:
+            if isinstance(e, ast.Constant) and isinstance(e.value, str):
+                out.add(e.value)
+        return out
+
+    UNHASHABLE = (ast.List, ast.Dict, ast.Set, ast.ListComp, ast.SetComp,
+                  ast.DictComp, ast.GeneratorExp)
+
+    def _check_statics(self, scope, specs, ctx: FileContext):
+        if not specs:
+            return
+        for node in walk_skipping_defs(scope):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Name)
+                    and node.func.id in specs):
+                continue
+            nums, names = specs[node.func.id]
+            for i, arg in enumerate(node.args):
+                if i in nums and isinstance(arg, self.UNHASHABLE):
+                    yield ctx.finding(
+                        self.name, arg,
+                        f"non-hashable literal in static_argnums "
+                        f"position {i} of '{node.func.id}' — statics "
+                        f"must be hashable (and a fresh object per "
+                        f"call retraces)")
+            for kw in node.keywords:
+                if kw.arg in names and isinstance(kw.value,
+                                                  self.UNHASHABLE):
+                    yield ctx.finding(
+                        self.name, kw.value,
+                        f"non-hashable literal for static argname "
+                        f"'{kw.arg}' of '{node.func.id}' — statics "
+                        f"must be hashable")

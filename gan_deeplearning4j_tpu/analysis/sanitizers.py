@@ -1,0 +1,261 @@
+"""Runtime trace sanitizers — the dynamic half of gan4j-lint.
+
+Static rules (rules_jax.py) catch the hazard PATTERNS; these two catch
+whatever slips past them, on the real program:
+
+* ``RecompileSentinel`` — counts XLA compiles via jax's compile-logging
+  hook (the ``Compiling <name> ...`` record ``jax._src.interpreters.
+  pxla`` emits on every cache miss; cache hits emit nothing — verified
+  against jax 0.4).  ``arm()`` after warmup; every compile after that
+  is a RECOMPILE: counted, exported as ``gan4j_recompiles_total``,
+  traced as a ``compile.recompile`` event, and fatal in strict
+  consumers (bench ``--dryrun`` ``sanitizer_ok``, the pytest fixture).
+  The hook costs one logging-handler dispatch per COMPILE, not per
+  step — zero steady-state overhead, safe to leave on in production
+  (``--sanitize``).
+
+* ``no_implicit_transfers`` — ``jax.transfer_guard("disallow")`` around
+  the hot loop: any implicit host<->device transfer raises at the
+  offending op (explicit ``jax.device_put`` stays allowed — staging IS
+  explicit).  Platform note: on CPU backends device->host is zero-copy
+  and does not trip the guard; host->device does.  On TPU both
+  directions are guarded — the CI (CPU) gate therefore proves the
+  host->device half and the TPU bench run proves both.
+
+Wiring: bench ``--dryrun`` (``sanitizer_ok`` folded into ``ok``),
+``GANTrainer(sanitize=True)`` / ``--sanitize`` (observational: metric +
+event + warning, never kills a production run), and the
+``recompile_sentinel`` / ``transfer_guard`` pytest fixtures
+(tests/conftest.py).  docs/STATIC_ANALYSIS.md has the full contract.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from contextlib import contextmanager
+from typing import Callable, Dict, List, Optional
+
+# the logger that emits one "Compiling <name> with global shapes and
+# types ..." record per XLA compile (DEBUG when jax_log_compiles is
+# off, which is why the sentinel lowers the logger level instead of
+# flipping that config flag and spamming stderr)
+_COMPILE_LOGGER = "jax._src.interpreters.pxla"
+_COMPILE_PREFIX = "Compiling "
+
+RECOMPILE_METRIC = "gan4j_recompiles_total"
+RECOMPILE_EVENT = "compile.recompile"
+
+
+class RecompileError(RuntimeError):
+    """A post-warmup recompile in a region that promised none."""
+
+
+class TransferGuardError(RuntimeError):
+    """An implicit host<->device transfer in a guarded hot loop."""
+
+
+class _CompileLogHandler(logging.Handler):
+    def __init__(self, sentinel: "RecompileSentinel"):
+        super().__init__(level=logging.DEBUG)
+        self._sentinel = sentinel
+
+    def emit(self, record: logging.LogRecord) -> None:
+        try:
+            msg = record.getMessage()
+        except Exception:  # gan4j-lint: disable=swallowed-exception — a malformed log record must not break compilation itself
+            return
+        if msg.startswith(_COMPILE_PREFIX):
+            name = msg[len(_COMPILE_PREFIX):].split(" ", 1)[0]
+            self._sentinel._on_compile(name)
+
+
+class RecompileSentinel:
+    """Counts XLA compiles; any compile after ``arm()`` is a recompile.
+
+    ``registry``: a telemetry MetricsRegistry — post-arm compiles
+    increment ``gan4j_recompiles_total`` there.  ``step_fn``: optional
+    step-number source stamped onto the ``compile.recompile`` event so
+    the plot/live-UI overlays can place it on the step axis.
+    ``on_recompile``: extra callback per post-arm compile (the trainer
+    hangs its warning log here).
+
+    Context-manager use installs/removes the logging hook; ``arm()``
+    marks the end of the legitimate-compile window (post-warmup);
+    ``check()`` raises ``RecompileError`` listing what recompiled.
+    Thread-safe — compiles can land from any dispatching thread.
+
+    Scoping: by default every post-arm compile anywhere in the process
+    counts (right for a bench loop or a test body that owns the whole
+    window).  A long-lived consumer whose process ALSO legitimately
+    compiles auxiliary programs after warmup (the trainer's first
+    eval-cadence inference program, a metrics reader) instead wraps
+    only its hot dispatches in ``with sentinel.watch():`` — once any
+    watch region has been used, post-arm compiles only count when the
+    compiling thread is inside one (jit traces/compiles synchronously
+    on the calling thread, so the thread-local scope is exact).
+    Unwatched post-arm compiles are recorded in ``benign_compiles`` —
+    visible, just not violations."""
+
+    def __init__(self, registry=None,
+                 step_fn: Optional[Callable[[], int]] = None,
+                 on_recompile: Optional[Callable[[str], None]] = None):
+        self.registry = registry
+        self.step_fn = step_fn
+        self.on_recompile = on_recompile
+        self.compiles: List[str] = []       # warmup window
+        self.recompiles: List[str] = []     # post-arm = violations
+        self.benign_compiles: List[str] = []  # post-arm, outside watch
+        self._armed = False
+        self._watch_used = False
+        self._tls = threading.local()
+        self._lock = threading.Lock()
+        self._handler: Optional[_CompileLogHandler] = None
+        self._logger: Optional[logging.Logger] = None
+        self._prev_level: Optional[int] = None
+        self._prev_propagate: bool = True
+
+    # -- lifecycle ------------------------------------------------------------
+
+    def start(self) -> "RecompileSentinel":
+        with self._lock:
+            if self._handler is not None:
+                return self
+            self._logger = logging.getLogger(_COMPILE_LOGGER)
+            self._handler = _CompileLogHandler(self)
+            self._prev_level = self._logger.level
+            # the compile record is emitted at DEBUG (with
+            # jax_log_compiles off); lowering THIS logger's level routes
+            # it to our handler without enabling the flag's stderr
+            # warnings.  Root handlers sit at >= WARNING, so nothing
+            # extra prints.
+            if (self._prev_level == logging.NOTSET
+                    or self._prev_level > logging.DEBUG):
+                self._logger.setLevel(logging.DEBUG)
+            # stop propagation while attached: jax installs its own
+            # stderr handler on the parent "jax" logger, and the DEBUG
+            # records we just unlocked would spam it — the sentinel is
+            # the sole consumer for the duration
+            self._prev_propagate = self._logger.propagate
+            self._logger.propagate = False
+            self._logger.addHandler(self._handler)
+        if self.registry is not None:
+            # the series must exist from the first scrape even if no
+            # recompile ever happens (same discipline as nonfinite)
+            self.registry.inc(RECOMPILE_METRIC, 0.0)
+        return self
+
+    def stop(self) -> None:
+        with self._lock:
+            if self._handler is None:
+                return
+            self._logger.removeHandler(self._handler)
+            self._logger.setLevel(self._prev_level)
+            self._logger.propagate = self._prev_propagate
+            self._handler = None
+            self._logger = None
+
+    def __enter__(self) -> "RecompileSentinel":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    # -- the hook -------------------------------------------------------------
+
+    def arm(self) -> None:
+        """End of the warmup window: every compile from here on is a
+        recompile (the program was supposed to be cached)."""
+        with self._lock:
+            self._armed = True
+
+    @property
+    def armed(self) -> bool:
+        return self._armed
+
+    @contextmanager
+    def watch(self):
+        """Scope violation counting to this region (see class
+        docstring): wrap exactly the hot dispatches whose programs
+        must stay cached."""
+        with self._lock:
+            self._watch_used = True
+        depth = getattr(self._tls, "depth", 0)
+        self._tls.depth = depth + 1
+        try:
+            yield
+        finally:
+            self._tls.depth = depth
+
+    def _on_compile(self, name: str) -> None:
+        watched = getattr(self._tls, "depth", 0) > 0
+        with self._lock:
+            armed = self._armed
+            if not armed:
+                self.compiles.append(name)
+            elif self._watch_used and not watched:
+                # a legitimate first-time compile of an auxiliary
+                # program (eval inference, a reader) — recorded, not a
+                # violation of the hot path's cache promise
+                self.benign_compiles.append(name)
+                return
+            else:
+                self.recompiles.append(name)
+        if not armed:
+            return
+        attrs: Dict = {"fn": name}
+        if self.step_fn is not None:
+            try:
+                attrs["step"] = self.step_fn()
+            except Exception:  # gan4j-lint: disable=swallowed-exception — a broken step source must not mask the recompile signal itself
+                pass
+        from gan_deeplearning4j_tpu.telemetry import events
+
+        events.instant(RECOMPILE_EVENT, **attrs)
+        if self.registry is not None:
+            self.registry.inc(RECOMPILE_METRIC)
+        if self.on_recompile is not None:
+            self.on_recompile(name)
+
+    # -- verdicts -------------------------------------------------------------
+
+    @property
+    def ok(self) -> bool:
+        return not self.recompiles
+
+    def check(self) -> None:
+        if self.recompiles:
+            raise RecompileError(
+                f"{len(self.recompiles)} post-warmup recompile(s): "
+                f"{', '.join(sorted(set(self.recompiles)))} — the hot "
+                f"path promised a cached program (see "
+                f"docs/STATIC_ANALYSIS.md, rule recompile-hazard)")
+
+
+@contextmanager
+def no_implicit_transfers():
+    """``jax.transfer_guard("disallow")`` region: implicit host<->device
+    transfers inside raise ``TransferGuardError`` naming the offender
+    (explicit ``jax.device_put`` remains allowed).  Keep device fences/
+    readbacks OUTSIDE the region — a readback is a transfer by design.
+
+    Emits a ``transfer.violation`` instant event before re-raising, so
+    the flight recorder carries the evidence even when a caller
+    swallows the exception."""
+    import jax
+
+    from gan_deeplearning4j_tpu.telemetry import events
+
+    try:
+        with jax.transfer_guard("disallow"):
+            yield
+    except Exception as e:
+        # jax raises XlaRuntimeError/RuntimeError with a "Disallowed
+        # ... transfer" message; anything else is not the guard's
+        if "isallowed" not in str(e):
+            raise
+        events.instant("transfer.violation", error=str(e)[:200])
+        raise TransferGuardError(
+            f"implicit transfer in a guarded hot-loop region: {e} "
+            f"(see docs/STATIC_ANALYSIS.md, rule "
+            f"host-sync-in-hot-path)") from e

@@ -326,7 +326,7 @@ def celeba_multistep_time(device, batch: int = 128, k: int = 20,
                 state, *step_fn.invariants).compile().cost_analysis()
             # scan body counted once by the cost model == per-iteration
             flops = float(cost.get("flops", 0.0)) or None
-        except Exception:
+        except Exception:  # gan4j-lint: disable=swallowed-exception — cost model unavailable on some backends; flops=None IS the handled outcome
             pass
 
         state, losses = step_fn(state)  # compile
@@ -444,6 +444,68 @@ def checkpoint_dryrun() -> dict:
     }
 
 
+def sanitizer_dryrun(registry=None) -> dict:
+    """Runtime trace sanitizers on the MNIST fused loop (the
+    acceptance half of gan4j-lint, analysis/sanitizers.py): compile the
+    fused protocol step, warm it up, then ARM the RecompileSentinel and
+    drive further steps inside a ``no_implicit_transfers`` region.
+    ``ok`` requires ZERO post-warmup recompiles and ZERO implicit
+    transfers — the two silent ways the hot path loses its headline.
+    The fence (an explicit readback) stays OUTSIDE the guarded region:
+    a readback is a transfer by design."""
+    import jax
+
+    from gan_deeplearning4j_tpu.analysis import (
+        RecompileSentinel,
+        TransferGuardError,
+        no_implicit_transfers,
+    )
+
+    device = jax.devices()[0]
+    with jax.default_device(device):
+        step, state, real, labels, inv = _build_step_and_args(device)
+        sentinel = RecompileSentinel(registry=registry)
+        with sentinel:
+            for _ in range(2):   # warmup: the one legitimate compile
+                state, losses = step(state, real, labels, *inv)
+            _fence(losses)
+            sentinel.arm()
+            transfer_ok, transfer_error = True, None
+            try:
+                with no_implicit_transfers():
+                    for _ in range(3):
+                        state, losses = step(state, real, labels, *inv)
+            except TransferGuardError as e:
+                transfer_ok, transfer_error = False, str(e)[:200]
+            _fence(losses)
+    out = {
+        "warmup_compiles": len(sentinel.compiles),
+        "post_warmup_recompiles": len(sentinel.recompiles),
+        "transfer_ok": bool(transfer_ok),
+        # the sentinel must have SEEN the warmup compile — otherwise
+        # "zero recompiles" would also describe a dead hook
+        "ok": bool(sentinel.ok and transfer_ok
+                   and len(sentinel.compiles) >= 1),
+    }
+    if transfer_error:
+        out["transfer_error"] = transfer_error
+    return out
+
+
+def lint_dryrun() -> dict:
+    """The static gate as a bench verdict: gan4j-lint over the whole
+    installed package, default rules, EMPTY baseline — ``ok`` iff zero
+    findings (docs/STATIC_ANALYSIS.md's zero-findings contract)."""
+    from gan_deeplearning4j_tpu import analysis
+
+    res = analysis.lint_package()
+    return {"findings": len(res.findings),
+            "suppressed": len(res.suppressed),
+            "parse_errors": len(res.errors),
+            "files_checked": res.files_checked,
+            "ok": res.ok}
+
+
 def dryrun(telemetry: bool = True,
            metrics_port: Optional[int] = None) -> dict:
     """CI smoke: build and execute the fused protocol program — single
@@ -474,7 +536,14 @@ def dryrun(telemetry: bool = True,
     ``gan4j_data_*`` series must exist from the first scrape and the
     /healthz ``"data"`` block must report a budget-intact ``ok`` —
     the healthy half of the quarantine contract
-    (tests/test_resilient.py pins the failure half)."""
+    (tests/test_resilient.py pins the failure half).
+
+    gan4j-lint rides it last (PR 6): ``lint_ok`` asserts ZERO static
+    findings over the whole package with an empty baseline, and
+    ``sanitizer_ok`` asserts zero post-warmup recompiles + zero
+    implicit transfers on the fused loop (``sanitizer_dryrun``) — the
+    static and runtime halves of the same hot-path-stays-clean
+    contract, both folded into ``ok``."""
     global BATCH
     prev_batch, BATCH = BATCH, 8
     try:
@@ -537,6 +606,14 @@ def dryrun(telemetry: bool = True,
                 ckpt_ok = (ckpt["manifest_match"]
                            and ckpt["blocking_ratio"] is not None
                            and ckpt["blocking_ratio"] <= 0.25)
+                # gan4j-lint, both halves (analysis/): the static
+                # zero-findings gate and the runtime sanitizers on the
+                # fused loop — a recompile-hazard or host-sync
+                # regression is a red dryrun before it is a slow TPU run
+                with events_mod.span("bench.sanitizers"):
+                    sanitizer = sanitizer_dryrun(registry=registry)
+                with events_mod.span("bench.lint"):
+                    lint = lint_dryrun()
                 # one record through the registry feed, then a REAL
                 # scrape over the socket: the CI assertion that the
                 # exporter answers with the step/goodput/NaN series
@@ -564,7 +641,8 @@ def dryrun(telemetry: bool = True,
                     and "gan4j_nonfinite_total " in m_body
                     and "gan4j_goodput_seconds" in m_body
                     and "gan4j_watchdog_last_beat_age_seconds" in m_body
-                    and "gan4j_rollback_total " in m_body)
+                    and "gan4j_rollback_total " in m_body
+                    and "gan4j_recompiles_total " in m_body)
                 # stalled contract, healthy half: the scrape above ran
                 # against a LIVE (beating) watchdog-armed run and must
                 # say so — 200 with "stalled": false
@@ -599,7 +677,8 @@ def dryrun(telemetry: bool = True,
         return {"metric": "dcgan_mnist_img_per_sec", "dryrun": True,
                 "ok": bool(ok and math.isfinite(t) and ckpt_ok
                            and exporter_ok and events_ok
-                           and watchdog_ok and data_ok),
+                           and watchdog_ok and data_ok
+                           and lint["ok"] and sanitizer["ok"]),
                 "platform": device.platform,
                 "telemetry": telemetry,
                 "checkpoint": ckpt,
@@ -607,6 +686,10 @@ def dryrun(telemetry: bool = True,
                 "events_ok": bool(events_ok),
                 "watchdog_ok": bool(watchdog_ok),
                 "data_ok": bool(data_ok),
+                "lint_ok": bool(lint["ok"]),
+                "lint": lint,
+                "sanitizer_ok": bool(sanitizer["ok"]),
+                "sanitizer": sanitizer,
                 "watchdog_beat_us": round(beat_us, 3)}
     finally:
         BATCH = prev_batch

@@ -53,8 +53,8 @@ def _close_open() -> None:
     for ck in list(_OPEN):
         try:
             ck.close()
-        except Exception:
-            pass  # interpreter exit: never raise from the atexit hook
+        except Exception:  # gan4j-lint: disable=swallowed-exception — interpreter exit: never raise from the atexit hook
+            pass
 
 
 class AsyncCheckpointer:

@@ -85,7 +85,7 @@ def _fsync_dir(path: str) -> None:
         return  # platform without directory fds: rename is still atomic
     try:
         os.fsync(fd)
-    except OSError:
+    except OSError:  # gan4j-lint: disable=swallowed-exception — some filesystems refuse directory fsync; rename atomicity still holds
         pass
     finally:
         os.close(fd)

@@ -177,8 +177,8 @@ class RecordQuarantine:
         try:
             with open(self.path, "a") as f:
                 f.write(json.dumps(entry) + "\n")
-        except OSError:
-            pass  # provenance is diagnostics; the charge is the product
+        except OSError:  # gan4j-lint: disable=swallowed-exception — provenance is diagnostics; the charge (quarantine budget) is the product
+            pass
         from gan_deeplearning4j_tpu.telemetry import events
 
         events.instant("data.quarantine", file=file, line=line, row=row,

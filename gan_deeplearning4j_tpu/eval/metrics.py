@@ -76,8 +76,8 @@ def write_evaluation_report(res_path: str, predictions, labels,
             from gan_deeplearning4j_tpu.utils.plot_metrics import plot_losses
 
             plot_losses(metrics_jsonl, smooth=smooth)
-        except ImportError:
-            pass  # matplotlib is an optional extra
-        except ValueError:
-            pass  # e.g. a resumed-to-completion run truncates the jsonl
+        except ImportError:  # gan4j-lint: disable=swallowed-exception — matplotlib is an optional extra; the stats file above is the product
+            pass
+        except ValueError:  # gan4j-lint: disable=swallowed-exception — e.g. a resumed-to-completion run truncates the jsonl; the plot is best-effort
+            pass
     return {"test_f1": ev.f1(f1_cls) if f1_cls is not None else ev.f1()}

@@ -196,5 +196,5 @@ def host_device_count_for_testing(n: int = 8) -> None:
     )
     try:
         jax.config.update("jax_num_cpu_devices", n)
-    except Exception:
+    except Exception:  # gan4j-lint: disable=swallowed-exception — older jax lacks jax_num_cpu_devices; the XLA_FLAGS fallback above covers it
         pass

@@ -62,6 +62,10 @@ MARKER_EVENTS = {
     "alarm.divergence": ("divergence", "#c2571a"),
     "watchdog.timeout": ("watchdog timeout", "#7a1f1f"),
     "rollback.restore": ("rollback", "#8338ec"),
+    # runtime trace sanitizers (analysis/sanitizers.py): a post-warmup
+    # recompile on the step axis is a perf cliff worth SEEING next to
+    # the losses it stalled
+    "compile.recompile": ("recompile", "#b5651d"),
 }
 
 
@@ -310,7 +314,7 @@ def read_events(path: str) -> List[Dict]:
                 continue
             try:
                 out.append(json.loads(line))
-            except ValueError:
+            except ValueError:  # gan4j-lint: disable=swallowed-exception — the file may be mid-append; a torn last line is expected, not evidence
                 continue
     return out
 
@@ -382,7 +386,7 @@ def export_chrome_trace(source: Union[str, List[Dict], EventRecorder],
                     with open(sidecar) as f:
                         anchor = float(
                             json.load(f)["wall_start"]) * 1e6
-                except (OSError, ValueError, KeyError, TypeError):
+                except (OSError, ValueError, KeyError, TypeError):  # gan4j-lint: disable=swallowed-exception — missing/garbled sidecar: alignment falls back to the anchor-span path below
                     pass
                 if anchor is None:
                     for ev in events:

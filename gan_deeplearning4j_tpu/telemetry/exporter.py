@@ -31,6 +31,12 @@ Metric names (all ``gan4j_``-prefixed):
                                         data plane, data/resilient.py)
   gan4j_data_quarantined_total counter  corrupt records quarantined
   gan4j_data_last_error_age_seconds  gauge  age of the last data incident
+  gan4j_recompiles_total       counter  post-warmup XLA recompiles seen
+                                        by the RecompileSentinel
+                                        (analysis/sanitizers.py) — any
+                                        increment after warmup means
+                                        the fused hot path lost its
+                                        cached program
 """
 
 from __future__ import annotations
@@ -73,6 +79,11 @@ class MetricsRegistry:
             ("gan4j_rollback_total", ()): 0.0,
             ("gan4j_data_retries_total", ()): 0.0,
             ("gan4j_data_quarantined_total", ()): 0.0,
+            # recompile sentinel (analysis/sanitizers.py): an alert
+            # rule on this series must see it at 0 from the first
+            # scrape — a recompile storm is exactly when a scrape
+            # might not come back
+            ("gan4j_recompiles_total", ()): 0.0,
         }
         self._gauges: Dict[Tuple[str, tuple], float] = {
             # age since the last data-plane incident; 0 until one
@@ -172,7 +183,8 @@ class MetricsRegistry:
         ``"stalled": true`` while the heartbeat is quiet past the
         deadline — the liveness probe sees a hang the moment the
         watchdog does, without waiting for the process to die."""
-        self._watchdog_fn = report_fn
+        with self._lock:
+            self._watchdog_fn = report_fn
 
         def cb(reg: "MetricsRegistry") -> None:
             rep = report_fn()
@@ -199,7 +211,8 @@ class MetricsRegistry:
         carries it as the ``"data"`` block, so a run chewing through
         its quarantine budget is visible BEFORE the budget-exhaustion
         fatality."""
-        self._data_fn = report_fn
+        with self._lock:
+            self._data_fn = report_fn
 
         def cb(reg: "MetricsRegistry") -> None:
             rep = report_fn()
@@ -222,8 +235,8 @@ class MetricsRegistry:
             for fn in self._callbacks:
                 try:
                     fn(self)
-                except Exception:
-                    pass  # a broken feed must not take down the scrape
+                except Exception:  # gan4j-lint: disable=swallowed-exception — a broken feed must not take down the scrape
+                    pass
             lines: List[str] = []
             for kind, series in (("counter", self._counters),
                                  ("gauge", self._gauges)):
@@ -254,8 +267,8 @@ class MetricsRegistry:
                 rep = fn() or {}
                 stalled = bool(rep.get("stalled"))
                 beat_age = rep.get("last_beat_age_s")
-            except Exception:
-                pass  # a broken feed must not take down the probe
+            except Exception:  # gan4j-lint: disable=swallowed-exception — a broken feed must not take down the probe
+                pass
         # the data-plane block: from the live feed when one is
         # registered, else the registry's own (pre-created) counters —
         # the block is ALWAYS present, so probes can key on it
@@ -269,8 +282,8 @@ class MetricsRegistry:
                             rep.get("quarantined_total", 0)),
                         "last_error_age_s": rep.get("last_error_age_s"),
                         "ok": bool(rep.get("ok", True))}
-            except Exception:
-                pass  # a broken feed must not take down the probe
+            except Exception:  # gan4j-lint: disable=swallowed-exception — a broken feed must not take down the probe
+                pass
         with self._lock:
             if data is None:
                 data = {"retries_total": int(self._counters.get(

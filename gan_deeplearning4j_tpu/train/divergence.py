@@ -97,30 +97,37 @@ class DivergenceSentinel:
     def observe(self, rec: Dict) -> None:
         """MetricsLogger ``on_record`` hook (worker thread).  Non-finite
         values are the NaN alarm's jurisdiction and are skipped here
-        (they would also poison the medians)."""
+        (they would also poison the medians).  The history/streak tables
+        mutate under the lock — the final flush can drive this from the
+        closing thread while the worker drains, and a torn streak would
+        miss (or double-fire) a trip."""
         if self.tripped:
             return
-        for k, v in rec.items():
-            if not isinstance(v, (int, float)) or not k.endswith(
-                    _WATCH_SUFFIXES):
-                continue
-            v = float(v)
-            if not math.isfinite(v):
-                continue
-            hist = self._hist.get(k)
-            if hist is None:
-                hist = self._hist[k] = deque(maxlen=self.window)
-                self._streak[k] = 0
-            if len(hist) >= self.min_history:
-                baseline = max(self._median_abs(hist), self.floor)
-                if abs(v) > self.factor * baseline:
-                    self._streak[k] += 1
-                    if self._streak[k] >= self.patience:
-                        self._trip(rec, k, v, baseline)
-                        return
-                else:
+        trip = None
+        with self._lock:
+            for k, v in rec.items():
+                if not isinstance(v, (int, float)) or not k.endswith(
+                        _WATCH_SUFFIXES):
+                    continue
+                v = float(v)
+                if not math.isfinite(v):
+                    continue
+                hist = self._hist.get(k)
+                if hist is None:
+                    hist = self._hist[k] = deque(maxlen=self.window)
                     self._streak[k] = 0
-            hist.append(v)
+                if len(hist) >= self.min_history:
+                    baseline = max(self._median_abs(hist), self.floor)
+                    if abs(v) > self.factor * baseline:
+                        self._streak[k] += 1
+                        if self._streak[k] >= self.patience:
+                            trip = (rec, k, v, baseline)
+                            break
+                    else:
+                        self._streak[k] = 0
+                hist.append(v)
+        if trip is not None:
+            self._trip(*trip)
 
     def _trip(self, rec: Dict, key: str, value: float,
               baseline: float) -> None:

@@ -233,6 +233,16 @@ class GANTrainerConfig:
     # duration of train() (telemetry/exporter.py).  None = off; 0 = an
     # ephemeral port (resolved port on ``trainer.metrics_port``).
     metrics_port: Optional[int] = None
+    # Runtime trace sanitizers (analysis/sanitizers.py): arm a
+    # RecompileSentinel over the run (any XLA compile after the first
+    # steady-state fence = gan4j_recompiles_total + a compile.recompile
+    # event + a loud warning) and wrap the fused hot-loop dispatches in
+    # a transfer guard (an implicit host<->device transfer raises
+    # TransferGuardError).  Observational about recompiles, strict
+    # about transfers; the hook costs nothing at steady state (it fires
+    # per COMPILE, not per step).  bench --dryrun and the pytest
+    # fixtures run the STRICT version of both.
+    sanitize: bool = False
 
 
 class Workload:
@@ -390,8 +400,8 @@ def train_with_recovery(make_trainer: Callable[[bool], "GANTrainer"],
                     recorder.dump_flight_record(
                         trainer.c.res_path, "training_failure",
                         extra={"step": step, "error": repr(e)})
-                except Exception:
-                    pass  # the dump must never mask the failure
+                except Exception:  # gan4j-lint: disable=swallowed-exception — the flight-record dump must never mask the failure being dumped
+                    pass
             if last_failure_step is not None and step > last_failure_step:
                 attempt = 0  # progress since the last failure: reset budget
             last_failure_step = step
@@ -424,7 +434,7 @@ def train_with_recovery(make_trainer: Callable[[bool], "GANTrainer"],
                             "recovery.restart", step=step,
                             attempt=attempt,
                             backoff_s=round(delay, 3), error=repr(e))
-                except Exception:
+                except Exception:  # gan4j-lint: disable=swallowed-exception — never-mask discipline (see below)
                     # same never-mask discipline as the flight-record
                     # dump above: ANY recorder failure (unwritable res
                     # dir is OSError, but a concurrently-removed dir
@@ -470,6 +480,15 @@ def add_health_args(parser) -> None:
         "--watchdog-deadline", type=float, default=None, metavar="SEC",
         help="fixed watchdog deadline in seconds (default: auto-scale "
              "from the measured steady-state step time)")
+    parser.add_argument(
+        "--sanitize", action="store_true",
+        help="arm the runtime trace sanitizers "
+             "(analysis/sanitizers.py): any post-warmup XLA recompile "
+             "is counted (gan4j_recompiles_total), traced "
+             "(compile.recompile) and warned about, and the fused "
+             "hot-loop dispatches run under a transfer guard that "
+             "raises on implicit host<->device transfers — the runtime "
+             "half of gan4j-lint (docs/STATIC_ANALYSIS.md)")
 
 
 def add_data_args(parser) -> None:
@@ -509,6 +528,7 @@ def health_config_kwargs(args) -> Dict:
         rollback_lr_factor=args.rollback_lr_factor,
         watchdog=args.watchdog,
         watchdog_deadline_s=args.watchdog_deadline,
+        sanitize=args.sanitize,
     )
 
 
@@ -726,6 +746,11 @@ class GANTrainer:
         self._rollback_pending: Optional[tuple] = None
         self._resume_max_step: Optional[int] = None
         self._watchdog = None
+        # runtime trace sanitizers (analysis/sanitizers.py), armed by
+        # config.sanitize: a RecompileSentinel for the whole run (armed
+        # post-warmup at the first steady fence) and a transfer guard
+        # around the fused dispatches
+        self._sanitizer = None
         # scrape registry (telemetry/exporter.py): fed from every
         # materialized metrics record (on the logger's worker thread)
         # and, at scrape time, from the live goodput ledger; served
@@ -1236,6 +1261,27 @@ class GANTrainer:
                     res_path=c.res_path)
                 self._watchdog.start()
                 self.registry.observe_watchdog(self._watchdog.report)
+            if c.sanitize:
+                # armed AFTER the recorder install (compile.recompile
+                # events must land in this run's timeline); the sentinel
+                # itself is passive until _mark_steady arms it past the
+                # legitimate first-compile window
+                import logging as _logging
+
+                from gan_deeplearning4j_tpu.analysis.sanitizers import (
+                    RecompileSentinel,
+                )
+
+                self._sanitizer = RecompileSentinel(
+                    registry=self.registry,
+                    step_fn=lambda: self.batch_counter,
+                    on_recompile=lambda name: _logging.getLogger(
+                        __name__).warning(
+                        "sanitizer: post-warmup XLA recompile of %r at "
+                        "step %d — the hot path lost its cached program "
+                        "(see docs/STATIC_ANALYSIS.md)",
+                        name, self.batch_counter))
+                self._sanitizer.start()
             if c.metrics_port is not None:
                 from gan_deeplearning4j_tpu.telemetry import serve_exporter
 
@@ -1251,6 +1297,9 @@ class GANTrainer:
                 # teardown below runs (stop() joins the poll thread)
                 self._watchdog.stop()
                 self._watchdog = None
+            if self._sanitizer is not None:
+                self._sanitizer.stop()
+                self._sanitizer = None
             if stop_exporter is not None:
                 stop_exporter()
             if prev_recorder is not None:
@@ -1412,10 +1461,17 @@ class GANTrainer:
                         codec_chunk_decode=(multi_codec is not None
                                             and not resident),
                         chunk_indexed=self._stream_dedup, **kw)
-            # loop-invariant step arguments, device-resident once
-            self._fused_invariants = (
-                self._z_base, self._fused_rng,
-                ones + self.soften_real, self.soften_fake, ones)
+            # loop-invariant step arguments, device-resident once —
+            # COMMITTED like the state below: under a mesh, uncommitted
+            # single-device invariants (the key, the soften vectors)
+            # would be re-broadcast device-to-device on EVERY dispatch
+            # (found by the --sanitize transfer guard; tiny arrays, but
+            # a per-dispatch transfer on the hot path all the same)
+            self._fused_invariants = jax.device_put(
+                (self._z_base, self._fused_rng,
+                 ones + self.soften_real, self.soften_fake, ones),
+                mesh_lib.replicated(self._mesh) if self._mesh is not None
+                else jax.sharding.SingleDeviceSharding(jax.devices()[0]))
             fused_state = self._fused_lib.state_from_graphs(
                 self.dis, self.gen, self.gan, self.classifier,
                 start_step=self.batch_counter, ema=c.ema_decay > 0)
@@ -1748,6 +1804,31 @@ class GANTrainer:
             return state, losses, tel
         return state, rest, None
 
+    def _dispatch_guard(self):
+        """Sanitizer context for a fused hot-loop dispatch
+        (config.sanitize).  Always a sentinel WATCH region — compiles
+        landing outside the watched dispatches (the first eval-cadence
+        inference program, a reader) are recorded as benign, so only
+        the hot path's own cache promise is enforced.  Plus
+        jax.transfer_guard("disallow") once the steady window has
+        begun — the warmup dispatch stays unguarded (compile-time
+        constant staging may legitimately transfer); everything the
+        steady loop dispatches is device-resident by construction, so
+        any implicit transfer there is a regression."""
+        from contextlib import ExitStack, nullcontext
+
+        if self._sanitizer is None:
+            return nullcontext()
+        stack = ExitStack()
+        stack.enter_context(self._sanitizer.watch())
+        if self._steady_t0 is not None:
+            from gan_deeplearning4j_tpu.analysis.sanitizers import (
+                no_implicit_transfers,
+            )
+
+            stack.enter_context(no_implicit_transfers())
+        return stack
+
     def _phase(self, name: str):
         """Goodput phase context, or a no-op outside train() (tests and
         notebooks may drive the dump/bookkeeping methods directly).
@@ -1788,7 +1869,8 @@ class GANTrainer:
                 # (on a tunneled link) dominates no matter how large K is
                 with self._phase("dispatch"), \
                         events.span("train.chunk",
-                                    step=self.batch_counter, n=run):
+                                    step=self.batch_counter, n=run), \
+                        self._dispatch_guard():
                     out = self._fused_multi(
                         fused_state, features, labels,
                         *self._fused_invariants)
@@ -1800,7 +1882,7 @@ class GANTrainer:
             else:
                 per_step = []
                 for _ in range(run):
-                    with self._phase("dispatch"):
+                    with self._phase("dispatch"), self._dispatch_guard():
                         out = self._fused_step(
                             fused_state, features, labels,
                             *self._fused_invariants)
@@ -1842,7 +1924,8 @@ class GANTrainer:
                 break
             with self._phase("dispatch"), \
                     events.span("train.chunk", step=self.batch_counter,
-                                n=run):
+                                n=run), \
+                    self._dispatch_guard():
                 out = self._fused_multi(
                     fused_state, *chunk, *self._fused_invariants)
             fused_state, (d, g, cl), tel = self._unpack(out)
@@ -1868,6 +1951,10 @@ class GANTrainer:
                 device_fence(loss)
             self._steady_t0 = time.perf_counter()
             self._steady_start_step = self.batch_counter + steps
+            if self._sanitizer is not None:
+                # the compile-paying first step/chunk just fenced: every
+                # compile from here on is a recompile
+                self._sanitizer.arm()
 
     def _train_loop(self, prefetch, iter_test, fused_state, ones, y_dis,
                     log) -> None:
@@ -1890,7 +1977,7 @@ class GANTrainer:
                 # the whole iteration — D-step, syncs, G-step, classifier,
                 # latent draws, step-counter bump — is one donated-state
                 # XLA program; the only per-step host work is this dispatch
-                with self._phase("dispatch"):
+                with self._phase("dispatch"), self._dispatch_guard():
                     out = self._fused_step(
                         fused_state, real, labels, *self._fused_invariants)
                 fused_state, (d_loss, g_loss, c_loss), tel = \

@@ -181,8 +181,8 @@ class PreemptionGuard:
         for s, prev in self._prev.items():
             try:
                 signal.signal(s, prev)
-            except (ValueError, TypeError, OSError):
-                pass  # interpreter teardown / non-main thread
+            except (ValueError, TypeError, OSError):  # gan4j-lint: disable=swallowed-exception — interpreter teardown / non-main thread: handlers are already gone
+                pass
         self._prev.clear()
 
     def __enter__(self) -> "PreemptionGuard":

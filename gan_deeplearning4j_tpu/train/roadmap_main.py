@@ -31,6 +31,7 @@ from gan_deeplearning4j_tpu.train.gan_pair import GANPair
 from gan_deeplearning4j_tpu.utils import (
     MetricsLogger,
     device_fence,
+    overlap_device_get,
     start_host_copy,
 )
 from gan_deeplearning4j_tpu.utils.async_dump import AsyncArtifactWriter
@@ -395,8 +396,11 @@ def _train_impl(family, iterations, batch_size, res_path, n_train,
             it += K
             d_loss, g_loss = dl[-1], gl[-1]
             if it % 100 == 0:
-                log(f"[{family}] iteration {it}: d={float(d_loss):.4f} "
-                    f"g={float(g_loss):.4f}")
+                # print-cadence readback: overlapped (one tunnel round
+                # trip for both scalars), never per-iteration
+                d_host, g_host = overlap_device_get((d_loss, g_loss))
+                log(f"[{family}] iteration {it}: d={d_host:.4f} "
+                    f"g={g_host:.4f}")
             if it % print_every == 0 or it >= iterations:
                 pair.adopt_state(state)
                 with goodput.phase("eval"), \

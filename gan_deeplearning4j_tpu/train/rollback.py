@@ -178,8 +178,8 @@ def _scaled_updater(up, scale: float):
         return None
     try:
         return dataclasses.replace(up, learning_rate=lr * scale)
-    except (TypeError, ValueError):
-        pass  # not a dataclass, or learning_rate not an init field
+    except (TypeError, ValueError):  # gan4j-lint: disable=swallowed-exception — not a dataclass, or learning_rate not an init field: the mutable-updater path below handles it
+        pass
     up.learning_rate = lr * scale  # mutable custom updater
     return up
 

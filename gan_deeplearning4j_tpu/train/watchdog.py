@@ -242,22 +242,25 @@ class HeartbeatWatchdog:
         """Arm over ``thread`` (default: the calling thread) and start
         the poll loop.  The first beat is implicit — the warmup clock
         starts now, not at the first explicit beat."""
-        self._monitored_thread = thread or threading.current_thread()
-        self._monitored_ident = self._monitored_thread.ident
         with self._lock:
+            self._monitored_thread = thread or threading.current_thread()
+            self._monitored_ident = self._monitored_thread.ident
             self._last_beat = time.perf_counter()
-        self._thread = threading.Thread(
-            target=self._poll_loop, name="gan4j-watchdog", daemon=True)
-        self._thread.start()
+            self._thread = threading.Thread(
+                target=self._poll_loop, name="gan4j-watchdog", daemon=True)
+            poll_thread = self._thread
+        poll_thread.start()
         return self
 
     def stop(self) -> None:
         """Disarm; no raise is attempted after this returns (the poll
         loop checks the flag immediately before every raise)."""
         self._stop.set()
-        if self._thread is not None:
-            self._thread.join(timeout=self.poll_s * 8 + 1.0)
-            self._thread = None
+        with self._lock:
+            poll_thread, self._thread = self._thread, None
+        if poll_thread is not None:
+            # join OUTSIDE the lock: the poll loop takes it every cycle
+            poll_thread.join(timeout=self.poll_s * 8 + 1.0)
 
     def __enter__(self) -> "HeartbeatWatchdog":
         return self.start()
@@ -290,8 +293,9 @@ class HeartbeatWatchdog:
               region: Optional[str], step: Optional[int]) -> None:
         from gan_deeplearning4j_tpu.telemetry import events
 
-        self.fired = True
-        self.timeouts += 1
+        with self._lock:
+            self.fired = True
+            self.timeouts += 1
         _log.error(
             "watchdog: no heartbeat for %.1fs (deadline %.1fs, region "
             "%s, step %s) — dumping flight record and raising "
@@ -307,8 +311,8 @@ class HeartbeatWatchdog:
                     extra={"step": step, "region": region,
                            "age_s": round(age, 3),
                            "deadline_s": round(deadline, 3)})
-        except Exception:
-            pass  # diagnostics must never block the raise
+        except Exception:  # gan4j-lint: disable=swallowed-exception — diagnostics must never block the raise
+            pass
         if self.on_timeout is not None:
             # sacrificial thread: if the DEVICE is what hung, the
             # emergency save hangs on it too — bound it and move on

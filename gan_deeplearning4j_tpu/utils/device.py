@@ -45,8 +45,8 @@ def start_host_copy(tree: Any) -> Any:
         if hasattr(a, "copy_to_host_async"):
             try:
                 a.copy_to_host_async()
-            except Exception:
-                pass  # the eventual synchronous read still works
+            except Exception:  # gan4j-lint: disable=swallowed-exception — async copy is an overlap optimization; the eventual synchronous read still works
+                pass
     return tree
 
 

@@ -207,8 +207,8 @@ class _TailCache:
                     continue
                 try:
                     rec = json.loads(line)
-                except ValueError:
-                    continue  # malformed line: skip
+                except ValueError:  # gan4j-lint: disable=swallowed-exception — tailing a live file: a torn/malformed line is expected, not evidence
+                    continue
                 if "step" not in rec:
                     # step-less run-level records (the goodput summary)
                     # have no x coordinate on a step chart

@@ -33,8 +33,8 @@ def _flush_open_loggers() -> None:
     for logger in list(_OPEN_LOGGERS):
         try:
             logger.close()
-        except Exception:
-            pass  # interpreter exit: never raise from the atexit hook
+        except Exception:  # gan4j-lint: disable=swallowed-exception — interpreter exit: never raise from the atexit hook
+            pass
 
 
 class MetricsLogger:
@@ -242,7 +242,7 @@ class MetricsLogger:
             # error (e.g. the readback of a poisoned loss) mask ``exc``
             try:
                 self.close()
-            except Exception:
+            except Exception:  # gan4j-lint: disable=swallowed-exception — a flush error (e.g. readback of a poisoned loss) must not mask exc
                 pass
 
     def records(self) -> List[Dict]:

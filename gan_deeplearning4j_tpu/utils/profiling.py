@@ -51,8 +51,8 @@ def maybe_trace(trace_dir: Optional[str]):
     try:
         with open(os.path.join(trace_dir, "host_anchor.json"), "w") as f:
             json.dump({"wall_start": time.time()}, f)
-    except OSError:
-        pass  # alignment degrades to best-effort; the capture still runs
+    except OSError:  # gan4j-lint: disable=swallowed-exception — alignment degrades to best-effort; the capture still runs
+        pass
     with events.span("profiler.trace", trace_dir=trace_dir):
         with jax.profiler.trace(trace_dir):
             yield

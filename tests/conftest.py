@@ -32,3 +32,33 @@ def cpu_devices():
     devs = jax.devices()
     assert len(devs) >= 8, f"expected 8 virtual CPU devices, got {len(devs)}"
     return devs
+
+
+@pytest.fixture
+def recompile_sentinel():
+    """Runtime recompile sanitizer (analysis/sanitizers.py) for perf-
+    sensitive tests: warm your jitted function up, call
+    ``sentinel.arm()``, run the steady phase — the fixture FAILS the
+    test at teardown if any compile landed after arming.  (Tests that
+    expect a recompile should assert on ``sentinel.recompiles``
+    themselves and ``sentinel.recompiles.clear()`` before teardown.)"""
+    from gan_deeplearning4j_tpu.analysis.sanitizers import RecompileSentinel
+
+    with RecompileSentinel() as sentinel:
+        yield sentinel
+        sentinel.check()  # raises RecompileError -> the test fails
+
+
+@pytest.fixture
+def transfer_guard():
+    """Transfer sanitizer: the whole test body runs under
+    ``jax.transfer_guard("disallow")`` — any implicit host<->device
+    transfer raises TransferGuardError at the offending op.  Stage
+    inputs with an explicit ``jax.device_put`` (allowed) and keep
+    readbacks out of the guarded assertions."""
+    from gan_deeplearning4j_tpu.analysis.sanitizers import (
+        no_implicit_transfers,
+    )
+
+    with no_implicit_transfers():
+        yield
