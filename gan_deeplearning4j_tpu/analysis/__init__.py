@@ -9,8 +9,17 @@ can only pattern-match: zero post-warmup recompiles
 (``RecompileSentinel``) and zero implicit transfers
 (``no_implicit_transfers``) in the fused hot loop.
 
+The PROGRAM half (program.py + contracts.py, the ``gan4j-prove``
+console entry in prove_cli.py) verifies a layer neither can see: the
+lowered jaxpr/HLO itself.  Each jitted entry point — fused single
+step, fused multi/scan, sharded SPMD step, pair multistep, serving
+inference — is lowered on abstract inputs and checked against a
+versioned JSON contract (``analysis/contracts/``): donation aliasing,
+dtype discipline, collective budgets, peak-HBM ceilings and
+compile-bucket coverage, enforced as a second zero-violations CI gate.
+
 docs/STATIC_ANALYSIS.md is the operator manual: rule catalogue,
-suppression/baseline semantics, sanitizer wiring.
+suppression/baseline semantics, sanitizer wiring, program contracts.
 """
 
 from gan_deeplearning4j_tpu.analysis.engine import (  # noqa: F401
@@ -32,3 +41,8 @@ from gan_deeplearning4j_tpu.analysis.sanitizers import (  # noqa: F401
     TransferGuardError,
     no_implicit_transfers,
 )
+
+# gan4j-prove (program.py/contracts.py) is imported lazily by its
+# consumers — pulling the entry-point registry in here would make every
+# ``import gan_deeplearning4j_tpu.analysis`` pay for bench/model
+# imports the lint/sanitizer half never needs.

@@ -14,8 +14,9 @@ from __future__ import annotations
 
 import argparse
 import os
+import subprocess
 import sys
-from typing import Optional
+from typing import List, Optional
 
 from gan_deeplearning4j_tpu.analysis import baseline as baseline_mod
 from gan_deeplearning4j_tpu.analysis import reporters
@@ -46,6 +47,16 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--write-baseline", action="store_true",
                    help="freeze the current active findings into "
                         "--baseline and exit 0 (adoption mode)")
+    p.add_argument("--changed", default=None, metavar="GIT_REF",
+                   help="lint only the .py files changed vs this git "
+                        "ref (tracked diffs + untracked files), "
+                        "restricted to the given paths — the fast "
+                        "pre-commit mode; zero changed files is a "
+                        "clean pass")
+    p.add_argument("--warn-unused-suppressions", action="store_true",
+                   help="also flag disable= directives whose rule no "
+                        "longer fires on their line (stale-suppression "
+                        "audit; findings gate like any other)")
     p.add_argument("--rules", default=None, metavar="LIST",
                    help="comma-separated rule names to run "
                         "(default: all)")
@@ -59,6 +70,49 @@ def build_parser() -> argparse.ArgumentParser:
     return p
 
 
+def changed_py_files(ref: str, scope_paths: List[str]) -> List[str]:
+    """The ``.py`` files changed vs ``ref`` (tracked diff + untracked),
+    restricted to ``scope_paths``.  Raises ValueError when git cannot
+    answer (not a repo, unknown ref) — a usage error upstream."""
+    anchor = scope_paths[0]
+    anchor_dir = (anchor if os.path.isdir(anchor)
+                  else os.path.dirname(os.path.abspath(anchor)) or ".")
+
+    def git(*cmd):
+        return subprocess.run(["git", "-C", anchor_dir, *cmd],
+                              capture_output=True, text=True)
+
+    top = git("rev-parse", "--show-toplevel")
+    if top.returncode != 0:
+        raise ValueError(f"--changed: {anchor_dir} is not inside a git "
+                         f"repository")
+    root = top.stdout.strip()
+    diff = git("diff", "--name-only", ref, "--")
+    if diff.returncode != 0:
+        raise ValueError(f"--changed: git diff vs {ref!r} failed: "
+                         f"{diff.stderr.strip()}")
+    # ls-files prints paths relative to (and only under) its cwd —
+    # run it from the repo ROOT so they join like the diff's
+    # root-relative names even when the scope anchor is a subdirectory
+    untracked = subprocess.run(
+        ["git", "-C", root, "ls-files", "--others",
+         "--exclude-standard"], capture_output=True, text=True)
+    names = set(diff.stdout.splitlines())
+    if untracked.returncode == 0:
+        names |= set(untracked.stdout.splitlines())
+    scope = [os.path.abspath(p) for p in scope_paths]
+    out = []
+    for name in sorted(names):
+        if not name.endswith(".py"):
+            continue
+        path = os.path.abspath(os.path.join(root, name))
+        if not os.path.exists(path):
+            continue  # deleted vs ref: nothing to lint
+        if any(path == s or path.startswith(s + os.sep) for s in scope):
+            out.append(path)
+    return out
+
+
 def main(argv: Optional[list] = None) -> int:
     parser = build_parser()
     args = parser.parse_args(argv)
@@ -69,6 +123,9 @@ def main(argv: Optional[list] = None) -> int:
         return 0
     if args.write_baseline and not args.baseline:
         parser.error("--write-baseline requires --baseline FILE")
+    if args.write_baseline and args.changed:
+        parser.error("--write-baseline over a --changed subset would "
+                     "freeze a partial baseline")
 
     paths = args.paths or [package_root()]
     # a gate that lints nothing must not answer green: a typo'd path
@@ -78,6 +135,18 @@ def main(argv: Optional[list] = None) -> int:
         print(f"gan4j-lint: error: no such path(s): "
               f"{', '.join(missing)}", file=sys.stderr)
         return 2
+    if args.changed is not None:
+        try:
+            paths = changed_py_files(args.changed, paths)
+        except ValueError as e:
+            print(f"gan4j-lint: error: {e}", file=sys.stderr)
+            return 2
+        if not paths:
+            # unlike a typo'd path, an empty diff is a REAL verdict:
+            # nothing in scope changed, so there is nothing to gate
+            print(f"gan4j-lint: no changed .py files vs "
+                  f"{args.changed} — clean")
+            return 0
     rules = ([r.strip() for r in args.rules.split(",") if r.strip()]
              if args.rules else None)
     disable = [r.strip() for r in args.disable.split(",") if r.strip()]
@@ -86,8 +155,10 @@ def main(argv: Optional[list] = None) -> int:
         fingerprints = (baseline_mod.load(args.baseline)
                         if args.baseline and not args.write_baseline
                         else set())
-        result = lint_paths(paths, rules=rules, disable=disable,
-                            baseline_fingerprints=fingerprints)
+        result = lint_paths(
+            paths, rules=rules, disable=disable,
+            baseline_fingerprints=fingerprints,
+            audit_suppressions=args.warn_unused_suppressions)
     except ValueError as e:
         print(f"gan4j-lint: error: {e}", file=sys.stderr)
         return 2

@@ -1,0 +1,516 @@
+"""gan4j-prove contracts: versioned, human-diffable JSON invariants per
+jitted entry point, checked against the ACTUAL lowering (program.py).
+
+One file per entry point under ``analysis/contracts/<entry>.json``:
+
+```json
+{
+ "version": 1,
+ "entry_point": "fused_single",
+ "donation": {"declared_leaves": 129, "aliased_leaves": 107,
+              "exemption": null},
+ "dtypes": {"allowed": ["f32", "i1", "i32", "i64", "ui32"]},
+ "collectives": {"all-reduce": 0},
+ "peak_hbm": {"bytes_ceiling": 220200960, "measured": 146566916,
+              "source": "memory_analysis"},
+ "buckets": {"mode": "exact", "declared": [8, 50, 200, 1600]}
+}
+```
+
+Five contract classes, each a distinct silent-failure mode:
+
+* ``donation`` — the compiled ``input_output_alias`` must carry exactly
+  the contracted number of aliased parameters.  A donation dropped by
+  jit or XLA doubles the state's HBM footprint without changing a
+  single loss value.  The scan-path exemption (donation + scan crashes
+  the axon TPU runtime) is an explicit ``exemption`` entry — the
+  contract then asserts aliasing is ABSENT, proving the builder really
+  dropped the flag, instead of a comment hoping it did.
+* ``dtype`` — every element type in the stablehlo must be in the
+  allowed set; f64 (or any unintended widening) fails before it ships.
+* ``collectives`` — static per-step collective-op counts must match
+  exactly; an accidental extra all-reduce per step can never land
+  silently.
+* ``peak-hbm`` — the compile's memory analysis must stay under the
+  contracted byte ceiling (written with 1.5x headroom for compiler
+  drift; a real regression blows well past it).
+* ``buckets`` — every batch shape reachable from the bench/serving
+  configs must map to a declared compile bucket ("exact" membership for
+  training shapes, "round-up" coverage for serving requests), making
+  recompile-per-request-shape statically impossible.
+
+Adoption follows gan4j-lint's baseline semantics: ``gan4j-prove
+--write-contracts`` freezes today's facts; the gate then fails only on
+drift, and every intentional change is a reviewable contract diff.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+from typing import Dict, List, Optional, Sequence
+
+from gan_deeplearning4j_tpu.analysis import program as program_mod
+from gan_deeplearning4j_tpu.analysis.program import EntryPoint, ProgramFacts
+
+CONTRACT_VERSION = 1
+# headroom multiplier applied at --write-contracts time: absorbs
+# XLA-version scratch-size drift without masking a real 2x regression
+HBM_CEILING_HEADROOM = 1.5
+
+CONTRACT_CLASSES = ("donation", "dtype", "collectives", "peak-hbm",
+                    "buckets")
+
+
+@dataclasses.dataclass
+class Violation:
+    """One broken contract at one entry point.  ``contract_class`` is
+    the failing check family; ``field`` names the exact contract field
+    so the report points at the line to re-review, not just the file."""
+
+    entry: str
+    contract_class: str
+    field: str
+    message: str
+
+    def to_dict(self) -> Dict:
+        return dataclasses.asdict(self)
+
+
+def contracts_dir() -> str:
+    """The committed contract files' home: ``analysis/contracts/``
+    inside the installed package (shipped as package data)."""
+    return os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "contracts")
+
+
+def contract_path(directory: str, entry: str) -> str:
+    return os.path.join(directory, f"{entry}.json")
+
+
+def load_contract(directory: str, entry: str) -> Optional[Dict]:
+    """The contract document for ``entry``, or None when the file does
+    not exist (reported as a violation by ``check_entry`` — an
+    uncontracted entry point is a hole in the gate, not a pass)."""
+    path = contract_path(directory, entry)
+    if not os.path.exists(path):
+        return None
+    with open(path) as f:
+        doc = json.load(f)
+    if doc.get("version") != CONTRACT_VERSION:
+        raise ValueError(
+            f"contract {path} has version {doc.get('version')!r}, "
+            f"expected {CONTRACT_VERSION} — regenerate with "
+            f"--write-contracts")
+    return doc
+
+
+def build_contract(entry: EntryPoint, facts: List[ProgramFacts]) -> Dict:
+    """Compose the contract document from measured facts (the
+    --write-contracts adoption path)."""
+    dtypes = sorted({d for f in facts for d in f.dtypes})
+    collectives: Dict[str, int] = {}
+    for f in facts:
+        for k, v in f.collectives.items():
+            collectives[k] = max(collectives.get(k, 0), v)
+    peak = max(f.peak_bytes for f in facts)
+    doc: Dict = {
+        "version": CONTRACT_VERSION,
+        "entry_point": entry.name,
+        "summary": entry.summary,
+        "mesh_shape": facts[0].mesh_shape,
+        "variants": [f.variant for f in facts],
+        "donation": {
+            "declared_leaves": facts[0].declared_donated_leaves,
+            "aliased_leaves": len(facts[0].aliased_params),
+            "exemption": entry.exemption,
+        },
+        "dtypes": {"allowed": dtypes},
+        "collectives": collectives,
+        "peak_hbm": {
+            "bytes_ceiling": int(peak * HBM_CEILING_HEADROOM),
+            "measured": int(peak),
+            "source": facts[0].memory_source,
+        },
+    }
+    if entry.bucket_spec is not None:
+        spec = entry.bucket_spec()
+        doc["buckets"] = {
+            "mode": spec["mode"],
+            "declared": list(spec["code_declared"]),
+        }
+        if "max_request" in spec:
+            doc["buckets"]["max_request"] = spec["max_request"]
+    return doc
+
+
+def write_contract(directory: str, entry: EntryPoint,
+                   facts: List[ProgramFacts]) -> str:
+    os.makedirs(directory, exist_ok=True)
+    path = contract_path(directory, entry.name)
+    with open(path, "w") as f:
+        json.dump(build_contract(entry, facts), f, indent=1,
+                  sort_keys=True)
+        f.write("\n")
+    return path
+
+
+# -- the five checks ----------------------------------------------------------
+
+
+def _check_donation(entry: str, contract: Dict,
+                    facts: List[ProgramFacts]) -> List[Violation]:
+    out: List[Violation] = []
+    spec = contract.get("donation", {})
+    f = facts[0]
+    exemption = spec.get("exemption")
+    if exemption:
+        # the exemption asserts donation is genuinely OFF in the
+        # artifact — if aliasing appears, the builder stopped dropping
+        # the flag and the contract (not a comment) must be updated
+        if f.aliased_params:
+            out.append(Violation(
+                entry, "donation", "donation.exemption",
+                f"{entry}: contract exempts donation "
+                f"({exemption.get('id')}) but the compiled program "
+                f"aliases {len(f.aliased_params)} parameter(s) — the "
+                f"builder no longer drops the flag; update the "
+                f"contract entry if this is intentional"))
+        return out
+    declared = spec.get("declared_leaves", 0)
+    expected = spec.get("aliased_leaves", 0)
+    if f.declared_donated_leaves != declared:
+        out.append(Violation(
+            entry, "donation", "donation.declared_leaves",
+            f"{entry}: contract declares {declared} donated leaves, "
+            f"entry point donates {f.declared_donated_leaves} — the "
+            f"donated-state pytree changed; re-run --write-contracts "
+            f"and review the diff"))
+    if len(f.aliased_params) != expected:
+        out.append(Violation(
+            entry, "donation", "donation.aliased_leaves",
+            f"{entry}: contract expects {expected} input->output "
+            f"aliases in the compiled program, found "
+            f"{len(f.aliased_params)} — a dropped donation doubles "
+            f"the state's HBM footprint"))
+    return out
+
+
+def _check_dtypes(entry: str, contract: Dict,
+                  facts: List[ProgramFacts]) -> List[Violation]:
+    allowed = set(contract.get("dtypes", {}).get("allowed", []))
+    seen = {d for f in facts for d in f.dtypes}
+    extra = sorted(seen - allowed)
+    if extra:
+        return [Violation(
+            entry, "dtype", "dtypes.allowed",
+            f"{entry}: stablehlo contains dtype(s) outside the "
+            f"contract: {', '.join(extra)} (allowed: "
+            f"{', '.join(sorted(allowed))}) — an unintended widening "
+            f"multiplies HBM traffic and disables the MXU fast path")]
+    return []
+
+
+def _check_collectives(entry: str, contract: Dict,
+                       facts: List[ProgramFacts]) -> List[Violation]:
+    out: List[Violation] = []
+    expected: Dict[str, int] = dict(contract.get("collectives", {}))
+    seen: Dict[str, int] = {}
+    for f in facts:
+        for k, v in f.collectives.items():
+            seen[k] = max(seen.get(k, 0), v)
+    for op in sorted(set(expected) | set(seen)):
+        if seen.get(op, 0) != expected.get(op, 0):
+            out.append(Violation(
+                entry, "collectives", f"collectives.{op}",
+                f"{entry}: contract budgets {expected.get(op, 0)} "
+                f"{op} op(s) per step, lowering contains "
+                f"{seen.get(op, 0)} — an unbudgeted sync per step is "
+                f"invisible in losses and fatal to step time"))
+    return out
+
+
+def _check_peak_hbm(entry: str, contract: Dict,
+                    facts: List[ProgramFacts]) -> List[Violation]:
+    ceiling = contract.get("peak_hbm", {}).get("bytes_ceiling")
+    if ceiling is None:
+        return [Violation(entry, "peak-hbm", "peak_hbm.bytes_ceiling",
+                          f"{entry}: contract has no byte ceiling")]
+    worst = max(facts, key=lambda f: f.peak_bytes)
+    if worst.peak_bytes > ceiling:
+        return [Violation(
+            entry, "peak-hbm", "peak_hbm.bytes_ceiling",
+            f"{entry}: peak program memory {worst.peak_bytes} B "
+            f"(variant {worst.variant}, {worst.memory_source}) exceeds "
+            f"the contract ceiling {ceiling} B")]
+    return []
+
+
+def _check_buckets(entry: str, contract: Dict,
+                   facts: List[ProgramFacts],
+                   spec: Optional[Dict]) -> List[Violation]:
+    block = contract.get("buckets")
+    if block is None and spec is None:
+        return []
+    if block is None or spec is None:
+        side = "contract" if block is None else "entry point"
+        return [Violation(
+            entry, "buckets", "buckets",
+            f"{entry}: bucket contract and code disagree on whether "
+            f"the entry has one (missing on the {side} side)")]
+    out: List[Violation] = []
+    declared = sorted(block.get("declared", []))
+    code_declared = sorted(spec.get("code_declared", []))
+    if declared != code_declared:
+        out.append(Violation(
+            entry, "buckets", "buckets.declared",
+            f"{entry}: contract declares buckets {declared}, code "
+            f"declares {code_declared} — every bucket change must be "
+            f"a contract diff"))
+    if block.get("mode") == "round-up":
+        max_request = block.get("max_request", 0)
+        top = declared[-1] if declared else 0
+        if max_request > top:
+            out.append(Violation(
+                entry, "buckets", "buckets.max_request",
+                f"{entry}: max_request {max_request} exceeds the "
+                f"largest declared bucket {top} — requests above it "
+                f"have no compile bucket to round up into"))
+        # lowered variants must cover the declared set exactly: the
+        # bucket list IS the complete set of dispatchable shapes
+        lowered = sorted(f.batch for f in facts)
+        if lowered != declared:
+            out.append(Violation(
+                entry, "buckets", "buckets.declared",
+                f"{entry}: lowered variants cover shapes {lowered} "
+                f"but the contract declares {declared}"))
+    else:
+        reachable = sorted(spec.get("reachable", []))
+        missing = [b for b in reachable if b not in declared]
+        if missing:
+            out.append(Violation(
+                entry, "buckets", "buckets.declared",
+                f"{entry}: reachable batch shape(s) "
+                f"{missing} map to no declared compile bucket "
+                f"(declared: {declared}) — each would recompile at "
+                f"first dispatch"))
+    return out
+
+
+def check_entry(entry: EntryPoint, contract: Optional[Dict],
+                facts: List[ProgramFacts]) -> List[Violation]:
+    """All five contract classes for one entry point.  A missing
+    contract is itself a violation — an entry point the gate cannot
+    see is a hole, not a pass."""
+    if contract is None:
+        return [Violation(
+            entry.name, "contract", "contract",
+            f"{entry.name}: no contract file — adopt it with "
+            f"gan4j-prove --write-contracts")]
+    out: List[Violation] = []
+    if contract.get("entry_point") != entry.name:
+        out.append(Violation(
+            entry.name, "contract", "entry_point",
+            f"{entry.name}: contract file names entry point "
+            f"{contract.get('entry_point')!r}"))
+    out.extend(_check_donation(entry.name, contract, facts))
+    out.extend(_check_dtypes(entry.name, contract, facts))
+    out.extend(_check_collectives(entry.name, contract, facts))
+    out.extend(_check_peak_hbm(entry.name, contract, facts))
+    spec = entry.bucket_spec() if entry.bucket_spec else None
+    out.extend(_check_buckets(entry.name, contract, facts, spec))
+    return out
+
+
+# -- repo-level verify / adopt ------------------------------------------------
+
+
+def verify_repo(names: Optional[Sequence[str]] = None,
+                directory: Optional[str] = None,
+                write: bool = False) -> Dict:
+    """Lower every resolvable entry point and check (or, with
+    ``write``, freeze) its contract.  Returns the report document the
+    reporters render; ``summary.ok`` is the gate verdict."""
+    directory = directory or contracts_dir()
+    entries, skipped = program_mod.resolve(names)
+    report: Dict = {
+        "tool": "gan4j-prove",
+        "contracts_dir": directory,
+        "entries": {},
+        "skipped": [{"entry": n, "reason": r} for n, r in skipped],
+    }
+    violations: List[Violation] = []
+    for entry in entries:
+        facts = program_mod.build_facts(entry)
+        if write:
+            path = write_contract(directory, entry, facts)
+            entry_violations: List[Violation] = []
+            report["entries"][entry.name] = {
+                "facts": [f.to_dict() for f in facts],
+                "written": path,
+                "violations": [],
+            }
+        else:
+            try:
+                contract = load_contract(directory, entry.name)
+            except ValueError as e:
+                entry_violations = [Violation(
+                    entry.name, "contract", "version", str(e))]
+            else:
+                entry_violations = check_entry(entry, contract, facts)
+            report["entries"][entry.name] = {
+                "facts": [f.to_dict() for f in facts],
+                "violations": [v.to_dict() for v in entry_violations],
+            }
+        violations.extend(entry_violations)
+    report["summary"] = {
+        "entry_points": len(entries),
+        "skipped": len(skipped),
+        "violations": len(violations),
+        "written": bool(write),
+        "ok": not violations and bool(entries),
+    }
+    return report
+
+
+# -- selftest: prove the gate CAN fail ----------------------------------------
+
+
+def _selftest_donation() -> List[Violation]:
+    """A wrapper that drops donate_argnums must turn the gate red."""
+    entry = program_mod.all_entry_points()["fused_single"]
+    contract = load_contract(contracts_dir(), entry.name)
+    if contract is None:
+        contract = build_contract(entry, program_mod.build_facts(entry))
+    facts = program_mod.build_facts(entry, donate=False)
+    # the build declared nothing donated, so pin the declared count to
+    # the contract's: the injected failure is the MISSING aliasing
+    facts[0].declared_donated_leaves = (
+        contract["donation"]["declared_leaves"])
+    return [v for v in check_entry(entry, contract, facts)
+            if v.contract_class == "donation"]
+
+
+def _tiny_entry(name: str, build) -> EntryPoint:
+    return EntryPoint(name=name, summary="selftest scaffold",
+                      build=build)
+
+
+def _selftest_dtype() -> List[Violation]:
+    """An op forced to f64 must escape the allowed-dtype set."""
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import enable_x64
+
+    def build(donate: bool = False) -> List[program_mod.Built]:
+        del donate
+        jitted = jax.jit(lambda x: x * 2.0)
+        args = (jax.ShapeDtypeStruct((4,), jnp.float64),)
+        return [program_mod.Built("b4", jitted, args, 0, 4)]
+
+    entry = _tiny_entry("selftest_dtype", build)
+    with enable_x64():
+        facts = program_mod.build_facts(entry)
+    contract = build_contract(entry, facts)
+    contract["dtypes"]["allowed"] = ["f32"]
+    return [v for v in check_entry(entry, contract, facts)
+            if v.contract_class == "dtype"]
+
+
+def _selftest_collectives() -> List[Violation]:
+    """An extra all-reduce over the budget must fail the count."""
+    import jax
+    import numpy as np
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    from gan_deeplearning4j_tpu.compat.jaxver import shard_map
+
+    mesh = Mesh(np.asarray(jax.devices()[:2]), ("data",))
+
+    def two_syncs(x):
+        return jax.lax.psum(jax.lax.psum(x, "data"), "data")
+
+    def build(donate: bool = False) -> List[program_mod.Built]:
+        del donate
+        jitted = jax.jit(shard_map(two_syncs, mesh=mesh,
+                                   in_specs=P("data"), out_specs=P(),
+                                   check_vma=False))
+        args = (jax.ShapeDtypeStruct((8,), np.float32),)
+        return [program_mod.Built("b8", jitted, args, 0, 8,
+                                  mesh_shape={"data": 2})]
+
+    entry = _tiny_entry("selftest_collectives", build)
+    facts = program_mod.build_facts(entry)
+    contract = build_contract(entry, facts)
+    contract["collectives"]["all-reduce"] = 1  # program has 2
+    return [v for v in check_entry(entry, contract, facts)
+            if v.contract_class == "collectives"]
+
+
+def _selftest_peak_hbm() -> List[Violation]:
+    """A fat temp over a tiny ceiling must blow the budget."""
+    import jax
+    import jax.numpy as jnp
+
+    def build(donate: bool = False) -> List[program_mod.Built]:
+        del donate
+        jitted = jax.jit(
+            lambda x: (jnp.broadcast_to(x, (512, 1024, 32)) * 2.0).sum())
+        args = (jax.ShapeDtypeStruct((32,), jnp.float32),)
+        return [program_mod.Built("b32", jitted, args, 0, 32)]
+
+    entry = _tiny_entry("selftest_hbm", build)
+    facts = program_mod.build_facts(entry)
+    contract = build_contract(entry, facts)
+    contract["peak_hbm"]["bytes_ceiling"] = 1 << 20  # 1 MiB vs ~64 MiB
+    return [v for v in check_entry(entry, contract, facts)
+            if v.contract_class == "peak-hbm"]
+
+
+def _selftest_buckets() -> List[Violation]:
+    """An undeclared reachable batch shape must fail coverage."""
+    import jax
+    import jax.numpy as jnp
+
+    def build(donate: bool = False) -> List[program_mod.Built]:
+        del donate
+        jitted = jax.jit(lambda x: x + 1.0)
+        args = (jax.ShapeDtypeStruct((8, 4), jnp.float32),)
+        return [program_mod.Built("b8", jitted, args, 0, 8)]
+
+    spec = {"mode": "exact", "code_declared": [8, 16],
+            "reachable": [8, 24]}  # 24 has no bucket
+    entry = EntryPoint(name="selftest_buckets",
+                       summary="selftest scaffold", build=build,
+                       bucket_spec=lambda: spec)
+    facts = program_mod.build_facts(entry)
+    contract = build_contract(entry, facts)
+    return [v for v in check_entry(entry, contract, facts)
+            if v.contract_class == "buckets"]
+
+
+def selftest() -> Dict:
+    """One injected violation per contract class, each through the SAME
+    build->lower->check machinery as the real gate: a class whose
+    injection does not fire means the gate cannot go red there —
+    decoration, not verification.  ``ok`` iff all five fired."""
+    injectors = {
+        "donation": _selftest_donation,
+        "dtype": _selftest_dtype,
+        "collectives": _selftest_collectives,
+        "peak-hbm": _selftest_peak_hbm,
+        "buckets": _selftest_buckets,
+    }
+    results: Dict = {"tool": "gan4j-prove-selftest", "classes": {}}
+    ok = True
+    for cls, fn in injectors.items():
+        violations = fn()
+        fired = any(v.contract_class == cls for v in violations)
+        ok = ok and fired
+        results["classes"][cls] = {
+            "fired": fired,
+            "violations": [v.to_dict() for v in violations],
+        }
+    results["ok"] = ok
+    return results

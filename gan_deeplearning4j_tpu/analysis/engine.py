@@ -77,13 +77,29 @@ class FileContext:
         # line (1-based) -> set of suppressed rule names (or {"all"})
         self.suppressions: Dict[int, Set[str]] = {}
         self.hot_lines: Set[int] = set()
-        for lineno, text in enumerate(self.lines, start=1):
+        # directives count only inside REAL comment tokens: a docstring
+        # that merely documents the syntax must neither suppress a
+        # finding on the next line nor trip the unused-suppression audit
+        for lineno, text in self._comment_lines(source):
             m = SUPPRESS_RE.search(text)
             if m:
                 self.suppressions[lineno] = {
                     r.strip() for r in m.group(1).split(",") if r.strip()}
             if HOT_PATH_RE.search(text):
                 self.hot_lines.add(lineno)
+
+    def _comment_lines(self, source: str):
+        import io
+
+        try:
+            return [(tok.start[0], tok.string)
+                    for tok in tokenize.generate_tokens(
+                        io.StringIO(source).readline)
+                    if tok.type == tokenize.COMMENT]
+        except (tokenize.TokenError, IndentationError, SyntaxError):
+            # the AST parsed, so this is near-unreachable; raw lines
+            # keep the directive mechanism alive regardless
+            return list(enumerate(self.lines, start=1))
 
     def line_text(self, lineno: int) -> str:
         if 1 <= lineno <= len(self.lines):
@@ -94,11 +110,23 @@ class FileContext:
         """A finding at ``lineno`` is suppressed by a directive on the
         SAME line or the line DIRECTLY above (the convention that
         survives black-style reflowing of long lines)."""
+        return self.suppression_site(lineno, rule) is not None
+
+    def suppression_site(self, lineno: int, rule: str
+                         ) -> Optional[Tuple[int, str]]:
+        """The ``(directive_line, matched_token)`` that silences
+        ``rule`` at ``lineno`` — the exact-rule token when present,
+        ``"all"`` otherwise; None when nothing matches.  The engine's
+        unused-suppression audit keys on these sites."""
         for cand in (lineno, lineno - 1):
             rules = self.suppressions.get(cand)
-            if rules and ("all" in rules or rule in rules):
-                return True
-        return False
+            if not rules:
+                continue
+            if rule in rules:
+                return (cand, rule)
+            if "all" in rules:
+                return (cand, "all")
+        return None
 
     def is_hot_marked(self, node: ast.AST) -> bool:
         """``# gan4j-lint: hot-path`` on the def line, the line above
@@ -280,13 +308,19 @@ def lint_paths(paths: Sequence[str],
                rules: Optional[Sequence[str]] = None,
                disable: Sequence[str] = (),
                baseline_fingerprints: Optional[Set[str]] = None,
+               audit_suppressions: bool = False,
                ) -> LintResult:
     """Run the (selected) rules over every ``.py`` under ``paths``.
 
     ``rules``: restrict to these names (default: all registered);
     ``disable``: drop these from whatever was selected;
     ``baseline_fingerprints``: findings whose fingerprint is in here are
-    reported as ``baselined`` instead of active."""
+    reported as ``baselined`` instead of active;
+    ``audit_suppressions``: additionally flag every ``disable=``
+    directive whose rule no longer fires on its line (the
+    stale-suppression rot killer) as an ``unused-suppression`` finding.
+    Directives naming rules that exist but were not selected this run
+    are left alone — only a full-rule-set run can call them stale."""
     registry = all_rules()
     selected = list(rules) if rules else sorted(registry)
     unknown = [r for r in list(selected) + list(disable)
@@ -295,6 +329,7 @@ def lint_paths(paths: Sequence[str],
         raise ValueError(f"unknown rule(s): {', '.join(unknown)}; "
                          f"known: {', '.join(sorted(registry))}")
     instances = [registry[r]() for r in selected if r not in set(disable)]
+    active = {r.name for r in instances}
     baseline_fingerprints = baseline_fingerprints or set()
 
     result = LintResult([], [], [], [])
@@ -314,13 +349,23 @@ def lint_paths(paths: Sequence[str],
         for rule in instances:
             file_findings.extend(rule.check(ctx))
         file_findings.sort(key=lambda f: (f.line, f.rule))
+        used_sites: Set[Tuple[int, str]] = set()
+        classify: List[Finding] = []
+        for f in file_findings:
+            site = ctx.suppression_site(f.line, f.rule)
+            if site is not None:
+                used_sites.add(site)
+                result.suppressed.append(f)
+                continue
+            classify.append(f)
+        if audit_suppressions:
+            classify.extend(_audit_suppressions(ctx, used_sites, active,
+                                                registry, result))
+            classify.sort(key=lambda f: (f.line, f.rule))
         # occurrence index per (rule, snippet) so identical lines get
         # distinct baseline fingerprints
         seen: Dict[Tuple[str, str], int] = {}
-        for f in file_findings:
-            if ctx.suppressed(f.line, f.rule):
-                result.suppressed.append(f)
-                continue
+        for f in classify:
             key = (f.rule, f.snippet)
             idx = seen.get(key, 0)
             seen[key] = idx + 1
@@ -329,6 +374,65 @@ def lint_paths(paths: Sequence[str],
             else:
                 result.findings.append(f)
     return result
+
+
+def _audit_suppressions(ctx: FileContext,
+                        used_sites: Set[Tuple[int, str]],
+                        active: Set[str], registry: Dict[str, type],
+                        result: LintResult) -> List[Finding]:
+    """``unused-suppression`` findings for every directive token that
+    silenced nothing this run (its own suppression/baseline treatment
+    happens in the caller's classification pass, so a justified
+    ``disable=unused-suppression`` works like any other rule)."""
+    out: List[Finding] = []
+    for line, tokens in sorted(ctx.suppressions.items()):
+        for token in sorted(tokens):
+            if (line, token) in used_sites:
+                continue
+            if token == "all":
+                # "all" is spent if ANY rule was silenced at this site
+                if any(site_line == line for site_line, _ in used_sites):
+                    continue
+                if active != set(registry):
+                    # a partial-rule run cannot call "all" stale: the
+                    # finding it silences may belong to a rule that
+                    # did not run (same unknowability as the
+                    # exact-token branch below)
+                    continue
+                message = ("'disable=all' silenced nothing here — "
+                           "stale; remove it or narrow it to a rule")
+            elif token == "unused-suppression":
+                # the audit's own escape hatch is never audited (its
+                # usage depends on audit-finding order, not rule runs)
+                continue
+            elif token in registry:
+                if token not in active:
+                    continue  # rule exists but was not run: unknowable
+                message = (f"suppression '{token}' never fired on this "
+                           f"line — the finding it silenced is gone; "
+                           f"remove the stale directive (policy: "
+                           f"docs/STATIC_ANALYSIS.md)")
+            else:
+                message = (f"suppression names unknown rule "
+                           f"{token!r} — renamed or removed; the "
+                           f"directive is dead")
+            f = ctx.finding("unused-suppression", line, message)
+            # only an EXPLICIT disable=unused-suppression token can
+            # silence an audit finding — honoring the audited
+            # directive's own "all" here would let every stale
+            # disable=all hide its own staleness (and its neighbor's,
+            # via the line-above convention), which is the exact rot
+            # this audit exists to kill
+            site = next(((cand, "unused-suppression")
+                         for cand in (line, line - 1)
+                         if "unused-suppression"
+                         in ctx.suppressions.get(cand, set())), None)
+            if site is not None:
+                used_sites.add(site)
+                result.suppressed.append(f)
+                continue
+            out.append(f)
+    return out
 
 
 def package_root() -> str:

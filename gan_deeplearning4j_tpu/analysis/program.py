@@ -1,0 +1,453 @@
+"""gan4j-prove program layer: lower the repo's jitted entry points and
+extract checkable facts from the ACTUAL lowering.
+
+gan4j-lint (engine.py) sees the AST; this module sees what XLA will
+really execute.  Every registered :class:`EntryPoint` builds one of the
+repo's jitted programs — the fused single step, the fused multi/scan
+step, the sharded SPMD step, the GANPair multistep scan, the serving
+inference dispatch — against abstract ``jax.ShapeDtypeStruct`` inputs
+(no device buffers, no TPU: the whole thing runs on the CPU CI lane)
+and lowers it via ``jax.jit(...).lower(...)``.  From the lowering and
+its CPU compile we extract :class:`ProgramFacts`:
+
+* **donation** — which flat parameters are actually aliased to outputs
+  in the compiled module's ``input_output_alias`` (a donation silently
+  dropped by jit/XLA doubles the state's HBM footprint and no Python
+  test can see it);
+* **dtypes** — every tensor element type in the stablehlo (f64 or an
+  unintended widening shows up here before it ships);
+* **collectives** — static per-step counts of all-reduce / all-gather /
+  collective-permute / all-to-all / reduce-scatter ops (an accidental
+  extra sync per step is invisible in loss curves and fatal to MFU);
+* **peak HBM** — ``compile().memory_analysis()`` byte totals, with an
+  aval-size estimate as the fallback where the backend offers none.
+
+contracts.py checks these facts against the versioned JSON contracts in
+``analysis/contracts/``; prove_cli.py is the ``gan4j-prove`` console
+entry and CI gate.  docs/STATIC_ANALYSIS.md#program-contracts is the
+operator manual.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import re
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+# Collective stablehlo op name -> contract key.  Counted statically in
+# the lowered module: a scan body is counted ONCE, matching the
+# "per-step cost" meaning of the contract budget.
+COLLECTIVE_OPS = {
+    "all_reduce": "all-reduce",
+    "all_gather": "all-gather",
+    "collective_permute": "collective-permute",
+    "all_to_all": "all-to-all",
+    "reduce_scatter": "reduce-scatter",
+    "collective_broadcast": "collective-broadcast",
+}
+
+_TENSOR_RE = re.compile(r"tensor<([^>]+)>")
+_ALIAS_ENTRY_RE = re.compile(r"\((\d+), \{[^)]*?\}, (?:may|must)-alias\)")
+
+
+@dataclasses.dataclass
+class ProgramFacts:
+    """What one lowered program variant actually does — the evidence the
+    contract checks run against."""
+
+    entry: str
+    variant: str                 # "b8" etc.; one per compile bucket
+    batch: int
+    mesh_shape: Optional[Dict[str, int]]
+    declared_donated_leaves: int  # leaves of the args the entry donates
+    aliased_params: List[int]     # flat param indices aliased to outputs
+    dtypes: List[str]             # sorted element types in the stablehlo
+    collectives: Dict[str, int]   # contract key -> static op count
+    peak_bytes: int
+    memory_source: str            # "memory_analysis" | "aval-estimate"
+
+    def to_dict(self) -> Dict:
+        return dataclasses.asdict(self)
+
+
+def abstractify(tree):
+    """Concrete pytree -> matching ShapeDtypeStruct pytree (sharding
+    dropped; use explicit ShapeDtypeStruct(sharding=...) leaves for SPMD
+    entries)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    return jax.tree.map(
+        lambda x: jax.ShapeDtypeStruct(np.shape(x), jnp.asarray(x).dtype),
+        tree)
+
+
+def _aval_bytes(tree) -> int:
+    import jax
+    import numpy as np
+
+    total = 0
+    for leaf in jax.tree.leaves(tree):
+        total += int(np.prod(leaf.shape or (1,))) * leaf.dtype.itemsize
+    return total
+
+
+def extract_facts(entry_name: str, variant: str, jitted, args,
+                  donated_leaves: int, batch: int,
+                  mesh_shape: Optional[Dict[str, int]]) -> ProgramFacts:
+    """Lower ``jitted`` on the abstract ``args``, compile it for the
+    host platform, and read the facts off the artifacts themselves —
+    never off source flags."""
+    lowered = jitted.lower(*args)
+    stablehlo = lowered.as_text()
+    compiled = lowered.compile()
+    hlo = compiled.as_text()
+
+    # donation ground truth: the COMPILED module's input_output_alias —
+    # what the runtime will actually alias, after both jit and XLA had
+    # their chance to silently drop a donation
+    aliased: List[int] = []
+    for line in hlo.splitlines():
+        # the HloModule header line carries the whole alias map:
+        # input_output_alias={ {0}: (0, {}, may-alias), ... }
+        if "input_output_alias=" in line:
+            aliased = sorted(
+                {int(p) for p in _ALIAS_ENTRY_RE.findall(line)})
+            break
+
+    dtypes = set()
+    for ty in _TENSOR_RE.findall(stablehlo):
+        dtypes.add(ty.split("x")[-1].strip())
+
+    collectives = {}
+    for op, key in COLLECTIVE_OPS.items():
+        n = len(re.findall(rf"stablehlo\.{op}\b", stablehlo))
+        if n:
+            collectives[key] = n
+
+    try:
+        mem = compiled.memory_analysis()
+    except Exception:
+        # memory_analysis is per-backend optional; the aval estimate
+        # below IS the handled fallback
+        mem = None
+    if mem is not None and getattr(mem, "argument_size_in_bytes", None
+                                   ) is not None:
+        # live-at-entry args + live-at-exit outputs (donated aliases
+        # counted once) + XLA's scratch high-water mark
+        peak = int(mem.argument_size_in_bytes + mem.output_size_in_bytes
+                   - mem.alias_size_in_bytes + mem.temp_size_in_bytes)
+        source = "memory_analysis"
+    else:
+        peak = _aval_bytes(args) + _aval_bytes(
+            jitted.eval_shape(*args) if hasattr(jitted, "eval_shape")
+            else args)
+        source = "aval-estimate"
+
+    return ProgramFacts(
+        entry=entry_name, variant=variant, batch=batch,
+        mesh_shape=mesh_shape, declared_donated_leaves=donated_leaves,
+        aliased_params=aliased, dtypes=sorted(dtypes),
+        collectives=collectives, peak_bytes=peak, memory_source=source)
+
+
+# -- reachable batch shapes ---------------------------------------------------
+#
+# The bucket-coverage contract class: every batch shape a bench or
+# serving config can reach must map to a declared compile bucket, so
+# "recompile per request shape" is statically impossible.  Reachability
+# is computed LIVE from the code (constants and config defaults) — the
+# contract pins the declared set; drift on either side is a red prove.
+
+
+def reachable_protocol_batches() -> List[int]:
+    """Batch shapes the fused protocol step is dispatched at by the
+    bench and the protocol mains' defaults."""
+    from gan_deeplearning4j_tpu import bench
+    from gan_deeplearning4j_tpu.train import cv_main, insurance_main
+
+    shapes = {bench.DEFAULT_BATCH, bench.DRYRUN_BATCH, bench.FAST_BATCH}
+    for mod in (cv_main, insurance_main):
+        shapes.add(int(mod.default_config().batch_size))
+    return sorted(shapes)
+
+
+def reachable_pair_batches() -> List[int]:
+    """Batch shapes the GANPair multistep scan is dispatched at (the
+    roadmap families' engine)."""
+    from gan_deeplearning4j_tpu import bench
+    from gan_deeplearning4j_tpu.train import roadmap_main
+
+    return sorted({bench.CELEBA_BATCH, roadmap_main.DEFAULT_BATCH_SIZE})
+
+
+# -- entry-point registry -----------------------------------------------------
+
+
+class Built:
+    """One lowerable program variant: the jit object, its abstract args,
+    and how many flat leaves the entry declares donated."""
+
+    def __init__(self, variant: str, jitted, args, donated_leaves: int,
+                 batch: int, mesh_shape: Optional[Dict[str, int]] = None):
+        self.variant = variant
+        self.jitted = jitted
+        self.args = args
+        self.donated_leaves = donated_leaves
+        self.batch = batch
+        self.mesh_shape = mesh_shape
+
+
+@dataclasses.dataclass
+class EntryPoint:
+    """A registered jitted entry point of the repo.
+
+    ``build(donate=...)`` returns the program variants to lower; the
+    ``donate`` override exists for the CI selftest (a wrapper that drops
+    ``donate_argnums`` must turn the gate red).  ``exemption`` names a
+    contract-owned donation exemption (e.g. scan-donation) instead of a
+    code comment; ``bucket_spec`` returns the live bucket-coverage
+    inputs, None when the entry has no bucket contract."""
+
+    name: str
+    summary: str
+    build: Callable[..., List[Built]]
+    needs_devices: int = 1
+    exemption: Optional[Dict[str, str]] = None
+    bucket_spec: Optional[Callable[[], Dict]] = None
+
+
+_ENTRIES: Dict[str, EntryPoint] = {}
+
+# The donation/scan exemption, encoded ONCE as data (the contract files
+# reference it; train/fused_step.py and train/gan_pair.py point here
+# instead of hand-maintaining the rationale in comments).
+SCAN_DONATION_EXEMPTION = {
+    "id": "scan-donation",
+    "reason": "donation + lax.scan trips an INVALID_ARGUMENT runtime "
+              "error in the axon TPU backend (single-step donated "
+              "programs are fine); the builders flip donate off under "
+              "scan and emit a 'donation.disabled' telemetry event — "
+              "the cost is one extra copy of the MB-scale state",
+}
+
+
+def register_entry(entry: EntryPoint) -> EntryPoint:
+    assert entry.name not in _ENTRIES, entry.name
+    _ENTRIES[entry.name] = entry
+    return entry
+
+
+def all_entry_points() -> Dict[str, EntryPoint]:
+    return dict(_ENTRIES)
+
+
+def resolve(names: Optional[Sequence[str]] = None,
+            ) -> Tuple[List[EntryPoint], List[Tuple[str, str]]]:
+    """Entry points runnable on the current topology.  Returns
+    ``(entries, skipped)`` where skipped is ``[(name, reason), ...]`` —
+    mesh entries skip (with a reason, never silently) on a single-device
+    host.  Unknown names raise ValueError (a usage error upstream)."""
+    import jax
+
+    unknown = [n for n in (names or []) if n not in _ENTRIES]
+    if unknown:
+        raise ValueError(
+            f"unknown entry point(s): {', '.join(unknown)}; known: "
+            f"{', '.join(sorted(_ENTRIES))}")
+    selected = [_ENTRIES[n] for n in names] if names else [
+        _ENTRIES[n] for n in sorted(_ENTRIES)]
+    n_dev = len(jax.devices())
+    entries, skipped = [], []
+    for e in selected:
+        if e.needs_devices > n_dev:
+            skipped.append((e.name, f"needs {e.needs_devices} devices, "
+                                    f"host has {n_dev}"))
+        else:
+            entries.append(e)
+    return entries, skipped
+
+
+def build_facts(entry: EntryPoint, donate: Optional[bool] = None,
+                ) -> List[ProgramFacts]:
+    """Build + lower every variant of ``entry`` and extract its facts.
+    ``donate`` overrides the entry's donation wiring (selftest only)."""
+    kwargs = {} if donate is None else {"donate": donate}
+    return [
+        extract_facts(entry.name, b.variant, b.jitted, b.args,
+                      b.donated_leaves, b.batch, b.mesh_shape)
+        for b in entry.build(**kwargs)
+    ]
+
+
+# -- the registered entries ---------------------------------------------------
+#
+# All builds are CI-sized (batch = bench.DRYRUN_BATCH, tiny tables):
+# the verified invariants — aliasing, collective counts, dtype set —
+# are batch-independent program properties, and the HBM ceiling is
+# pinned at the shape the contract records.
+
+
+def _mnist_protocol(mesh=None, **mk_kwargs):
+    import jax
+    import jax.numpy as jnp
+
+    from gan_deeplearning4j_tpu import bench
+    from gan_deeplearning4j_tpu.models import dcgan_mnist as M
+    from gan_deeplearning4j_tpu.train import fused_step as fused
+
+    b = bench.DRYRUN_BATCH
+    dis, gen, gan = (
+        M.build_discriminator(), M.build_generator(), M.build_gan())
+    classifier = M.build_classifier(dis)
+    step = fused.make_protocol_step(
+        dis, gen, gan, classifier,
+        M.DIS_TO_GAN, M.GAN_TO_GEN, M.DIS_TO_CLASSIFIER,
+        z_size=2, num_features=784, mesh=mesh, **mk_kwargs)
+    state = fused.state_from_graphs(dis, gen, gan, classifier)
+    key = jax.random.key(0)
+    ones = jnp.ones((b, 1), jnp.float32)
+    rows = (4 * b if mk_kwargs.get("data_on_device") else b)
+    args = (state, jnp.zeros((rows, 784), jnp.float32),
+            jnp.zeros((rows, 10), jnp.float32),
+            key, jax.random.fold_in(key, 1), ones, 0.0 * ones, ones)
+    return step, abstractify(args), state, b
+
+
+def _build_fused_single(donate: bool = True) -> List[Built]:
+    import jax
+
+    step, args, state, b = _mnist_protocol(donate=donate)
+    return [Built("single", step, args,
+                  len(jax.tree.leaves(state)) if donate else 0, b)]
+
+
+def _build_fused_multi(donate: bool = True) -> List[Built]:
+    # donate=True on purpose: the module itself must flip it off under
+    # scan (the contract-owned exemption), and the facts must show zero
+    # aliasing REGARDLESS of what the caller asked for
+    step, args, _, b = _mnist_protocol(
+        donate=donate, data_on_device=True, steps_per_call=2)
+    return [Built("scan2", step, args, 0, b)]
+
+
+def _build_sharded_step(donate: bool = True) -> List[Built]:
+    import jax
+    import numpy as np
+    from jax.sharding import Mesh
+
+    mesh = Mesh(np.asarray(jax.devices()[:2]), ("data",))
+    step, args, state, b = _mnist_protocol(mesh=mesh, donate=donate)
+    return [Built("spmd2", step, args,
+                  len(jax.tree.leaves(state)) if donate else 0, b,
+                  mesh_shape={"data": 2})]
+
+
+def _build_pair_multi(donate: bool = False) -> List[Built]:
+    import jax.numpy as jnp
+
+    from gan_deeplearning4j_tpu import bench
+    from gan_deeplearning4j_tpu.models import mlpgan_insurance as I
+    from gan_deeplearning4j_tpu.train.gan_pair import GANPair
+
+    del donate  # the pair scan never donates (scan-donation exemption)
+    b = bench.DRYRUN_BATCH
+    pair = GANPair(I.build_generator(), I.build_discriminator())
+    table = jnp.zeros((4 * b, I.InsuranceConfig().num_features),
+                      jnp.float32)
+    step_fn, state0 = pair.make_multistep(
+        table, None, batch_size=b, steps_per_call=2, z_size=2)
+    args = abstractify((state0, *step_fn.invariants))
+    return [Built("scan2", step_fn.jitted, args, 0, b)]
+
+
+def _build_serving_infer(donate: bool = False) -> List[Built]:
+    import functools
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    from gan_deeplearning4j_tpu.models import dcgan_mnist as M
+    from gan_deeplearning4j_tpu.parallel.inference import (
+        DEFAULT_SERVING_BUCKETS,
+    )
+
+    del donate  # inference dispatch has no state to donate
+    gen = M.build_generator()
+    mesh = Mesh(np.asarray(jax.devices()[:2]), ("data",))
+    rep, sh = NamedSharding(mesh, P()), NamedSharding(mesh, P("data"))
+    # ONE jit object, lowered once per declared bucket: the bucket set
+    # IS the complete set of program shapes serving may dispatch
+    jitted = jax.jit(functools.partial(gen._forward_outputs, train=False))
+    params = jax.tree.map(
+        lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=rep),
+        abstractify(gen.params))
+    built = []
+    for b in DEFAULT_SERVING_BUCKETS:
+        z = {gen.input_names[0]: jax.ShapeDtypeStruct(
+            (b, 2), jnp.float32, sharding=sh)}
+        built.append(Built(f"b{b}", jitted, (params, z), 0, b,
+                           mesh_shape={"data": 2}))
+    return built
+
+
+def _serving_bucket_spec() -> Dict:
+    from gan_deeplearning4j_tpu.parallel.inference import (
+        DEFAULT_SERVING_BUCKETS,
+    )
+
+    return {
+        "mode": "round-up",
+        "code_declared": sorted(DEFAULT_SERVING_BUCKETS),
+        "max_request": max(DEFAULT_SERVING_BUCKETS),
+    }
+
+
+register_entry(EntryPoint(
+    name="fused_single",
+    summary="fused three-graph protocol step, single-step donated path "
+            "(train/fused_step.py; the bench headline program)",
+    build=_build_fused_single,
+    bucket_spec=lambda: {"mode": "exact",
+                         "code_declared": reachable_protocol_batches(),
+                         "reachable": reachable_protocol_batches()},
+))
+
+register_entry(EntryPoint(
+    name="fused_multi",
+    summary="fused protocol step under lax.scan (steps_per_call>1, "
+            "device-resident data) — the trainer's chunked fast path",
+    build=_build_fused_multi,
+    exemption=SCAN_DONATION_EXEMPTION,
+))
+
+register_entry(EntryPoint(
+    name="sharded_step",
+    summary="fused protocol step as a shard_map SPMD program over a "
+            "2-device data mesh (parallel/ collective schedule)",
+    build=_build_sharded_step,
+    needs_devices=2,
+))
+
+register_entry(EntryPoint(
+    name="pair_multi",
+    summary="GANPair multistep scan (train/gan_pair.py; the roadmap "
+            "families' engine, insurance-sized for CI)",
+    build=_build_pair_multi,
+    exemption=SCAN_DONATION_EXEMPTION,
+    bucket_spec=lambda: {"mode": "exact",
+                         "code_declared": reachable_pair_batches(),
+                         "reachable": reachable_pair_batches()},
+))
+
+register_entry(EntryPoint(
+    name="serving_infer",
+    summary="sharded inference dispatch (parallel/inference.py) at "
+            "every declared serving bucket shape",
+    build=_build_serving_infer,
+    needs_devices=2,
+    bucket_spec=_serving_bucket_spec,
+))

@@ -1,0 +1,147 @@
+"""``gan4j-prove`` console entry point — the program-contract CI gate.
+
+Verifies the repo's jitted entry points against the versioned contracts
+in ``analysis/contracts/`` (contracts.py): donation aliasing, dtype
+discipline, collective budgets, peak-HBM ceilings and compile-bucket
+coverage — all read off the ACTUAL ``jax.jit(...).lower()`` artifacts
+on abstract inputs, so the tool needs no accelerator and runs on the
+CPU CI lane.
+
+Exit codes (the CI contract, tier1.yml prove lane):
+
+  0  every resolved entry point satisfies its contract
+     (or --write-contracts / --selftest / --list-entries succeeded)
+  1  at least one contract violation (or a selftest class not firing)
+  2  usage error — including ZERO resolved entry points: a prover that
+     proves nothing must not answer green
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import Optional
+
+
+def _force_cpu_topology() -> None:
+    """gan4j-prove is a static verifier: contracts are written and
+    checked against the CPU lowering, deterministically, with enough
+    virtual devices that the SPMD entry points resolve.  Must run
+    before the JAX backend initializes (conftest.py uses the same
+    dance; this environment's TPU plugin force-sets jax_platforms at
+    interpreter startup, so the env var alone is not enough)."""
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8").strip()
+    import jax
+
+    try:
+        jax.config.update("jax_platforms", "cpu")
+    except Exception:  # gan4j-lint: disable=swallowed-exception — backend already initialized (in-process use): the caller's topology stands
+        pass
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="gan4j-prove", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    p.add_argument("--contracts", default=None, metavar="DIR",
+                   help="contract directory (default: the committed "
+                        "analysis/contracts/ inside the package)")
+    p.add_argument("--entries", default=None, metavar="LIST",
+                   help="comma-separated entry-point names "
+                        "(default: all resolvable)")
+    p.add_argument("--write-contracts", action="store_true",
+                   help="freeze the current facts as the contracts "
+                        "(adoption mode — same semantics as gan4j-lint "
+                        "--write-baseline) and exit 0")
+    p.add_argument("--format", choices=("human", "json"),
+                   default="human", help="report format (json is the "
+                                         "CI artifact format)")
+    p.add_argument("--output", default=None, metavar="FILE",
+                   help="write the report there instead of stdout "
+                        "(the exit code is unchanged)")
+    p.add_argument("--list-entries", action="store_true",
+                   help="print the entry-point catalogue and exit")
+    p.add_argument("--selftest", action="store_true",
+                   help="prove the gate CAN fail: one injected "
+                        "violation per contract class must fire; "
+                        "exit 1 if any class stays green")
+    return p
+
+
+def main(argv: Optional[list] = None) -> int:
+    parser = build_parser()
+    args = parser.parse_args(argv)
+
+    from gan_deeplearning4j_tpu.analysis import (
+        contracts as contracts_mod,
+        program as program_mod,
+        reporters,
+    )
+
+    if args.list_entries:
+        for name, entry in sorted(program_mod.all_entry_points().items()):
+            print(f"{name}: {entry.summary}")
+        return 0
+
+    if args.selftest:
+        result = contracts_mod.selftest()
+        for cls, rec in result["classes"].items():
+            verdict = ("FAILED-AS-EXPECTED" if rec["fired"]
+                       else "DID-NOT-FIRE")
+            print(f"gan4j-prove selftest: {cls}: {verdict}")
+        print(f"gan4j-prove selftest: "
+              f"{'ok' if result['ok'] else 'GATE CANNOT GO RED'}")
+        return 0 if result["ok"] else 1
+
+    names = ([e.strip() for e in args.entries.split(",") if e.strip()]
+             if args.entries else None)
+    try:
+        report = contracts_mod.verify_repo(
+            names=names, directory=args.contracts,
+            write=args.write_contracts)
+    except ValueError as e:
+        print(f"gan4j-prove: error: {e}", file=sys.stderr)
+        return 2
+    if report["summary"]["entry_points"] == 0:
+        # a prover that resolved nothing (single-device host asking
+        # only for mesh entries, say) must not answer green
+        for rec in report["skipped"]:
+            print(f"gan4j-prove: skipped {rec['entry']}: "
+                  f"{rec['reason']}", file=sys.stderr)
+        print("gan4j-prove: error: zero entry points resolved — "
+              "refusing to report a vacuous pass", file=sys.stderr)
+        return 2
+
+    if args.write_contracts:
+        for name, rec in sorted(report["entries"].items()):
+            print(f"gan4j-prove: contract written: {name} -> "
+                  f"{rec['written']}")
+        return 0
+
+    rendered = (reporters.render_prove_json(report)
+                if args.format == "json"
+                else reporters.render_prove_human(report))
+    if args.output:
+        with open(args.output, "w") as f:
+            f.write(rendered)
+        s = report["summary"]
+        print(f"gan4j-prove: {s['violations']} violation(s) over "
+              f"{s['entry_points']} entry point(s) "
+              f"({'ok' if s['ok'] else 'FAIL'}) -> {args.output}")
+    else:
+        sys.stdout.write(rendered)
+    return 0 if report["summary"]["ok"] else 1
+
+
+def cli(argv: Optional[list] = None) -> None:
+    _force_cpu_topology()
+    sys.exit(main(argv))
+
+
+if __name__ == "__main__":
+    cli()

@@ -1,11 +1,14 @@
-"""Report rendering for gan4j-lint: human text and machine JSON.
+"""Report rendering for gan4j-lint AND gan4j-prove: human text + JSON.
 
 Human format is the conventional ``path:line: rule: message`` one line
 per finding (editors and CI log scrapers both parse it); JSON is the
 CI-artifact format tier1.yml uploads — stable keys, a summary block,
 and the full finding list including what was suppressed/baselined (the
 gate keys on ``findings`` alone, but the artifact shows the whole
-picture)."""
+picture).  The prove renderers take the report document
+``contracts.verify_repo`` returns and follow the same conventions:
+one ``entry: class: field: message`` line per violation, a one-line
+verdict, and the full facts in the JSON artifact."""
 
 from __future__ import annotations
 
@@ -56,3 +59,30 @@ def render_json(result: LintResult) -> str:
         "errors": [f.to_dict() for f in result.errors],
     }
     return json.dumps(doc, indent=1) + "\n"
+
+
+def render_prove_human(report: Dict) -> str:
+    """One line per violation (``entry: class: field: message``), the
+    per-entry verdicts, and a one-line summary — the terminal face of
+    the prove gate."""
+    lines = []
+    for name in sorted(report["entries"]):
+        rec = report["entries"][name]
+        for v in rec["violations"]:
+            lines.append(f"{v['entry']}: {v['contract_class']}: "
+                         f"{v['field']}: {v['message']}")
+    for rec in report.get("skipped", []):
+        lines.append(f"gan4j-prove: skipped {rec['entry']}: "
+                     f"{rec['reason']}")
+    s = report["summary"]
+    lines.append(
+        f"gan4j-prove: {s['violations']} violation(s) over "
+        f"{s['entry_points']} entry point(s), {s['skipped']} skipped "
+        f"({'ok' if s['ok'] else 'FAIL'})")
+    return "\n".join(lines) + "\n"
+
+
+def render_prove_json(report: Dict) -> str:
+    """The CI artifact: the full verify_repo document (facts included —
+    the artifact shows what was measured, not just the verdict)."""
+    return json.dumps(report, indent=1) + "\n"

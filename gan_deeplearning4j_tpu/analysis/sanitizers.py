@@ -63,7 +63,10 @@ class _CompileLogHandler(logging.Handler):
     def emit(self, record: logging.LogRecord) -> None:
         try:
             msg = record.getMessage()
-        except Exception:  # gan4j-lint: disable=swallowed-exception — a malformed log record must not break compilation itself
+        except Exception:
+            # best-effort: a malformed log record must not break
+            # compilation itself (return-only, so outside the
+            # swallowed-exception rule's pass/continue scope)
             return
         if msg.startswith(_COMPILE_PREFIX):
             name = msg[len(_COMPILE_PREFIX):].split(" ", 1)[0]

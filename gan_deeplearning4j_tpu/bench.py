@@ -49,7 +49,14 @@ def _baseline_path() -> str:
 
 
 BASELINE_PATH = _baseline_path()
-BATCH = 200          # batchSizePerWorker (dl4jGANComputerVision.java:59)
+# batchSizePerWorker (dl4jGANComputerVision.java:59).  DEFAULT_BATCH /
+# DRYRUN_BATCH / FAST_BATCH / CELEBA_BATCH are the bench's complete set
+# of protocol batch shapes — gan4j-prove's bucket-coverage contract
+# (analysis/program.py reachable_protocol_batches) enumerates THESE
+# constants, so adding a new dispatch shape without a contract diff is
+# a red prove, not a silent recompile.
+DEFAULT_BATCH = 200
+BATCH = DEFAULT_BATCH
 WARMUP = 3
 STEPS_LO = 30
 STEPS_HI = 180
@@ -64,6 +71,10 @@ E2E_STEPS = 300
 # default: s2d/d2s conv rewrites + bf16 MXU operands + full mixed
 # precision (f32 master params/BN/loss) — runtime/backend.py
 FAST_BATCH = 1600
+# the --dryrun smoke's toy batch and the CelebA block's default —
+# both part of the bucket-coverage contract (see DEFAULT_BATCH note)
+DRYRUN_BATCH = 8
+CELEBA_BATCH = 128
 # Bump when the measured step's methodology changes; a cached baseline
 # from another version is discarded and re-measured (apples to apples).
 # v5: readback-fenced slope timing — jax.block_until_ready is a NO-OP on
@@ -492,6 +503,26 @@ def sanitizer_dryrun(registry=None) -> dict:
     return out
 
 
+def prove_dryrun() -> dict:
+    """The program-contract gate as a bench verdict (gan4j-prove,
+    analysis/contracts.py): lower every entry point resolvable on the
+    CURRENT topology and check it against the committed contracts —
+    donation aliasing, dtype discipline, collective budgets, peak-HBM
+    ceilings, bucket coverage, all read off the actual lowering.  The
+    three meshless entry points (fused single, fused multi/scan, pair
+    multistep) resolve on any host, so ``ok`` requires >= 3 proved with
+    zero violations; the SPMD entries join automatically when the host
+    has >= 2 devices (the tier1.yml prove lane always runs all five)."""
+    from gan_deeplearning4j_tpu.analysis import contracts as contracts_mod
+
+    report = contracts_mod.verify_repo()
+    s = report["summary"]
+    return {"entry_points": s["entry_points"],
+            "skipped": [rec["entry"] for rec in report["skipped"]],
+            "violations": s["violations"],
+            "ok": bool(s["ok"] and s["entry_points"] >= 3)}
+
+
 def lint_dryrun() -> dict:
     """The static gate as a bench verdict: gan4j-lint over the whole
     installed package, default rules, EMPTY baseline — ``ok`` iff zero
@@ -543,9 +574,15 @@ def dryrun(telemetry: bool = True,
     ``sanitizer_ok`` asserts zero post-warmup recompiles + zero
     implicit transfers on the fused loop (``sanitizer_dryrun``) — the
     static and runtime halves of the same hot-path-stays-clean
-    contract, both folded into ``ok``."""
+    contract, both folded into ``ok``.
+
+    gan4j-prove joins them (PR 7): ``prove_ok`` checks every entry
+    point resolvable on this topology against its committed program
+    contract (``prove_dryrun``) — donation aliasing, dtype discipline,
+    collective budget, peak-HBM ceiling and bucket coverage, verified
+    from the actual lowering, also folded into ``ok``."""
     global BATCH
-    prev_batch, BATCH = BATCH, 8
+    prev_batch, BATCH = BATCH, DRYRUN_BATCH
     try:
         import math
         import tempfile
@@ -614,6 +651,12 @@ def dryrun(telemetry: bool = True,
                     sanitizer = sanitizer_dryrun(registry=registry)
                 with events_mod.span("bench.lint"):
                     lint = lint_dryrun()
+                # gan4j-prove (PR 7): the program-contract gate over
+                # every entry point this topology can lower — donation
+                # still aliased, no f64, collective budget intact,
+                # peak-HBM under ceiling, batch shapes inside buckets
+                with events_mod.span("bench.prove"):
+                    prove = prove_dryrun()
                 # one record through the registry feed, then a REAL
                 # scrape over the socket: the CI assertion that the
                 # exporter answers with the step/goodput/NaN series
@@ -678,7 +721,8 @@ def dryrun(telemetry: bool = True,
                 "ok": bool(ok and math.isfinite(t) and ckpt_ok
                            and exporter_ok and events_ok
                            and watchdog_ok and data_ok
-                           and lint["ok"] and sanitizer["ok"]),
+                           and lint["ok"] and sanitizer["ok"]
+                           and prove["ok"]),
                 "platform": device.platform,
                 "telemetry": telemetry,
                 "checkpoint": ckpt,
@@ -690,6 +734,8 @@ def dryrun(telemetry: bool = True,
                 "lint": lint,
                 "sanitizer_ok": bool(sanitizer["ok"]),
                 "sanitizer": sanitizer,
+                "prove_ok": bool(prove["ok"]),
+                "prove": prove,
                 "watchdog_beat_us": round(beat_us, 3)}
     finally:
         BATCH = prev_batch
@@ -727,7 +773,7 @@ def main(argv=None) -> None:
                    help="serve /metrics + /healthz during the e2e "
                         "trainer run (and the --dryrun smoke's "
                         "self-scrape); 0 = ephemeral")
-    p.add_argument("--batch", type=int, default=200,
+    p.add_argument("--batch", type=int, default=DEFAULT_BATCH,
                    help="global batch (default: the reference's 200; the "
                         "CPU-baseline ratio is only reported at 200, "
                         "apples to apples)")
@@ -757,7 +803,7 @@ def main(argv=None) -> None:
                         "multistep measurement block")
     p.add_argument("--skip-celeba", action="store_true",
                    help="skip the CelebA-64 GANPair multistep MFU block")
-    p.add_argument("--celeba-batch", type=int, default=128,
+    p.add_argument("--celeba-batch", type=int, default=CELEBA_BATCH,
                    help="CelebA block batch (default: the roadmap "
                         "trainer's 128)")
     args = p.parse_args(argv)

@@ -22,12 +22,22 @@ batching thread pads queued requests the same way) and sliced back before
 returning.  ``max_batch`` bounds the per-dispatch global batch — the
 analog of ParallelInference's ``batchLimit`` — by splitting oversized
 inputs into sequential dispatches.
+
+``buckets`` fixes the COMPLETE set of dispatch shapes: every request
+pads up to the smallest declared bucket that holds it (oversized inputs
+chunk by the largest), so the compiled-program set is closed and
+"recompile per request shape" is impossible by construction.  The
+bucket set is a gan4j-prove program contract
+(``analysis/contracts/serving_infer.json``): the verifier lowers the
+dispatch at every declared bucket and proves request coverage, so a
+bucket change is a reviewable contract diff, not a silent recompile
+storm under load (docs/STATIC_ANALYSIS.md#program-contracts).
 """
 
 from __future__ import annotations
 
 import functools
-from typing import List, Optional
+from typing import List, Optional, Sequence
 
 import jax
 import jax.numpy as jnp
@@ -38,6 +48,11 @@ from gan_deeplearning4j_tpu.parallel.mesh import (
     replicated,
 )
 
+# The canonical serving bucket set (the code side of the gan4j-prove
+# bucket-coverage contract).  Every bucket must divide over the mesh
+# axis; the largest bucket is the chunking unit for oversized requests.
+DEFAULT_SERVING_BUCKETS = (8, 32, 64)
+
 
 class ParallelInference:
     """Batch-sharded SPMD inference over a mesh for a ``ComputationGraph``.
@@ -47,7 +62,8 @@ class ParallelInference:
     """
 
     def __init__(self, graph, mesh=None, axis: str = "data",
-                 max_batch: Optional[int] = None):
+                 max_batch: Optional[int] = None,
+                 buckets: Optional[Sequence[int]] = None):
         self.graph = graph
         self.mesh = mesh if mesh is not None else data_mesh()
         self.axis = axis
@@ -65,6 +81,23 @@ class ParallelInference:
                 f"max_batch={max_batch} must be a multiple of the mesh "
                 f"axis size {self.mesh.shape[axis]}")
         self.max_batch = max_batch
+        self.buckets: Optional[tuple] = None
+        if buckets is not None:
+            bs = tuple(sorted({int(b) for b in buckets}))
+            if not bs:
+                raise ValueError("buckets must name at least one shape")
+            bad = [b for b in bs if b <= 0 or b % self.mesh.shape[axis]]
+            if bad:
+                raise ValueError(
+                    f"bucket(s) {bad} must be positive multiples of the "
+                    f"mesh axis size {self.mesh.shape[axis]} — every "
+                    f"bucket shape must shard evenly")
+            if max_batch is not None and max_batch != bs[-1]:
+                raise ValueError(
+                    f"max_batch={max_batch} must equal the largest "
+                    f"bucket {bs[-1]} when both are given — the largest "
+                    f"bucket IS the chunking unit")
+            self.buckets = bs
         self._n = self.mesh.shape[axis]
         self._rep = replicated(self.mesh)
         self._batch_sh = batch_sharding(self.mesh, axis)
@@ -94,20 +127,40 @@ class ParallelInference:
         outs = self._jit(self._params, placed)
         return [o[:b] for o in outs] if pad else list(outs)
 
+    def bucket_for(self, b: int) -> Optional[int]:
+        """The smallest declared bucket holding a ``b``-row request;
+        None when ``b`` exceeds the largest (the chunked path) or no
+        buckets are declared."""
+        if self.buckets is None:
+            return None
+        for k in self.buckets:
+            if k >= b:
+                return k
+        return None
+
     def output(self, *xs: jax.Array) -> List[jax.Array]:
         """Inference forward, batch fanned out over the mesh — the drop-in
         parallel counterpart of ``ComputationGraph.output`` (same return
-        shape: one array per output layer)."""
+        shape: one array per output layer).  With ``buckets`` declared,
+        every dispatch shape is a bucket: requests pad up to the
+        smallest bucket that holds them, oversized requests chunk by
+        the largest — the compiled-program set stays closed."""
         if not xs:
             raise ValueError("output() needs at least one input array")
         b = xs[0].shape[0]
-        if self.max_batch is None or b <= self.max_batch:
+        if self.buckets is not None:
+            bucket = self.bucket_for(b)
+            if bucket is not None:
+                return self._dispatch(xs, pad_to=bucket)
+            chunk = self.buckets[-1]
+        elif self.max_batch is None or b <= self.max_batch:
             return self._dispatch(xs)
+        else:
+            chunk = self.max_batch
         chunks = []
-        for lo in range(0, b, self.max_batch):
+        for lo in range(0, b, chunk):
             chunks.append(self._dispatch(
-                [x[lo:lo + self.max_batch] for x in xs],
-                pad_to=self.max_batch))
+                [x[lo:lo + chunk] for x in xs], pad_to=chunk))
         return [jnp.concatenate(parts) for parts in zip(*chunks)]
 
     __call__ = output

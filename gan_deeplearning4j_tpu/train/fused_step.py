@@ -30,6 +30,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from gan_deeplearning4j_tpu.optim import ema as ema_lib
 from gan_deeplearning4j_tpu.runtime import prng
+from gan_deeplearning4j_tpu.telemetry import events as telemetry_events
 
 
 # Cap on lax.scan steps per dispatch (trainer auto mode and the
@@ -292,10 +293,16 @@ def make_protocol_step(
             raise ValueError(
                 "steps_per_call > 1 requires data_on_device=True (inner "
                 "steps slice their own batches from the resident dataset)")
-        # donation + scan trips an INVALID_ARGUMENT runtime error in the
-        # axon TPU backend (single-step donated programs are fine); the
-        # cost of not donating is one extra copy of the ~MB-scale state
-        donate = False
+        if donate:
+            # the scan-path donation exemption is OWNED by the program
+            # contract (analysis/contracts/fused_multi.json, exemption
+            # "scan-donation" — analysis/program.py holds the rationale)
+            # and verified from the actual lowering by gan4j-prove; the
+            # flip is announced, never silent
+            telemetry_events.instant(
+                "donation.disabled", reason="scan-donation",
+                steps_per_call=steps_per_call)
+            donate = False
         inner = step
 
         if chunk_indexed:

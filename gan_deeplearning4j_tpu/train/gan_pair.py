@@ -248,7 +248,11 @@ class GANPair:
         GLOBAL batch (bitwise the single-device stream) and slices its own
         shard, and grads/losses/BN stats pmean over the axis — the
         multi-replica fast path for the CelebA roadmap config.
-        Donation is off (donation + scan crashes the axon TPU runtime).
+        Donation is off under the scan — the exemption is owned and
+        verified by the program contract
+        (analysis/contracts/pair_multi.json, exemption "scan-donation";
+        gan4j-prove asserts the lowering carries NO input/output
+        aliasing, so this is a checked fact, not a comment).
         Returns (step_fn, state0):
           step_fn(state) -> (state', (d_losses[K], g_losses[K]))
           state = (params_g, opt_g, params_d, opt_d, it, ema_or_None)

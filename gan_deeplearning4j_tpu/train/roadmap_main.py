@@ -37,6 +37,10 @@ from gan_deeplearning4j_tpu.utils import (
 from gan_deeplearning4j_tpu.utils.async_dump import AsyncArtifactWriter
 
 FAMILIES = ("cgan-cifar10", "wgan-gp", "celeba")
+# the default --batch-size: a named constant because it is part of the
+# gan4j-prove bucket-coverage contract (analysis/program.py
+# reachable_pair_batches) — changing it requires a contract diff
+DEFAULT_BATCH_SIZE = 128
 
 
 SAMPLE_SHAPES = {
@@ -558,7 +562,7 @@ def main(argv=None) -> Dict[str, float]:
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("--family", choices=FAMILIES, required=True)
     p.add_argument("--iterations", type=int, default=2000)
-    p.add_argument("--batch-size", type=int, default=128)
+    p.add_argument("--batch-size", type=int, default=DEFAULT_BATCH_SIZE)
     p.add_argument("--res-path", default=None)
     p.add_argument("--n-train", type=int, default=10000)
     p.add_argument("--print-every", type=int, default=500)

@@ -613,6 +613,176 @@ def test_cli_refuses_vacuous_pass(tmp_path, capsys):
     assert "no .py files" in capsys.readouterr().err
 
 
+# -- --changed mode (PR 7 satellite: the pre-commit fast path) ---------------
+
+
+def _git_repo(tmp_path):
+    import subprocess
+
+    def git(*cmd):
+        subprocess.run(["git", "-C", str(tmp_path), *cmd], check=True,
+                       capture_output=True,
+                       env={"PATH": "/usr/bin:/bin:/usr/local/bin",
+                            "GIT_AUTHOR_NAME": "t",
+                            "GIT_AUTHOR_EMAIL": "t@t",
+                            "GIT_COMMITTER_NAME": "t",
+                            "GIT_COMMITTER_EMAIL": "t@t",
+                            "HOME": str(tmp_path)})
+
+    git("init", "-q")
+    (tmp_path / "tracked.py").write_text(CLEAN_SRC)
+    git("add", "tracked.py")
+    git("commit", "-qm", "seed")
+    return git
+
+
+def test_changed_empty_diff_is_clean_pass(tmp_path, capsys):
+    _git_repo(tmp_path)
+    assert cli.main([str(tmp_path), "--changed", "HEAD"]) == 0
+    assert "no changed .py files" in capsys.readouterr().out
+
+
+def test_changed_lints_tracked_modification(tmp_path):
+    _git_repo(tmp_path)
+    (tmp_path / "tracked.py").write_text(DIRTY_SRC)
+    assert cli.main([str(tmp_path), "--changed", "HEAD"]) == 1
+
+
+def test_changed_includes_untracked(tmp_path):
+    _git_repo(tmp_path)
+    (tmp_path / "fresh.py").write_text(DIRTY_SRC)
+    assert cli.main([str(tmp_path), "--changed", "HEAD"]) == 1
+
+
+def test_changed_skips_unchanged_dirty_file(tmp_path):
+    """A violation already committed at the ref is OUT of scope — the
+    mode gates the diff, not the tree."""
+    git = _git_repo(tmp_path)
+    (tmp_path / "old_dirt.py").write_text(DIRTY_SRC)
+    git("add", "old_dirt.py")
+    git("commit", "-qm", "dirt")
+    (tmp_path / "clean_new.py").write_text(CLEAN_SRC)
+    assert cli.main([str(tmp_path), "--changed", "HEAD"]) == 0
+
+
+def test_changed_finds_untracked_under_subdir_anchor(tmp_path):
+    """The default invocation anchors at a SUBDIRECTORY of the repo
+    (the installed package dir): untracked files must still be found —
+    ls-files prints cwd-relative paths, which must be joined from the
+    repo root like the diff's."""
+    _git_repo(tmp_path)
+    sub = tmp_path / "pkg"
+    sub.mkdir()
+    (sub / "fresh.py").write_text(DIRTY_SRC)
+    assert cli.main([str(sub), "--changed", "HEAD"]) == 1
+
+
+def test_changed_usage_errors(tmp_path, capsys):
+    # outside a git repo -> usage error, not a pass
+    (tmp_path / "a.py").write_text(CLEAN_SRC)
+    assert cli.main([str(tmp_path), "--changed", "HEAD"]) == 2
+    assert "not inside a git" in capsys.readouterr().err
+    # unknown ref -> usage error
+    _git_repo(tmp_path)
+    assert cli.main([str(tmp_path), "--changed", "no-such-ref"]) == 2
+    # --write-baseline over a partial subset is refused
+    with pytest.raises(SystemExit):
+        cli.main([str(tmp_path), "--changed", "HEAD",
+                  "--baseline", str(tmp_path / "bl.json"),
+                  "--write-baseline"])
+
+
+# -- --warn-unused-suppressions (the stale-suppression audit) ----------------
+
+
+def test_stale_suppression_flagged(tmp_path):
+    res = lint_src(tmp_path, """
+        def fine():
+            # gan4j-lint: disable=swallowed-exception — long gone
+            return 1
+    """, audit_suppressions=True)
+    assert rule_names(res) == ["unused-suppression"]
+    assert "never fired" in res.findings[0].message
+
+
+def test_used_suppression_not_flagged(tmp_path):
+    res = lint_src(tmp_path, """
+        def risky():
+            try:
+                return 1
+            except Exception:  # gan4j-lint: disable=swallowed-exception — fixture
+                pass
+    """, audit_suppressions=True)
+    assert res.findings == [] and len(res.suppressed) == 1
+
+
+def test_stale_disable_all_and_unknown_rule_flagged(tmp_path):
+    res = lint_src(tmp_path, """
+        x = 1  # gan4j-lint: disable=all — nothing here
+        y = 2  # gan4j-lint: disable=not-a-rule — renamed away
+    """, audit_suppressions=True)
+    msgs = sorted(f.message for f in res.findings)
+    assert len(msgs) == 2
+    assert "'disable=all' silenced nothing" in msgs[0]
+    assert "unknown rule" in msgs[1]
+
+
+def test_explicit_escape_hatch_silences_audit(tmp_path):
+    """Only a justified disable=unused-suppression silences an audit
+    finding — the audited directive's own 'all' must NOT (a stale
+    disable=all hiding its own staleness is the rot itself)."""
+    res = lint_src(tmp_path, """
+        # gan4j-lint: disable=unused-suppression — kept for doc parity
+        x = 1  # gan4j-lint: disable=swallowed-exception — long gone
+    """, audit_suppressions=True)
+    assert res.findings == [] and len(res.suppressed) == 1
+
+
+def test_unselected_rule_suppression_not_audited(tmp_path):
+    """Only a run that actually executed the rule can call its
+    suppression stale."""
+    res = lint_src(tmp_path, """
+        def fine():
+            # gan4j-lint: disable=swallowed-exception — unknowable here
+            return 1
+    """, rules=["prng-key-reuse"], audit_suppressions=True)
+    assert res.findings == []
+    # disable=all is equally unknowable under a partial rule set: the
+    # finding it silences may belong to a rule that did not run
+    res = lint_src(tmp_path, """
+        def risky():
+            try:
+                return 1
+            except Exception:  # gan4j-lint: disable=all — fixture
+                pass
+    """, rules=["prng-key-reuse"], audit_suppressions=True)
+    assert res.findings == []
+
+
+def test_docstring_directive_neither_suppresses_nor_audits(tmp_path):
+    """A docstring documenting the syntax is not a directive: it must
+    not silence the finding below it, and the audit must not call it
+    stale."""
+    res = lint_src(tmp_path, '''
+        def documented():
+            """Use # gan4j-lint: disable=swallowed-exception — why."""
+            try:
+                return 1
+            except Exception:
+                pass
+    ''', audit_suppressions=True)
+    assert rule_names(res) == ["swallowed-exception"]
+
+
+def test_audit_rides_the_cli_flag(tmp_path, capsys):
+    stale = tmp_path / "stale.py"
+    stale.write_text("# gan4j-lint: disable=swallowed-exception — x\n"
+                     "y = 1\n")
+    assert cli.main([str(stale)]) == 0  # off by default
+    assert cli.main([str(stale), "--warn-unused-suppressions"]) == 1
+    assert "unused-suppression" in capsys.readouterr().out
+
+
 # -- every rule trips the CLI gate (the injected-violation proof) ------------
 
 

@@ -1,0 +1,390 @@
+"""gan4j-prove: program contracts verified from the ACTUAL lowering
+(analysis/program.py + contracts.py + prove_cli.py — PR 7).
+
+Layout mirrors docs/STATIC_ANALYSIS.md#program-contracts:
+
+* the registry resolves every entry point on the 8-virtual-device test
+  topology and the repo verifies CLEAN against its committed contracts;
+* donation is proven from the compiled ``input_output_alias``, not the
+  source flag (dropping ``donate_argnums`` goes red), and the
+  scan-path exemption is contract-owned (aliasing APPEARING under the
+  exemption goes red too);
+* ``--write-contracts`` round-trips, and editing any contract field —
+  alias count, allowed dtype, collective count, byte ceiling, bucket
+  list — fails the matching check with a message naming the entry
+  point and field;
+* the selftest proves every one of the five contract classes CAN fire;
+* the CLI honors the exit-code contract (0 clean / 1 violations /
+  2 usage-or-zero-entry-points) the CI prove lane keys on;
+* the serving bucket mechanics (parallel/inference.py ``buckets``) pad
+  requests into the declared compile-shape set.
+
+The module-scoped ``proved`` fixture lowers/compiles each entry point
+exactly once (~15 s); every check and tamper test reuses those facts.
+"""
+
+import copy
+import json
+import shutil
+
+import numpy as np
+import pytest
+
+from gan_deeplearning4j_tpu.analysis import contracts as contracts_mod
+from gan_deeplearning4j_tpu.analysis import program as program_mod
+from gan_deeplearning4j_tpu.analysis import prove_cli
+
+ALL_ENTRIES = ("fused_single", "fused_multi", "sharded_step",
+               "pair_multi", "serving_infer")
+
+
+@pytest.fixture(scope="module")
+def proved(cpu_devices):
+    entries, skipped = program_mod.resolve()
+    assert not skipped, skipped  # 8 virtual devices: everything resolves
+    return {e.name: (e, program_mod.build_facts(e)) for e in entries}
+
+
+def _contract(name):
+    return contracts_mod.load_contract(contracts_mod.contracts_dir(),
+                                       name)
+
+
+# -- the green path -----------------------------------------------------------
+
+
+def test_registry_covers_the_entry_points():
+    assert set(program_mod.all_entry_points()) >= set(ALL_ENTRIES)
+    assert len(ALL_ENTRIES) >= 4  # the acceptance floor
+
+
+def test_repo_contracts_clean(proved):
+    for name, (entry, facts) in proved.items():
+        contract = _contract(name)
+        assert contract is not None, f"{name}: no committed contract"
+        violations = contracts_mod.check_entry(entry, contract, facts)
+        assert violations == [], [v.message for v in violations]
+
+
+def test_donation_verified_from_lowering_not_source(proved):
+    """The single-step fused path: the check reads the compiled
+    module's input_output_alias, and the committed contract pins the
+    exact aliased-parameter count."""
+    _, facts = proved["fused_single"]
+    assert facts[0].declared_donated_leaves > 0
+    assert facts[0].aliased_params, \
+        "compiled fused step carries no input/output aliasing"
+    contract = _contract("fused_single")
+    assert (len(facts[0].aliased_params)
+            == contract["donation"]["aliased_leaves"])
+    # and every aliased parameter is inside the donated-state range
+    assert max(facts[0].aliased_params) < facts[0].declared_donated_leaves
+
+
+def test_dropped_donation_goes_red(proved):
+    """A wrapper that loses donate_argnums must fail the donation
+    check against the committed contract."""
+    entry, _ = proved["fused_single"]
+    facts = program_mod.build_facts(entry, donate=False)
+    violations = contracts_mod.check_entry(entry, _contract(entry.name),
+                                           facts)
+    assert any(v.contract_class == "donation" for v in violations)
+    assert all("fused_single" in v.message for v in violations)
+
+
+def test_scan_exemption_is_contract_owned(proved):
+    for name in ("fused_multi", "pair_multi"):
+        _, facts = proved[name]
+        assert facts[0].aliased_params == [], \
+            f"{name}: scan path unexpectedly aliases"
+        contract = _contract(name)
+        assert (contract["donation"]["exemption"]["id"]
+                == "scan-donation")
+
+
+def test_exemption_violated_when_aliasing_appears(proved):
+    """If the builder stops dropping donation under scan, the exempted
+    contract must go red (the exemption is an assertion, not a pass)."""
+    entry, facts = proved["fused_multi"]
+    forged = [copy.copy(f) for f in facts]
+    forged[0].aliased_params = [0, 1, 2]
+    violations = contracts_mod.check_entry(entry, _contract(entry.name),
+                                           forged)
+    assert any(v.contract_class == "donation"
+               and "scan-donation" in v.message for v in violations)
+
+
+def test_sharded_collective_budget_pinned(proved):
+    _, facts = proved["sharded_step"]
+    contract = _contract("sharded_step")
+    assert contract["collectives"].get("all-reduce", 0) > 0
+    assert facts[0].collectives["all-reduce"] == \
+        contract["collectives"]["all-reduce"]
+
+
+def test_serving_has_no_collectives_and_covers_buckets(proved):
+    """The inference-exactness claim as a contract: zero cross-batch
+    reductions, and one lowered variant per declared bucket."""
+    from gan_deeplearning4j_tpu.parallel.inference import (
+        DEFAULT_SERVING_BUCKETS,
+    )
+
+    _, facts = proved["serving_infer"]
+    assert all(not f.collectives for f in facts)
+    assert sorted(f.batch for f in facts) == \
+        sorted(DEFAULT_SERVING_BUCKETS)
+
+
+def test_reachable_batches_enumerate_the_bench():
+    from gan_deeplearning4j_tpu import bench
+
+    reach = program_mod.reachable_protocol_batches()
+    for b in (bench.DRYRUN_BATCH, bench.DEFAULT_BATCH, bench.FAST_BATCH):
+        assert b in reach
+    assert bench.CELEBA_BATCH in program_mod.reachable_pair_batches()
+
+
+# -- contract round-trip + per-field tampering --------------------------------
+
+
+def test_write_contracts_roundtrip(tmp_path, proved):
+    for name, (entry, facts) in proved.items():
+        contracts_mod.write_contract(str(tmp_path), entry, facts)
+        contract = contracts_mod.load_contract(str(tmp_path), name)
+        violations = contracts_mod.check_entry(entry, contract, facts)
+        assert violations == [], [v.message for v in violations]
+
+
+def test_missing_contract_is_a_violation(proved):
+    entry, facts = proved["fused_single"]
+    violations = contracts_mod.check_entry(entry, None, facts)
+    assert [v.contract_class for v in violations] == ["contract"]
+    assert "write-contracts" in violations[0].message
+
+
+def test_contract_version_mismatch_raises(tmp_path):
+    path = contracts_mod.contract_path(str(tmp_path), "fused_single")
+    with open(path, "w") as f:
+        json.dump({"version": 99, "entry_point": "fused_single"}, f)
+    with pytest.raises(ValueError, match="version"):
+        contracts_mod.load_contract(str(tmp_path), "fused_single")
+
+
+def _fast_batch():
+    from gan_deeplearning4j_tpu import bench
+
+    return bench.FAST_BATCH
+
+
+TAMPERS = [
+    ("fused_single", "donation", "donation.aliased_leaves",
+     lambda c: c["donation"].update(
+         aliased_leaves=c["donation"]["aliased_leaves"] + 1)),
+    ("fused_single", "dtype", "dtypes.allowed",
+     lambda c: c["dtypes"].update(
+         allowed=[d for d in c["dtypes"]["allowed"] if d != "i64"])),
+    ("sharded_step", "collectives", "collectives.all-reduce",
+     lambda c: c["collectives"].update(
+         {"all-reduce": c["collectives"]["all-reduce"] - 1})),
+    ("fused_single", "peak-hbm", "peak_hbm.bytes_ceiling",
+     lambda c: c["peak_hbm"].update(bytes_ceiling=1)),
+    ("fused_single", "buckets", "buckets.declared",
+     lambda c: c["buckets"].update(
+         declared=[b for b in c["buckets"]["declared"]
+                   if b != _fast_batch()])),
+]
+
+
+@pytest.mark.parametrize("name,cls,field,mutate", TAMPERS,
+                         ids=[t[1] for t in TAMPERS])
+def test_contract_edit_fails_matching_check(proved, name, cls, field,
+                                            mutate):
+    """Editing one contract field fails exactly the matching class,
+    with a message naming the entry point, and leaves the other four
+    classes green."""
+    entry, facts = proved[name]
+    contract = copy.deepcopy(_contract(name))
+    mutate(contract)
+    violations = contracts_mod.check_entry(entry, contract, facts)
+    assert violations, f"tampered {field} produced no violation"
+    assert {v.contract_class for v in violations} == {cls}
+    assert any(v.field == field for v in violations)
+    assert all(name in v.message for v in violations)
+
+
+def test_selftest_every_class_can_fire(cpu_devices):
+    result = contracts_mod.selftest()
+    assert result["ok"], result
+    assert set(result["classes"]) == set(contracts_mod.CONTRACT_CLASSES)
+    for cls, rec in result["classes"].items():
+        assert rec["fired"], f"{cls} injection did not fire"
+
+
+# -- the CLI exit-code contract -----------------------------------------------
+
+
+def test_cli_exit0_on_repo_subset(cpu_devices, capsys):
+    assert prove_cli.main(["--entries", "pair_multi"]) == 0
+    assert "0 violation(s)" in capsys.readouterr().out
+
+
+def test_cli_exit1_on_tampered_contract(cpu_devices, tmp_path, capsys):
+    src = contracts_mod.contracts_dir()
+    for name in ALL_ENTRIES:
+        shutil.copy(contracts_mod.contract_path(src, name),
+                    contracts_mod.contract_path(str(tmp_path), name))
+    path = contracts_mod.contract_path(str(tmp_path), "pair_multi")
+    with open(path) as f:
+        doc = json.load(f)
+    doc["collectives"]["all-gather"] = 3
+    with open(path, "w") as f:
+        json.dump(doc, f)
+    rc = prove_cli.main(["--entries", "pair_multi",
+                         "--contracts", str(tmp_path)])
+    out = capsys.readouterr().out
+    assert rc == 1
+    assert "collectives" in out and "pair_multi" in out
+
+
+def test_cli_exit2_on_unknown_entry(capsys):
+    assert prove_cli.main(["--entries", "not_an_entry"]) == 2
+    assert "unknown entry" in capsys.readouterr().err
+
+
+def test_cli_exit2_on_zero_resolved(monkeypatch, capsys):
+    """A host too small for every requested entry point must exit 2 —
+    a prover that proved nothing is not green."""
+    import jax
+
+    real = jax.devices()
+    monkeypatch.setattr(jax, "devices", lambda *a, **k: real[:1])
+    rc = prove_cli.main(["--entries", "sharded_step"])
+    assert rc == 2
+    assert "vacuous" in capsys.readouterr().err
+
+
+def test_cli_write_then_verify(cpu_devices, tmp_path, capsys):
+    assert prove_cli.main(["--entries", "pair_multi",
+                           "--contracts", str(tmp_path),
+                           "--write-contracts"]) == 0
+    assert prove_cli.main(["--entries", "pair_multi",
+                           "--contracts", str(tmp_path)]) == 0
+    out = capsys.readouterr().out
+    assert "contract written" in out
+
+
+def test_cli_json_report(cpu_devices, tmp_path):
+    out_path = tmp_path / "prove.json"
+    assert prove_cli.main(["--entries", "fused_multi", "--format",
+                           "json", "--output", str(out_path)]) == 0
+    doc = json.loads(out_path.read_text())
+    assert doc["summary"]["ok"] is True
+    assert doc["entries"]["fused_multi"]["facts"][0]["aliased_params"] \
+        == []
+
+
+def test_cli_list_entries(capsys):
+    assert prove_cli.main(["--list-entries"]) == 0
+    out = capsys.readouterr().out
+    for name in ALL_ENTRIES:
+        assert name in out
+
+
+# -- the donation.disabled telemetry event (PR 7 satellite) -------------------
+
+
+def test_scan_donation_flip_emits_event(tmp_path):
+    from gan_deeplearning4j_tpu.models import mlpgan_insurance as I
+    from gan_deeplearning4j_tpu.telemetry import events as events_mod
+    from gan_deeplearning4j_tpu.train import fused_step as fused
+
+    path = str(tmp_path / events_mod.EVENTS_NAME)
+    rec = events_mod.EventRecorder(path=path)
+    prev = events_mod.install(rec)
+    try:
+        dis, gen, gan = (I.build_discriminator(), I.build_generator(),
+                         I.build_gan())
+        clf = I.build_classifier(dis)
+        fused.make_protocol_step(
+            dis, gen, gan, clf, I.DIS_TO_GAN, I.GAN_TO_GEN,
+            I.DIS_TO_CLASSIFIER, z_size=2, num_features=12,
+            data_on_device=True, steps_per_call=2, donate=True)
+        rec.flush()
+    finally:
+        events_mod.install(prev)
+        rec.close()
+    evs = [e for e in events_mod.read_events(path)
+           if e.get("name") == "donation.disabled"]
+    assert len(evs) == 1  # announced exactly once per program build
+    assert evs[0]["reason"] == "scan-donation"
+
+
+def test_scan_donation_not_emitted_when_caller_opted_out(tmp_path):
+    from gan_deeplearning4j_tpu.models import mlpgan_insurance as I
+    from gan_deeplearning4j_tpu.telemetry import events as events_mod
+    from gan_deeplearning4j_tpu.train import fused_step as fused
+
+    path = str(tmp_path / events_mod.EVENTS_NAME)
+    rec = events_mod.EventRecorder(path=path)
+    prev = events_mod.install(rec)
+    try:
+        dis, gen, gan = (I.build_discriminator(), I.build_generator(),
+                         I.build_gan())
+        clf = I.build_classifier(dis)
+        fused.make_protocol_step(
+            dis, gen, gan, clf, I.DIS_TO_GAN, I.GAN_TO_GEN,
+            I.DIS_TO_CLASSIFIER, z_size=2, num_features=12,
+            data_on_device=True, steps_per_call=2, donate=False)
+        rec.flush()
+    finally:
+        events_mod.install(prev)
+        rec.close()
+    assert not [e for e in events_mod.read_events(path)
+                if e.get("name") == "donation.disabled"]
+
+
+# -- serving buckets (parallel/inference.py) ----------------------------------
+
+
+def _serving_pi(buckets):
+    import jax
+
+    from gan_deeplearning4j_tpu.models import mlpgan_insurance as I
+    from gan_deeplearning4j_tpu.parallel.inference import ParallelInference
+    from jax.sharding import Mesh
+
+    gen = I.build_generator()
+    mesh = Mesh(np.asarray(jax.devices()[:2]), ("data",))
+    return gen, ParallelInference(gen, mesh=mesh, buckets=buckets)
+
+
+def test_bucketed_dispatch_matches_reference(cpu_devices):
+    gen, pi = _serving_pi((8, 16))
+    for n in (3, 8, 9, 16, 20):  # pad-up, exact, round-up, chunked
+        z = np.random.RandomState(n).rand(n, 2).astype(np.float32)
+        np.testing.assert_allclose(
+            np.asarray(gen.output(z)[0]), np.asarray(pi.output(z)[0]),
+            rtol=2e-6, atol=2e-7)
+
+
+def test_bucket_for_rounds_up(cpu_devices):
+    _, pi = _serving_pi((8, 16))
+    assert pi.bucket_for(1) == 8
+    assert pi.bucket_for(8) == 8
+    assert pi.bucket_for(9) == 16
+    assert pi.bucket_for(17) is None  # chunked by the largest bucket
+
+
+def test_bucket_validation(cpu_devices):
+    import jax
+
+    from gan_deeplearning4j_tpu.models import mlpgan_insurance as I
+    from gan_deeplearning4j_tpu.parallel.inference import ParallelInference
+    from jax.sharding import Mesh
+
+    gen = I.build_generator()
+    mesh = Mesh(np.asarray(jax.devices()[:2]), ("data",))
+    with pytest.raises(ValueError, match="shard evenly"):
+        ParallelInference(gen, mesh=mesh, buckets=(3,))
+    with pytest.raises(ValueError, match="largest"):
+        ParallelInference(gen, mesh=mesh, buckets=(8, 16), max_batch=8)
