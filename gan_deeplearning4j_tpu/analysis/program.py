@@ -337,11 +337,23 @@ def _build_sharded_step(donate: bool = True) -> List[Built]:
     import numpy as np
     from jax.sharding import Mesh
 
-    mesh = Mesh(np.asarray(jax.devices()[:2]), ("data",))
-    step, args, state, b = _mnist_protocol(mesh=mesh, donate=donate)
-    return [Built("spmd2", step, args,
-                  len(jax.tree.leaves(state)) if donate else 0, b,
-                  mesh_shape={"data": 2})]
+    # TWO mesh sizes on purpose (elastic resume, parallel/elastic.py):
+    # a shrink/grow resume moves the SAME program between device
+    # counts, so the contract must hold at both — identical collective
+    # schedule and dtype set, only the per-device shard changes.  The
+    # 4-device variant joins wherever the host attaches enough devices
+    # (the CI lane forces 8; a 2-device host proves spmd2 alone).
+    built = []
+    for n in (2, 4):
+        if n > len(jax.devices()):
+            continue
+        mesh = Mesh(np.asarray(jax.devices()[:n]), ("data",))
+        step, args, state, b = _mnist_protocol(mesh=mesh, donate=donate)
+        built.append(Built(
+            f"spmd{n}", step, args,
+            len(jax.tree.leaves(state)) if donate else 0, b,
+            mesh_shape={"data": n}))
+    return built
 
 
 def _build_pair_multi(donate: bool = False) -> List[Built]:
@@ -426,8 +438,9 @@ register_entry(EntryPoint(
 
 register_entry(EntryPoint(
     name="sharded_step",
-    summary="fused protocol step as a shard_map SPMD program over a "
-            "2-device data mesh (parallel/ collective schedule)",
+    summary="fused protocol step as a shard_map SPMD program, lowered "
+            "at 2- AND 4-device data meshes (parallel/ collective "
+            "schedule; elastic resume moves between device counts)",
     build=_build_sharded_step,
     needs_devices=2,
 ))

@@ -26,9 +26,11 @@ from gan_deeplearning4j_tpu.checkpoint.async_checkpointer import (
 )
 from gan_deeplearning4j_tpu.checkpoint.checkpointer import (
     CheckpointCorruptError,
+    CheckpointMeshMismatchError,
     NoVerifiedCheckpointError,
     TrainCheckpointer,
 )
 
 __all__ = ["AsyncCheckpointer", "CheckpointCorruptError",
-           "NoVerifiedCheckpointError", "TrainCheckpointer"]
+           "CheckpointMeshMismatchError", "NoVerifiedCheckpointError",
+           "TrainCheckpointer"]

@@ -41,6 +41,7 @@ import weakref
 from typing import Dict, Optional
 
 from gan_deeplearning4j_tpu.checkpoint.checkpointer import (
+    _NO_TARGET,
     TrainCheckpointer,
     snapshot_state,
 )
@@ -118,7 +119,8 @@ class AsyncCheckpointer:
     # -- API -----------------------------------------------------------------
 
     def save(self, step: int, graphs: Dict[str, object],
-             extra: Optional[Dict] = None) -> str:
+             extra: Optional[Dict] = None,
+             mesh_spec: Optional[Dict] = None) -> str:
         """Barrier on the previous save, snapshot on THIS thread, enqueue
         serialization.  Returns the final checkpoint path (valid once the
         worker commits it — call ``wait()`` for durability)."""
@@ -126,7 +128,8 @@ class AsyncCheckpointer:
 
         self.wait()  # barrier at the next save; surfaces worker errors
         with events.span("checkpoint.snapshot", step=step):
-            snap = snapshot_state(graphs, step, extra)
+            snap = snapshot_state(graphs, step, extra,
+                                  mesh_spec=mesh_spec)
         if self._closed:  # post-close (atexit ordering): degrade to sync
             return self.inner.write_snapshot(snap)
         self._q.put(snap)
@@ -179,9 +182,14 @@ class AsyncCheckpointer:
 
     def restore(self, graphs: Dict[str, object],
                 step: Optional[int] = None,
-                max_step: Optional[int] = None):
+                max_step: Optional[int] = None, target_mesh=_NO_TARGET):
         self.wait()
-        return self.inner.restore(graphs, step, max_step=max_step)
+        return self.inner.restore(graphs, step, max_step=max_step,
+                                  target_mesh=target_mesh)
+
+    def mesh_spec(self, step: int) -> Optional[Dict]:
+        self.wait()
+        return self.inner.mesh_spec(step)
 
     def prune_above(self, step: int) -> list:
         self.wait()

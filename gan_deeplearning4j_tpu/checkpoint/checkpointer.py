@@ -59,6 +59,16 @@ class CheckpointCorruptError(RuntimeError):
     """An explicitly requested checkpoint failed manifest verification."""
 
 
+class CheckpointMeshMismatchError(ValueError):
+    """The checkpoint was written under a different device topology and
+    the caller named no target mesh to reshard onto.  A ValueError on
+    purpose: the recovery wrapper classifies it FATAL — a blind restart
+    replays the identical mismatch; only a caller decision (pass
+    ``target_mesh=`` / re-form the mesh elastically) fixes it.  Before
+    this error existed the mismatch surfaced as an opaque
+    shape/sharding error deep inside ``device_put``."""
+
+
 class NoVerifiedCheckpointError(FileNotFoundError):
     """No checkpoint in the directory verifies and loads.  Callers that
     can fall back to a from-scratch run (deterministic replay) should
@@ -99,8 +109,15 @@ def _sha256(path: str) -> str:
     return h.hexdigest()
 
 
+# explicitly-not-passed sentinel for restore(target_mesh=...): ``None``
+# is a VALID target (the single-device, no-mesh trainer), so absence
+# needs its own value
+_NO_TARGET = object()
+
+
 def snapshot_state(graphs: Dict[str, object], step: int,
-                   extra: Optional[Dict] = None) -> Dict:
+                   extra: Optional[Dict] = None,
+                   mesh_spec: Optional[Dict] = None) -> Dict:
     """The training-thread half of a save: capture config dicts and HOST
     copies of every param/updater/extra array.  After this returns, the
     live graphs may keep training — serialization reads only the
@@ -132,7 +149,13 @@ def snapshot_state(graphs: Dict[str, object], step: int,
             arrays[k] = np.asarray(v)
     if pytrees:
         scalars["pytree_extras"] = sorted(pytrees)
-    return {"graphs": graph_parts, "scalars": scalars, "arrays": arrays}
+    snap = {"graphs": graph_parts, "scalars": scalars, "arrays": arrays}
+    if mesh_spec is not None:
+        # the saving topology (parallel/elastic.py MeshSpec.to_dict),
+        # committed into MANIFEST.json by write_snapshot so a restore
+        # can detect a world-size change BEFORE touching any array
+        snap["mesh_spec"] = dict(mesh_spec)
+    return snap
 
 
 class TrainCheckpointer:
@@ -201,11 +224,15 @@ class TrainCheckpointer:
     # -- save ----------------------------------------------------------------
 
     def save(self, step: int, graphs: Dict[str, object],
-             extra: Optional[Dict] = None) -> str:
+             extra: Optional[Dict] = None,
+             mesh_spec: Optional[Dict] = None) -> str:
         """Write ``ckpt_{step}`` atomically (manifest-verified, fsynced);
         prune beyond ``keep``.  Snapshot + serialize on this thread; the
-        async wrapper calls the two halves on different threads."""
-        return self.write_snapshot(snapshot_state(graphs, step, extra))
+        async wrapper calls the two halves on different threads.
+        ``mesh_spec``: the saving topology (elastic resume), landed in
+        the manifest."""
+        return self.write_snapshot(
+            snapshot_state(graphs, step, extra, mesh_spec=mesh_spec))
 
     def write_snapshot(self, snap: Dict) -> str:
         """Serialize a ``snapshot_state`` result to ``ckpt_{step}`` —
@@ -248,9 +275,11 @@ class TrainCheckpointer:
                 # LAST, so a manifest that parses implies every listed
                 # byte hit the disk before it
                 mpath = os.path.join(tmp, MANIFEST_NAME)
+                manifest: Dict = {"step": step, "files": entries}
+                if snap.get("mesh_spec") is not None:
+                    manifest["mesh_spec"] = snap["mesh_spec"]
                 with open(mpath, "w") as f:
-                    json.dump({"step": step, "files": entries}, f,
-                              indent=1)
+                    json.dump(manifest, f, indent=1)
                 _fsync_file(mpath)
                 _fsync_dir(tmp)
                 _chaos("manifest")
@@ -333,6 +362,19 @@ class TrainCheckpointer:
                 return s
         return None
 
+    def mesh_spec(self, step: int) -> Optional[Dict]:
+        """The saving topology stamped into ``ckpt_{step}``'s manifest
+        (a ``parallel/elastic.py`` MeshSpec dict), or None for
+        pre-elastic checkpoints — whose restores keep the old trust-
+        the-caller behavior, there being nothing to check against."""
+        path = os.path.join(self.directory, f"ckpt_{step}", MANIFEST_NAME)
+        try:
+            with open(path) as f:
+                spec = json.load(f).get("mesh_spec")
+        except (OSError, ValueError):
+            return None
+        return spec if isinstance(spec, dict) else None
+
     # -- restore -------------------------------------------------------------
 
     def steps(self) -> list:
@@ -367,7 +409,7 @@ class TrainCheckpointer:
 
     def restore(
         self, graphs: Dict[str, object], step: Optional[int] = None,
-        max_step: Optional[int] = None,
+        max_step: Optional[int] = None, target_mesh=_NO_TARGET,
     ) -> Tuple[int, Dict]:
         """Load params + updater state into the given graphs (in place).
 
@@ -386,7 +428,19 @@ class TrainCheckpointer:
         Structure mismatches (graph set / params / opt_state trees) are
         NOT corruption — they mean the caller resumed with different
         flags and always raise ``ValueError`` (the recovery wrapper
-        classifies that as fatal, not retryable)."""
+        classifies that as fatal, not retryable).
+
+        ``target_mesh`` (elastic resume, parallel/elastic.py): the mesh
+        this restore lands on — a ``jax.sharding.Mesh`` or ``None`` for
+        the single-device no-mesh trainer.  When the checkpoint's
+        recorded ``mesh_spec`` differs, params/opt-state are RESHARDED
+        onto the target (gather-to-host → ``device_put`` replicated;
+        bit-equal post-gather) and ``extra["__reshard__"]`` reports the
+        from/to topologies and the time paid.  NOT passing it keeps the
+        legacy behavior — except that a checkpoint whose saved topology
+        cannot even be rebuilt on this host (more devices than
+        attached) now raises ``CheckpointMeshMismatchError`` naming
+        both shapes instead of an opaque sharding error downstream."""
         if step is not None:
             path = os.path.join(self.directory, f"ckpt_{step}")
             if not os.path.isdir(path):
@@ -405,7 +459,7 @@ class TrainCheckpointer:
                     raise CheckpointCorruptError(
                         f"checkpoint ckpt_{step} in {self.directory} "
                         "fails manifest verification (torn or corrupt)")
-            return self._load(step, graphs)
+            return self._load_elastic(step, graphs, target_mesh)
         candidates = self.steps()
         if max_step is not None:
             candidates = [s for s in candidates if s <= max_step]
@@ -426,7 +480,7 @@ class TrainCheckpointer:
                     "corrupt); falling back to the previous one", s)
                 continue
             try:
-                return self._load(s, graphs)
+                return self._load_elastic(s, graphs, target_mesh)
             except ValueError:
                 raise  # structure mismatch: fatal, not corruption
             except Exception as e:  # unreadable despite the manifest
@@ -441,7 +495,7 @@ class TrainCheckpointer:
                 "checkpoint ckpt_%d predates the manifest format "
                 "(unverifiable); attempting restore", s)
             try:
-                return self._load(s, graphs)
+                return self._load_elastic(s, graphs, target_mesh)
             except ValueError:
                 raise
             except Exception as e:
@@ -450,6 +504,64 @@ class TrainCheckpointer:
         raise NoVerifiedCheckpointError(
             f"no VERIFIED checkpoint in {self.directory} "
             f"(all of {candidates} torn or corrupt)")
+
+    def _load_elastic(self, step: int, graphs: Dict[str, object],
+                      target_mesh) -> Tuple[int, Dict]:
+        """``_load`` plus the elastic-mesh contract: guard the
+        topology mismatch BEFORE touching any array, then reshard the
+        loaded params/opt-state onto the target mesh when the saved
+        spec differs (parallel/elastic.py).  Pre-elastic checkpoints
+        (no recorded mesh_spec) keep the legacy load."""
+        saved = self.mesh_spec(step)
+        if saved is None:
+            return self._load(step, graphs)
+        from gan_deeplearning4j_tpu.parallel.elastic import (
+            MeshSpec,
+            reshard,
+        )
+
+        saved_spec = MeshSpec.from_dict(saved)
+        if target_mesh is _NO_TARGET:
+            import jax
+
+            avail = len(jax.devices())
+            if saved_spec.device_count > avail:
+                raise CheckpointMeshMismatchError(
+                    f"checkpoint ckpt_{step} in {self.directory} was "
+                    f"written on mesh {saved_spec.describe()} but this "
+                    f"host attaches only {avail} device(s); pass "
+                    f"target_mesh= to reshard onto the surviving "
+                    f"topology (docs/FAULT_TOLERANCE.md § Elastic "
+                    f"resume)")
+            return self._load(step, graphs)
+        target_spec = MeshSpec.from_mesh(target_mesh)
+        out = self._load(step, graphs)
+        if saved_spec.same_topology(target_spec):
+            return out
+        import time as _time
+
+        import jax
+
+        t0 = _time.perf_counter()
+        if target_mesh is None:
+            sharding = jax.sharding.SingleDeviceSharding(jax.devices()[0])
+        else:
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            sharding = NamedSharding(target_mesh, PartitionSpec())
+        for graph in graphs.values():
+            graph.params = reshard(graph.params, sharding)
+            graph.opt_state = reshard(graph.opt_state, sharding)
+        dt = _time.perf_counter() - t0
+        _log.warning(
+            "resharded checkpoint ckpt_%d from mesh %s onto %s in "
+            "%.3fs (values bit-equal post-gather)", step,
+            saved_spec.describe(), target_spec.describe(), dt)
+        step_out, extra = out
+        extra["__reshard__"] = {"from": saved_spec.to_dict(),
+                                "to": target_spec.to_dict(),
+                                "seconds": dt}
+        return step_out, extra
 
     def _load(self, step: int, graphs: Dict[str, object]) -> Tuple[int, Dict]:
         path = os.path.join(self.directory, f"ckpt_{step}")

@@ -1,0 +1,249 @@
+"""Elastic mesh — reshard-on-restore and world-size-elastic recovery.
+
+The fleet already survives crashes, hangs, NaNs and poisoned data
+(PRs 2/4/5) — but only back onto the *same* mesh shape.  This module is
+the missing tier (ROADMAP item 4): a checkpoint written on 8 devices
+must restore on 4 (or 16), and a preempted fleet must re-form with
+whatever hosts come back instead of demanding the original world size.
+
+Three pieces:
+
+* :class:`MeshSpec` — the saving topology, stamped into every
+  checkpoint's ``MANIFEST.json``: axis names/sizes, device and process
+  counts, and the per-role sharding of the state (params/opt-state are
+  replicated under the data-parallel protocol; the batch axis is what
+  shards).  A restore compares the saved spec against the restoring
+  mesh and reshards on mismatch instead of trusting the topologies
+  match (checkpoint/checkpointer.py ``restore(target_mesh=...)``).
+* :func:`reshard` — the mechanism: gather every leaf to host (the
+  checkpoint already holds host arrays; live arrays take one
+  ``device_get``) and ``device_put`` with the *target* mesh's
+  ``NamedSharding``.  Values are bit-equal post-gather by construction
+  — resharding moves bytes, never rounds them.
+* :func:`pack_iter_state` / :func:`unpack_iter_state` /
+  :func:`merge_iter_states` / :func:`split_iter_state` — the O(1)
+  data-plane cursor (data/csv.py state contract) across world-size
+  changes.  Checkpoints stamp the boundary-aligned stash, and under
+  SPMD lockstep every host's boundary position is equal by
+  construction — so the pack is a broadcast of the local cursor (no
+  collective on the save path), and the restore-side merge is
+  defensive: it verifies that equality and resolves any disagreement
+  (a checkpoint from a writer without the boundary-stash guarantee)
+  to the *lagging* position (lexicographic min of (epoch, cursor)),
+  so a record can be re-fed to a replica but never dropped.  The
+  re-split broadcasts the merged position to the new host count.
+  Both directions are pure functions of their inputs — deterministic
+  by construction.
+
+The batch-rebucket rule lives with the trainer (train/gan_trainer.py):
+the GLOBAL batch is invariant across resumes (it is part of the
+protocol's math — changing it would change the trajectory, not just
+the layout); the re-formed mesh is the largest divisor of the global
+batch that fits the surviving devices, so only the per-device shard
+grows or shrinks.  gan4j-prove's bucket contracts key on the global
+batch, which is exactly the quantity held fixed.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+from typing import Dict, List, Optional, Sequence
+
+_log = logging.getLogger(__name__)
+
+# iter-state wrapper version (the packed multi-host form); bumped only
+# if the wrapper layout itself changes — the inner states carry the
+# data/csv.py shuffle contract and version themselves
+ITER_STATE_PACK_VERSION = 1
+
+# sharding-role vocabulary a MeshSpec records.  "replicated" is the
+# data-parallel protocol's answer for params/opt-state; the batch role
+# names the mesh axis it shards over.
+ROLE_PARAMS = "params"
+ROLE_OPT_STATE = "opt_state"
+ROLE_BATCH = "batch"
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshSpec:
+    """The topology a checkpoint was written under — everything a
+    restore needs to decide "same mesh, load as-is" vs "reshard".
+
+    ``axes`` preserves mesh axis order (dict insertion order);
+    ``sharding`` maps state roles to either ``"replicated"`` or the
+    axis name their leading dim shards over."""
+
+    axes: Dict[str, int]
+    device_count: int
+    process_count: int = 1
+    sharding: Dict[str, str] = dataclasses.field(default_factory=dict)
+
+    @classmethod
+    def from_mesh(cls, mesh, process_count: Optional[int] = None,
+                  batch_axis: str = "data") -> "MeshSpec":
+        """Spec of a live ``jax.sharding.Mesh`` (``mesh=None`` = the
+        single-device, no-mesh trainer) under the data-parallel
+        protocol's sharding roles."""
+        import jax
+
+        if process_count is None:
+            process_count = jax.process_count()
+        if mesh is None:
+            return cls(axes={batch_axis: 1}, device_count=1,
+                       process_count=process_count,
+                       sharding={ROLE_PARAMS: "replicated",
+                                 ROLE_OPT_STATE: "replicated",
+                                 ROLE_BATCH: batch_axis})
+        axes = {str(k): int(v) for k, v in dict(mesh.shape).items()}
+        return cls(axes=axes, device_count=int(mesh.devices.size),
+                   process_count=process_count,
+                   sharding={ROLE_PARAMS: "replicated",
+                             ROLE_OPT_STATE: "replicated",
+                             ROLE_BATCH: batch_axis})
+
+    def to_dict(self) -> Dict:
+        return {"axes": dict(self.axes),
+                "device_count": int(self.device_count),
+                "process_count": int(self.process_count),
+                "sharding": dict(self.sharding)}
+
+    @classmethod
+    def from_dict(cls, doc: Dict) -> "MeshSpec":
+        return cls(axes={str(k): int(v)
+                         for k, v in (doc.get("axes") or {}).items()},
+                   device_count=int(doc.get("device_count", 1)),
+                   process_count=int(doc.get("process_count", 1)),
+                   sharding={str(k): str(v) for k, v in
+                             (doc.get("sharding") or {}).items()})
+
+    def describe(self) -> str:
+        """Human shape for error messages: ``{'data': 8} (8 devices,
+        1 process)``."""
+        return (f"{self.axes} ({self.device_count} device"
+                f"{'s' if self.device_count != 1 else ''}, "
+                f"{self.process_count} process"
+                f"{'es' if self.process_count != 1 else ''})")
+
+    def same_topology(self, other: "MeshSpec") -> bool:
+        """True when a checkpoint written under ``self`` loads onto
+        ``other`` without resharding (axis layout and world identical;
+        the sharding roles ride along with the axes)."""
+        return (self.axes == other.axes
+                and self.device_count == other.device_count
+                and self.process_count == other.process_count)
+
+
+def reshard(tree, sharding):
+    """Place every leaf of ``tree`` under ``sharding`` (a
+    ``NamedSharding`` on the *target* mesh, or any ``jax.sharding``
+    placement) via gather-to-host → ``device_put``.
+
+    Leaves already on host (the checkpoint-restore path) transfer
+    directly; device-resident leaves are gathered first — ``np.asarray``
+    on a sharded jax.Array assembles the full logical value, which is
+    exactly the "post-gather" form the bit-equality contract is stated
+    over.  No arithmetic happens in either direction."""
+    import jax
+    import numpy as np
+
+    return jax.tree.map(
+        lambda x: jax.device_put(np.asarray(x), sharding), tree)
+
+
+# -- iterator state across world sizes ----------------------------------------
+
+
+def pack_iter_state(state: Dict, process_count: int) -> Dict:
+    """The checkpoint form of the O(1) iterator state.  Single process
+    keeps the bare data/csv.py state dict (bit-compatible with every
+    pre-elastic checkpoint); a multi-host fleet wraps ``process_count``
+    copies of the BOUNDARY-ALIGNED local cursor.
+
+    Why a broadcast is the fleet truth, not a shortcut: checkpoints
+    are only ever stamped from the step-boundary stash
+    (gan_trainer._stash_iter_state — the mid-step emergency save reads
+    the stash too), and under SPMD lockstep every host's consumed
+    position at a boundary is EQUAL by construction, so the local
+    cursor IS each host's cursor — no gather needed, no collective on
+    the save path.  The merge machinery on the restore side is the
+    DEFENSIVE half: it validates that equality on checkpoints from
+    writers without the boundary-stash guarantee and resolves any
+    disagreement to the lagging position."""
+    if process_count <= 1:
+        return dict(state)
+    return {"__elastic_iter__": ITER_STATE_PACK_VERSION,
+            "hosts": int(process_count),
+            "states": [dict(state) for _ in range(process_count)]}
+
+
+def is_packed_iter_state(raw: Dict) -> bool:
+    return isinstance(raw, dict) and "__elastic_iter__" in raw
+
+
+def merge_iter_states(states: Sequence[Dict]) -> Dict:
+    """One global position from per-host cursors — deterministic, and
+    never past any host's consumed position.
+
+    Under SPMD lockstep the states are equal (every host advances the
+    same logical stream at the same boundary); a fleet killed between
+    boundaries can disagree by at most the in-flight batches, and the
+    safe merge is the LAGGING host's position (lexicographic min of
+    (epoch, cursor)): records past it are re-fed to the replicas that
+    already saw them — the same replay semantics a plain restart has —
+    while nothing is ever skipped.  A shuffle-contract mismatch between
+    hosts is a config error, not a merge decision, and raises."""
+    if not states:
+        raise ValueError("merge_iter_states: no per-host states")
+    first = states[0]
+    for st in states[1:]:
+        if (bool(st.get("shuffle", False))
+                != bool(first.get("shuffle", False))
+                or int(st.get("shuffle_seed", 0))
+                != int(first.get("shuffle_seed", 0))):
+            raise ValueError(
+                "iterator state shuffle contract differs across hosts: "
+                f"{first!r} vs {st!r} — the fleet was not running one "
+                "run")
+    merged = min(
+        states,
+        key=lambda st: (int(st.get("epoch", 0)), int(st.get("cursor", 0))))
+    if any((int(st.get("epoch", 0)), int(st.get("cursor", 0)))
+           != (int(merged.get("epoch", 0)), int(merged.get("cursor", 0)))
+           for st in states):
+        _log.warning(
+            "per-host iterator cursors disagree (fleet killed between "
+            "boundaries); merging to the lagging position %r — some "
+            "records will be re-fed, none dropped", merged)
+    return dict(merged)
+
+
+def split_iter_state(state: Dict, process_count: int) -> List[Dict]:
+    """The merged global position, re-split for ``process_count``
+    hosts.  Every host consumes the same logical stream under SPMD
+    lockstep, so the split is a broadcast — each new host starts at the
+    merged position, and the first boundary re-synchronizes the pack.
+    Deterministic: same input, same output, any direction of world
+    change (8 hosts -> 4, 4 -> 16, ...)."""
+    if process_count < 1:
+        raise ValueError(f"process_count must be >= 1, got {process_count}")
+    return [dict(state) for _ in range(process_count)]
+
+
+def unpack_iter_state(raw: Dict, process_count: int,
+                      process_index: int = 0) -> Dict:
+    """The restoring host's iterator state from a checkpoint's
+    (possibly packed) ``iter_state`` — merging across a host-count
+    change so no record is dropped.  Bare (pre-elastic / single-host)
+    states pass through untouched."""
+    if not is_packed_iter_state(raw):
+        return dict(raw)
+    states = list(raw.get("states") or [])
+    if not states:
+        raise ValueError("packed iter_state carries no per-host states")
+    saved_hosts = int(raw.get("hosts", len(states)))
+    if saved_hosts == process_count and process_index < len(states):
+        return dict(states[process_index])
+    merged = merge_iter_states(states)
+    return split_iter_state(merged, process_count)[
+        min(process_index, process_count - 1)]

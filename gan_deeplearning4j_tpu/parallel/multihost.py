@@ -97,6 +97,46 @@ def agree_preemption(triggered: bool, step: int) -> tuple:
     return bool(arr[:, 0].any()), int(arr[:, 1].min())
 
 
+def agree_world() -> tuple:
+    """Mesh-formation consensus (elastic recovery, parallel/elastic.py):
+    every member of the CURRENT ``jax.distributed`` job reports
+    ``(process_id, local_device_count)`` and gets back
+    ``(process_count, total_devices)`` — the world the re-formed mesh
+    must be built over.  The allgather IS the barrier: no host returns
+    until every member has checked in, so the fleet re-forms one mesh
+    instead of N partial ones.
+
+    Scope, precisely: the barrier synchronizes the surviving
+    INCARNATIONS of one job — hosts that crashed and restarted, hosts
+    whose device count changed under them.  A host that is permanently
+    GONE cannot be voted out from in here (``process_allgather`` is a
+    collective over the job's fixed membership; a dead member means
+    the scheduler must restart the job, at which point the NEW job's
+    membership — and this barrier's result — is the smaller world).
+    That re-exec path is exactly the ``XLA_FLAGS`` world-shrink the
+    chaos harness models, and the checkpoint layer is what carries
+    state across it (reshard-on-restore, checkpoint/checkpointer.py).
+
+    Entered on RESTART paths only (``_maybe_resume``, inside a
+    watchdog region) — never inside the training loop, so it costs one
+    DCN allgather per incarnation, not per step.  Single process:
+    passthrough, no device contact — the same no-op discipline as the
+    other ``agree_*`` collectives above."""
+    if jax.process_count() == 1:
+        return 1, len(jax.devices())
+    from jax.experimental import multihost_utils
+
+    from gan_deeplearning4j_tpu.telemetry import events
+
+    with events.span("collective.agree_world",
+                     process=jax.process_index()):
+        gathered = multihost_utils.process_allgather(
+            np.asarray([jax.process_index(),
+                        jax.local_device_count()], np.int64))
+    arr = np.asarray(gathered).reshape(-1, 2)
+    return int(arr.shape[0]), int(arr[:, 1].sum())
+
+
 # agree_rollback sentinel for "this host has no local bad step": any
 # real step is far below it, so the fleet min ignores non-alarmed hosts
 _NO_BAD_STEP = 1 << 62

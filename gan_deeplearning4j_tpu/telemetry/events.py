@@ -66,6 +66,10 @@ MARKER_EVENTS = {
     # recompile on the step axis is a perf cliff worth SEEING next to
     # the losses it stalled
     "compile.recompile": ("recompile", "#b5651d"),
+    # elastic resume (parallel/elastic.py): a restore that landed on a
+    # different mesh and was resharded onto it — the moment the world
+    # size changed, next to the losses that must stay banded across it
+    "reshard.restore": ("reshard", "#2b6cb0"),
 }
 
 

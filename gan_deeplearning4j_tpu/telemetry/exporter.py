@@ -37,6 +37,15 @@ Metric names (all ``gan4j_``-prefixed):
                                         increment after warmup means
                                         the fused hot path lost its
                                         cached program
+  gan4j_mesh_devices           gauge    devices in the live training
+                                        mesh (elastic resume,
+                                        parallel/elastic.py — drops
+                                        after a fleet shrink are the
+                                        signal)
+  gan4j_reshard_total          counter  checkpoint restores that landed
+                                        on a DIFFERENT mesh and were
+                                        resharded onto it
+  gan4j_reshard_seconds        gauge    cumulative time paid resharding
 """
 
 from __future__ import annotations
@@ -84,12 +93,20 @@ class MetricsRegistry:
             # scrape — a recompile storm is exactly when a scrape
             # might not come back
             ("gan4j_recompiles_total", ()): 0.0,
+            # elastic mesh (parallel/elastic.py): a reshard-on-restore
+            # is rare by design, so the alert rule needs the series at
+            # 0 long before the first one happens
+            ("gan4j_reshard_total", ()): 0.0,
         }
         self._gauges: Dict[Tuple[str, tuple], float] = {
             # age since the last data-plane incident; 0 until one
             # happens (pre-created so alert rules see the series from
             # the first scrape, like the counters above)
             ("gan4j_data_last_error_age_seconds", ()): 0.0,
+            # elastic-mesh surface: mesh size 0 = "no mesh formed yet";
+            # the feed (observe_mesh) raises it to the live count
+            ("gan4j_mesh_devices", ()): 0.0,
+            ("gan4j_reshard_seconds", ()): 0.0,
         }
         self._callbacks: List[Callable[["MetricsRegistry"], None]] = []
         self.run_id: Optional[str] = None
@@ -102,6 +119,10 @@ class MetricsRegistry:
         # data-plane feed (data/resilient.py DataHealth.report): drives
         # the gan4j_data_* series and the /healthz "data" block
         self._data_fn: Optional[Callable[[], Optional[Dict]]] = None
+        # elastic-mesh feed (GANTrainer._mesh_report): drives the
+        # gan4j_mesh_devices / gan4j_reshard_* series and the /healthz
+        # "mesh" block (ok:false while mesh formation is quorum-blocked)
+        self._mesh_fn: Optional[Callable[[], Optional[Dict]]] = None
 
     @staticmethod
     def _key(name: str, labels: Optional[Dict]) -> Tuple[str, tuple]:
@@ -228,6 +249,32 @@ class MetricsRegistry:
 
         self.add_callback(cb)
 
+    def observe_mesh(self, report_fn: Callable[[], Optional[Dict]]) -> None:
+        """Register the elastic-mesh feed: ``report_fn`` returns a
+        ``GANTrainer._mesh_report`` dict (live mesh device count,
+        reshard accounting, formation state).  Scrapes mirror it into
+        ``gan4j_mesh_devices`` / ``gan4j_reshard_*`` and ``/healthz``
+        carries it as the ``"mesh"`` block — ``ok: false`` while mesh
+        formation is quorum-blocked (the agree_world barrier), so a
+        probe can tell "waiting for survivors" from "training"."""
+        with self._lock:
+            self._mesh_fn = report_fn
+
+        def cb(reg: "MetricsRegistry") -> None:
+            rep = report_fn()
+            if not rep:
+                return
+            devices = rep.get("devices")
+            if isinstance(devices, (int, float)):
+                reg.set("gan4j_mesh_devices", float(devices))
+            reg.set_counter("gan4j_reshard_total",
+                            float(rep.get("reshard_total", 0)))
+            secs = rep.get("reshard_seconds")
+            if isinstance(secs, (int, float)):
+                reg.set("gan4j_reshard_seconds", float(secs))
+
+        self.add_callback(cb)
+
     # -- render ---------------------------------------------------------------
 
     def render(self) -> str:
@@ -284,6 +331,21 @@ class MetricsRegistry:
                         "ok": bool(rep.get("ok", True))}
             except Exception:  # gan4j-lint: disable=swallowed-exception — a broken feed must not take down the probe
                 pass
+        # the elastic-mesh block: from the live feed when registered,
+        # else the registry's own (pre-created) series — ALWAYS
+        # present, like the data block, so probes can key on it.
+        # ok:false only while mesh formation is quorum-blocked.
+        mesh = None
+        mfn = self._mesh_fn
+        if mfn is not None:
+            try:
+                rep = mfn() or {}
+                mesh = {"devices": int(rep.get("devices", 0)),
+                        "reshard_total": int(rep.get("reshard_total", 0)),
+                        "forming": bool(rep.get("forming", False)),
+                        "ok": bool(rep.get("ok", True))}
+            except Exception:  # gan4j-lint: disable=swallowed-exception — a broken feed must not take down the probe
+                pass
         with self._lock:
             if data is None:
                 data = {"retries_total": int(self._counters.get(
@@ -291,11 +353,18 @@ class MetricsRegistry:
                         "quarantined_total": int(self._counters.get(
                             ("gan4j_data_quarantined_total", ()), 0.0)),
                         "last_error_age_s": None, "ok": True}
+            if mesh is None:
+                mesh = {"devices": int(self._gauges.get(
+                            ("gan4j_mesh_devices", ()), 0.0)),
+                        "reshard_total": int(self._counters.get(
+                            ("gan4j_reshard_total", ()), 0.0)),
+                        "forming": False, "ok": True}
             age = (None if self._last_record_wall is None
                    else round(time.time() - self._last_record_wall, 3))
             doc = {"status": "stalled" if stalled else "ok",
                    "stalled": stalled, "run_id": self.run_id,
-                   "last_record_age_s": age, "data": data}
+                   "last_record_age_s": age, "data": data,
+                   "mesh": mesh}
             if beat_age is not None:
                 doc["last_beat_age_s"] = round(float(beat_age), 3)
             return doc

@@ -4,6 +4,7 @@ the training path imports this package)."""
 from gan_deeplearning4j_tpu.testing.chaos import (
     ChaosInjector,
     CorruptRecordSource,
+    DeviceLostError,
     FlakyReader,
     FlakySource,
     HangingSource,
@@ -12,6 +13,6 @@ from gan_deeplearning4j_tpu.testing.chaos import (
     StallingSource,
 )
 
-__all__ = ["ChaosInjector", "CorruptRecordSource", "FlakyReader",
-           "FlakySource", "HangingSource", "InjectedCrash", "NanSource",
-           "StallingSource"]
+__all__ = ["ChaosInjector", "CorruptRecordSource", "DeviceLostError",
+           "FlakyReader", "FlakySource", "HangingSource",
+           "InjectedCrash", "NanSource", "StallingSource"]

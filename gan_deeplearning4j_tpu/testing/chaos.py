@@ -32,6 +32,15 @@ the seeded, deterministic injector that does all four, driven by
   batch (the classic bad-record path to non-finite grads), driving the
   telemetry NaN alarm — and the rollback-with-perturbation heal path —
   end to end.
+* **lose-part-of-the-fleet** — ``ChaosInjector.shrink_world`` /
+  ``lost_device`` hook the trainer's step-boundary seam
+  (``train/gan_trainer._chaos_step_hook``) and raise
+  ``DeviceLostError`` at a seeded kill step; afterwards
+  ``world_size()`` reports the survivor count, so the next incarnation
+  rebuilds its mesh over a device SUBSET (the in-process variant of an
+  ``XLA_FLAGS`` re-exec with a smaller
+  ``--xla_force_host_platform_device_count``).  Drives the elastic-
+  resume layer (parallel/elastic.py, reshard-on-restore) end to end.
 * **flaky-reads** — ``FlakySource`` (a source whose ``next()`` raises a
   transient ``OSError`` N times starting at a chosen call, then
   recovers — an NFS blip) and ``FlakyReader`` (the same for a CSV
@@ -66,6 +75,14 @@ class InjectedCrash(RuntimeError):
     retryable failure (it is a RuntimeError, not a config error)."""
 
     simulates_kill = True
+
+
+class DeviceLostError(RuntimeError):
+    """A simulated loss of part of the device fleet mid-run (a spot
+    eviction, a failed chip).  A plain RuntimeError on purpose: the
+    recovery wrapper classifies it RETRYABLE — the restart is exactly
+    where the elastic layer re-forms the mesh over the survivors and
+    reshards the checkpoint onto it."""
 
 
 class ChaosInjector:
@@ -163,6 +180,30 @@ class ChaosInjector:
             f.write("\n".join(lines) + "\n")
         return [i + 1 for i in hit]
 
+    # -- device loss / world shrink --------------------------------------------
+
+    def shrink_world(self, kill_step: int, before: int,
+                     after: int) -> "_ShrinkWorld":
+        """Context manager: the run loses ``before - after`` devices at
+        the first step boundary >= ``kill_step`` — the trainer's step
+        seam raises ``DeviceLostError`` (one-shot; the restarted
+        incarnation trains normally) and ``world_size()`` flips from
+        ``before`` to ``after``.  The test's ``make_trainer`` reads
+        ``world_size()`` so the next incarnation rebuilds its mesh over
+        the surviving subset — the in-process equivalent of re-execing
+        with a smaller ``--xla_force_host_platform_device_count``."""
+        if not 0 < after < before:
+            raise ValueError(
+                f"shrink_world needs 0 < after < before, got "
+                f"{before} -> {after}")
+        return _ShrinkWorld(kill_step, before, after)
+
+    def lost_device(self, kill_step: int, before: int,
+                    lose: int = 1) -> "_ShrinkWorld":
+        """``shrink_world`` phrased as "K devices died": drop ``lose``
+        of the ``before`` devices at the seeded kill step."""
+        return self.shrink_world(kill_step, before, before - lose)
+
     # -- hangs -----------------------------------------------------------------
 
     def hang_at_readback(self, at: int = 0) -> "_ReadbackHang":
@@ -207,6 +248,48 @@ class _ReadbackHang:
     def __exit__(self, *exc) -> None:
         self._device_mod._chaos_readback_hook = self._prev
         self._release.set()  # free any thread still parked in the hook
+
+
+class _ShrinkWorld:
+    """Seeded device-loss injector (``ChaosInjector.shrink_world``).
+    Installs the trainer step-boundary hook for the with-block; fires
+    ONCE at the first boundary at or past ``kill_step`` (chunked paths
+    only visit multiples of steps_per_call, so "at or past" is the
+    honest contract), then reports the shrunken world."""
+
+    def __init__(self, kill_step: int, before: int, after: int):
+        self.kill_step = kill_step
+        self.before = before
+        self.after = after
+        self.fired = False          # one-shot, like _KillPoint
+        self.killed_at: Optional[int] = None
+        self._prev = None
+
+    def world_size(self) -> int:
+        """Devices alive right now: ``before`` until the kill fires,
+        ``after`` from then on — what an elastic ``make_trainer`` hands
+        to ``n_devices``."""
+        return self.after if self.fired else self.before
+
+    def _hook(self, step: int) -> None:
+        if self.fired or step < self.kill_step:
+            return
+        self.fired = True
+        self.killed_at = step
+        raise DeviceLostError(
+            f"injected device loss at step {step}: fleet shrank "
+            f"{self.before} -> {self.after} devices")
+
+    def __enter__(self) -> "_ShrinkWorld":
+        from gan_deeplearning4j_tpu.train import gan_trainer as _gt_mod
+
+        self._gt_mod = _gt_mod
+        self._prev = _gt_mod._chaos_step_hook
+        _gt_mod._chaos_step_hook = self._hook
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self._gt_mod._chaos_step_hook = self._prev
 
 
 class _KillPoint:

@@ -68,6 +68,19 @@ from gan_deeplearning4j_tpu.utils import (
 )
 from gan_deeplearning4j_tpu.utils.async_dump import AsyncArtifactWriter
 
+# fault-injection seam (testing/chaos.py ShrinkWorld / lost_device):
+# called with the step counter at every step/chunk boundary, BEFORE the
+# boundary's own bookkeeping — a raised exception simulates losing part
+# of the device fleet mid-run (the process dies retryably; the next
+# incarnation re-forms the mesh over the survivors).  Mirrors
+# checkpoint/checkpointer.py's ``_chaos_hook`` discipline.
+_chaos_step_hook: Optional[Callable[[int], None]] = None
+
+
+def _chaos_step(step: int) -> None:
+    if _chaos_step_hook is not None:
+        _chaos_step_hook(step)
+
 
 @dataclasses.dataclass
 class GANTrainerConfig:
@@ -89,6 +102,16 @@ class GANTrainerConfig:
     res_path: str = "outputs"   # a flag, not a hardcoded absolute path
     # -- distribution (replaces useGpu/Spark local[4]) --
     n_devices: Optional[int] = None   # None = all attached; 1 = no mesh
+    # Elastic mesh formation (parallel/elastic.py, ROADMAP item 4):
+    # when the requested n_devices exceeds what this incarnation
+    # attaches (a shrunken fleet after preemption / device loss), re-
+    # form the mesh over the largest batch divisor that fits the
+    # SURVIVORS — loudly — instead of refusing to start.  The global
+    # batch is invariant (it is part of the protocol's math); only the
+    # per-device shard grows.  Checkpoints restore onto the re-formed
+    # mesh via reshard-on-restore.  False = the old demand-the-
+    # original-world behavior (data_mesh raises).
+    elastic: bool = True
     dp_mode: str = "gradient_sync"
     averaging_frequency: int = 1
     fused: bool = True                # one-XLA-program protocol iteration
@@ -331,7 +354,16 @@ def train_with_recovery(make_trainer: Callable[[bool], "GANTrainer"],
     file handles, exactly the medicine for storage flakiness that
     outlives one read — while ``DataQuarantineError`` (corrupt-record
     budget exhausted) is FATAL: a restart re-reads the same poisoned
-    dataset and re-exhausts the same budget."""
+    dataset and re-exhausts the same budget.
+
+    Elastic recovery (parallel/elastic.py): every retryable restart
+    passes the ``multihost.agree_world`` mesh-formation barrier before
+    rebuilding — the surviving hosts agree on the world, and the next
+    incarnation forms its mesh over it (``GANTrainerConfig.elastic``)
+    and reshards the latest checkpoint onto it instead of demanding
+    the original world size.  A simulated device loss
+    (testing/chaos.py ``DeviceLostError``) is retryable by
+    construction — the restart IS the reshard point."""
     import random as _random
 
     from gan_deeplearning4j_tpu.checkpoint import CheckpointCorruptError
@@ -414,8 +446,15 @@ def train_with_recovery(make_trainer: Callable[[bool], "GANTrainer"],
                 delay = min(backoff_max_s,
                             backoff_base_s * (2 ** (attempt - 1)))
                 delay *= 0.5 + _random.random()  # jitter: x[0.5, 1.5)
+            # the mesh-formation barrier itself runs in the rebuilt
+            # trainer's _maybe_resume (inside a watchdog region) —
+            # every retry resumes, so every restart passes it exactly
+            # once; a second allgather here would double the fleet's
+            # synchronization points for a log line
             log(f"training failed ({e!r}) at step {step}; restart "
-                f"{attempt}/{max_restarts} from the latest checkpoint"
+                f"{attempt}/{max_restarts} from the latest checkpoint "
+                f"on the surviving world ({len(jax.devices())} local "
+                f"device(s))"
                 + (f" after {delay:.1f}s backoff" if delay else ""))
             # the restart marker must land in the run's events.jsonl,
             # but the failed incarnation's recorder is already closed
@@ -603,6 +642,29 @@ class GANTrainer:
                 f"batch_size {config.batch_size} is not divisible by "
                 f"--n-devices {config.n_devices}; shards are exact "
                 f"(largest usable mesh for this batch: {usable})")
+        if (config.elastic and config.n_devices is not None
+                and config.n_devices > len(jax.devices())):
+            # elastic mesh formation: the requested (VALID — the
+            # divisibility check above already passed it) world is
+            # gone, a shrunken fleet after preemption/device loss —
+            # re-form over the survivors instead of refusing to
+            # start.  The global batch is held; per-device shards
+            # grow.  Deliberately AFTER the validation: a config that
+            # never divided the batch must fail identically on every
+            # host size, not be silently clamped into legality.
+            import logging
+
+            avail = len(jax.devices())
+            resolved = _largest_batch_divisor(config.batch_size, avail)
+            logging.getLogger(__name__).warning(
+                "elastic mesh: %d devices requested but only %d "
+                "attached; re-forming on a %d-device mesh (global "
+                "batch %d held, per-device shard %d -> %d)",
+                config.n_devices, avail, resolved, config.batch_size,
+                config.batch_size // config.n_devices,
+                config.batch_size // resolved)
+            config = dataclasses.replace(config, n_devices=resolved)
+            self.c = config
         # validate preemption signals EAGERLY (same fail-before-side-
         # effects discipline: an unknown name must not surface inside a
         # preemption grace window)
@@ -770,6 +832,16 @@ class GANTrainer:
                 f"max_quarantine must be >= 0, got {config.max_quarantine}")
         self.data_health = DataHealth()
         self.registry.observe_data(self.data_health.report)
+        # elastic-mesh surface (parallel/elastic.py): the live mesh
+        # size, reshard totals and formation state feed the
+        # gan4j_mesh_devices / gan4j_reshard_* series and the /healthz
+        # "mesh" block — ok:false while mesh formation is quorum-
+        # blocked (the agree_world barrier in _maybe_resume), so a
+        # probe can tell "waiting for the fleet" from "training"
+        self._mesh_forming = False
+        self._reshard_total = 0
+        self._reshard_seconds = 0.0
+        self.registry.observe_mesh(self._mesh_report)
         self._quarantine = None
         if config.max_quarantine:
             self._quarantine = RecordQuarantine(
@@ -920,6 +992,28 @@ class GANTrainer:
         return {"dis": self.dis, "gen": self.gen, "gan": self.gan,
                 "classifier": self.classifier}
 
+    def _mesh_spec_dict(self) -> Dict:
+        """The live topology as a checkpoint-manifest ``mesh_spec``
+        (parallel/elastic.py) — stamped into EVERY save so a restore on
+        a different world reshards instead of trusting the shapes."""
+        from gan_deeplearning4j_tpu.parallel.elastic import MeshSpec
+
+        return MeshSpec.from_mesh(self._mesh).to_dict()
+
+    def _mesh_report(self) -> Dict:
+        """Scrape feed for the elastic-mesh surface: current device
+        count, reshard accounting and the formation state (the
+        /healthz "mesh" block is ``ok: false`` only while the
+        agree_world quorum barrier is in flight)."""
+        mesh = self._mesh
+        return {
+            "devices": int(mesh.devices.size) if mesh is not None else 1,
+            "reshard_total": int(self._reshard_total),
+            "reshard_seconds": float(self._reshard_seconds),
+            "forming": bool(self._mesh_forming),
+            "ok": not self._mesh_forming,
+        }
+
     def _iter_state(self) -> Optional[Dict]:
         """O(1) consumed-position of the training data, for the
         checkpoint ``extra`` dict.  Streaming paths read the snapshot
@@ -956,7 +1050,19 @@ class GANTrainer:
         if it_state is not None:
             import json as _json
 
-            extra["iter_state"] = _json.dumps(it_state, sort_keys=True)
+            from gan_deeplearning4j_tpu.parallel.elastic import (
+                pack_iter_state,
+            )
+
+            # single host: the bare data/csv.py state (bit-compatible
+            # with pre-elastic checkpoints); a fleet packs the
+            # boundary-aligned cursor per host (equal under SPMD
+            # lockstep — elastic.pack_iter_state documents why) so a
+            # restore at a different host count merges instead of
+            # guessing
+            extra["iter_state"] = _json.dumps(
+                pack_iter_state(it_state, jax.process_count()),
+                sort_keys=True)
         # the generator EMA is state the graphs' params don't carry;
         # without it a crash-resume would silently restart the
         # trajectory average from the current weights
@@ -977,7 +1083,8 @@ class GANTrainer:
             with events.span("checkpoint.save", step=self.batch_counter):
                 self.checkpointer.save(
                     self.batch_counter, self._graphs(),
-                    extra=self._checkpoint_extra())
+                    extra=self._checkpoint_extra(),
+                    mesh_spec=self._mesh_spec_dict())
 
     def _emergency_checkpoint(self, directory: Optional[str] = None,
                               keep: int = 1) -> str:
@@ -1005,7 +1112,8 @@ class GANTrainer:
             else:
                 ck = TrainCheckpointer(directory, keep=keep)
             path = ck.save(self.batch_counter, self._graphs(),
-                           extra=self._checkpoint_extra())
+                           extra=self._checkpoint_extra(),
+                           mesh_spec=self._mesh_spec_dict())
             wait = getattr(ck, "wait", None)
             if wait is not None:
                 wait()
@@ -1064,13 +1172,46 @@ class GANTrainer:
             logging.getLogger(__name__).info(
                 "resuming a preempted run (consuming %s)", marker)
             os.remove(marker)
+        # mesh-formation barrier (elastic recovery): agree on the
+        # surviving world BEFORE restoring — on a fleet the allgather
+        # holds every host here until all survivors check in, and the
+        # /healthz "mesh" block answers ok:false for the duration
+        # (quorum-blocked is an observable state, not a silent wait).
+        # Single process: passthrough, no device contact.
+        from gan_deeplearning4j_tpu.parallel import multihost
+
+        self._mesh_forming = True
+        try:
+            with self._wd_region("collective.agree_world"):
+                n_proc, n_dev = multihost.agree_world()
+        finally:
+            self._mesh_forming = False
+        mesh_devs = (self._mesh.devices.size
+                     if self._mesh is not None else 1)
+        events.instant("mesh.form", step=self.batch_counter,
+                       processes=n_proc, devices=n_dev,
+                       mesh_devices=mesh_devs)
+        if n_dev < mesh_devs:
+            # the barrier exists to CATCH world changes, not narrate
+            # them: a mesh spanning more devices than the agreed
+            # world would die later inside shard_map with an opaque
+            # sharding error — fail here, naming both numbers (fatal
+            # in the recovery wrapper: every restart of this config
+            # re-agrees on the same too-small world)
+            raise ValueError(
+                f"mesh formation: the fleet agreed on {n_dev} "
+                f"device(s) ({n_proc} process(es)) but this "
+                f"incarnation's mesh spans {mesh_devs} — the "
+                f"surviving world cannot carry it; resume with "
+                f"n_devices <= {n_dev}")
         # a rollback resume is BOUNDED: the manager recorded the first
         # known-bad step, and restoring at-or-after it would replay the
         # poisoned state the rollback exists to discard
         max_step = self._resume_max_step
         try:
-            step, extra = self.checkpointer.restore(self._graphs(),
-                                                    max_step=max_step)
+            step, extra = self.checkpointer.restore(
+                self._graphs(), max_step=max_step,
+                target_mesh=self._mesh)
         except NoVerifiedCheckpointError as e:
             # restore() already fell back as far as it could; an empty or
             # fully-torn directory means: start from step 0 (the
@@ -1092,6 +1233,22 @@ class GANTrainer:
             # mark the timeline
             self.checkpointer.prune_above(step)
             self._consume_rollback_restore(step, max_step)
+        reshard_info = extra.pop("__reshard__", None)
+        if reshard_info is not None:
+            # reshard-on-restore happened (checkpoint/checkpointer.py
+            # _load_elastic): account it — the overlay marker, the
+            # counter a chaos lane asserts on, and the time paid.
+            # These fields are the SINGLE source of truth for the
+            # gan4j_reshard_* series (the observe_mesh callback
+            # mirrors them at scrape time — a second direct writer
+            # here could silently drift from it).
+            self._reshard_total += 1
+            self._reshard_seconds += float(reshard_info["seconds"])
+            events.instant(
+                "reshard.restore", step=step,
+                from_devices=reshard_info["from"].get("device_count"),
+                to_devices=reshard_info["to"].get("device_count"),
+                seconds=round(float(reshard_info["seconds"]), 4))
         self.batch_counter = step
         self.soften_real = jnp.asarray(extra["soften_real"])
         self.soften_fake = jnp.asarray(extra["soften_fake"])
@@ -1120,8 +1277,19 @@ class GANTrainer:
             import json as _json
             import logging
 
+            from gan_deeplearning4j_tpu.parallel.elastic import (
+                unpack_iter_state,
+            )
+
             try:
-                it_state = _json.loads(raw_state)
+                # a fleet checkpoint carries per-host cursors; unpack
+                # merges them deterministically when the host count
+                # changed (lagging position wins: records may be
+                # re-fed, never dropped) — a single-host bare state
+                # passes through untouched
+                it_state = unpack_iter_state(
+                    _json.loads(raw_state), jax.process_count(),
+                    jax.process_index())
                 restore(it_state)
                 restored = True
                 events.instant("data.resume_state", step=step,
@@ -2086,6 +2254,11 @@ class GANTrainer:
         """Artifact/checkpoint cadence triggers at the current counter
         (shared by the per-step and chunk paths)."""
         c = self.c
+        # device-loss injection seam (testing/chaos.py ShrinkWorld):
+        # fires BEFORE this boundary's checkpoint, so the resume comes
+        # from an earlier save — exactly what a real mid-step loss
+        # leaves behind
+        _chaos_step(self.batch_counter)
         if self._fused_step is not None and (
             self.batch_counter % c.print_every == 0
             or self.batch_counter % c.save_every == 0

@@ -1,0 +1,400 @@
+"""Elastic mesh — reshard-on-restore and world-size-elastic recovery.
+
+The fault-tolerance layer (PRs 2/4/5) restarts a run, but only onto the
+SAME mesh shape.  This suite proves the elastic tier
+(parallel/elastic.py + checkpoint ``mesh_spec`` + the trainer's
+agree_world barrier):
+
+* the reshard round-trip matrix — a checkpoint saved on every mesh size
+  in {1, 2, 4, 8} restores onto every size in {1, 2, 4, 8} with params,
+  opt-state AND iterator state bit-equal post-gather;
+* a device-count mismatch without a target mesh is a clear
+  ``CheckpointMeshMismatchError`` naming both shapes, not a sharding
+  error deep in device_put;
+* the chaos acceptance e2e — an 8-virtual-device run killed mid-step by
+  the ``shrink_world`` injector resumes on 4 devices, FINISHES, ticks
+  ``gan4j_reshard_total``, and its loss trajectory stays banded against
+  an uninterrupted control.
+
+The virtual-device trick is the same as everywhere else in the repo:
+conftest forces ``--xla_force_host_platform_device_count=8``, and a
+"shrunken fleet" is a mesh over a device SUBSET — the in-process
+variant of re-execing with a smaller count (testing/chaos.py).
+"""
+
+import json
+import os
+import signal
+
+import jax
+import numpy as np
+import pytest
+
+from gan_deeplearning4j_tpu.checkpoint import (
+    CheckpointMeshMismatchError,
+    TrainCheckpointer,
+)
+from gan_deeplearning4j_tpu.parallel import data_mesh, elastic
+from gan_deeplearning4j_tpu.testing import ChaosInjector, DeviceLostError
+from gan_deeplearning4j_tpu.train.gan_trainer import (
+    GANTrainer,
+    train_with_recovery,
+)
+from gan_deeplearning4j_tpu.train.insurance_main import (
+    InsuranceWorkload,
+    default_config,
+)
+
+SEED = 666
+
+
+@pytest.fixture(autouse=True)
+def _watchdog():
+    """Per-test deadline: an injected failure must FAIL the test, not
+    hang the runner (the CI elastic lane sets CHAOS_TEST_TIMEOUT)."""
+    limit = int(os.environ.get("CHAOS_TEST_TIMEOUT", "300"))
+    if not hasattr(signal, "SIGALRM"):  # non-POSIX: rely on lane timeout
+        yield
+        return
+
+    def on_alarm(signum, frame):
+        raise TimeoutError(f"elastic test exceeded {limit}s watchdog")
+
+    prev = signal.signal(signal.SIGALRM, on_alarm)
+    signal.alarm(limit)
+    try:
+        yield
+    finally:
+        signal.alarm(0)
+        signal.signal(signal.SIGALRM, prev)
+
+
+def _cfg(res_path, **overrides):
+    base = dict(res_path=str(res_path), batch_size=16, num_iterations=2,
+                checkpoint_every=2, print_every=100, save_every=100,
+                metrics=False)
+    base.update(overrides)
+    return default_config(**base)
+
+
+def _mesh_of(n):
+    return data_mesh(n) if n > 1 else None
+
+
+def _assert_tree_bitequal(a, b, label):
+    la, lb = jax.tree.leaves(a), jax.tree.leaves(b)
+    assert len(la) == len(lb), label
+    for x, y in zip(la, lb):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y),
+                                      err_msg=label)
+
+
+# -- MeshSpec / iter-state units ---------------------------------------------
+
+
+def test_mesh_spec_roundtrip_and_describe():
+    spec = elastic.MeshSpec.from_mesh(data_mesh(4))
+    assert spec.axes == {"data": 4}
+    assert spec.device_count == 4
+    assert spec.process_count == 1
+    assert spec.sharding[elastic.ROLE_PARAMS] == "replicated"
+    assert elastic.MeshSpec.from_dict(spec.to_dict()) == spec
+    assert "4 devices" in spec.describe()
+    # the no-mesh (single-device) trainer has a spec too
+    single = elastic.MeshSpec.from_mesh(None)
+    assert single.device_count == 1
+    assert not single.same_topology(spec)
+    assert spec.same_topology(elastic.MeshSpec.from_mesh(data_mesh(4)))
+
+
+def test_iter_state_pack_is_bare_for_single_host():
+    st = {"epoch": 1, "cursor": 64, "shuffle": False, "shuffle_seed": 0}
+    packed = elastic.pack_iter_state(st, 1)
+    assert packed == st and not elastic.is_packed_iter_state(packed)
+    # and unpack of a bare state is the identity (pre-elastic
+    # checkpoints keep restoring byte-for-byte)
+    assert elastic.unpack_iter_state(st, 1) == st
+
+
+def test_iter_state_pack_unpack_across_host_counts():
+    st = {"epoch": 2, "cursor": 128, "shuffle": True, "shuffle_seed": 7}
+    packed = elastic.pack_iter_state(st, 4)
+    assert elastic.is_packed_iter_state(packed)
+    assert packed["hosts"] == 4 and len(packed["states"]) == 4
+    # same host count: positional unpack
+    assert elastic.unpack_iter_state(packed, 4, 2) == st
+    # shrink and grow: merge + broadcast, deterministically the same
+    for new_hosts in (1, 2, 8):
+        for pid in range(new_hosts):
+            assert elastic.unpack_iter_state(packed, new_hosts, pid) == st
+
+
+def test_iter_state_merge_lagging_position_wins():
+    # a fleet killed between boundaries disagrees by in-flight batches:
+    # the merged position is the LAGGING host's (records re-fed, never
+    # dropped), lexicographic over (epoch, cursor)
+    states = [{"epoch": 2, "cursor": 10}, {"epoch": 1, "cursor": 900},
+              {"epoch": 2, "cursor": 0}]
+    assert elastic.merge_iter_states(states) == {"epoch": 1,
+                                                 "cursor": 900}
+    # deterministic: permutation-independent
+    assert elastic.merge_iter_states(states[::-1]) == {"epoch": 1,
+                                                       "cursor": 900}
+
+
+def test_iter_state_merge_shuffle_contract_mismatch_raises():
+    with pytest.raises(ValueError, match="shuffle contract"):
+        elastic.merge_iter_states([
+            {"epoch": 0, "cursor": 0, "shuffle": True, "shuffle_seed": 1},
+            {"epoch": 0, "cursor": 0, "shuffle": True, "shuffle_seed": 2},
+        ])
+
+
+def test_split_iter_state_is_broadcast():
+    st = {"epoch": 3, "cursor": 5}
+    out = elastic.split_iter_state(st, 3)
+    assert out == [st, st, st]
+    assert all(o is not st for o in out)  # copies, not aliases
+    with pytest.raises(ValueError):
+        elastic.split_iter_state(st, 0)
+
+
+# -- the reshard round-trip matrix -------------------------------------------
+
+
+@pytest.mark.parametrize("save_n", [1, 2, 4, 8])
+def test_reshard_roundtrip_matrix(tmp_path, save_n):
+    """Save on ``save_n`` virtual devices, restore on every mesh size in
+    {1, 2, 4, 8}: params, opt-state and iter-state all bit-equal
+    post-gather, reshard accounting present exactly when the topology
+    changed."""
+    d = str(tmp_path / f"save{save_n}")
+    t = GANTrainer(InsuranceWorkload(), _cfg(d, n_devices=save_n))
+    t.train(log=lambda s: None)
+    ck = TrainCheckpointer(os.path.join(d, "checkpoints"))
+    spec = ck.mesh_spec(2)
+    assert spec is not None and spec["device_count"] == save_n
+
+    # ground truth: a same-topology restore (no reshard) into fresh
+    # graphs — host copies of exactly what the checkpoint holds
+    ref = InsuranceWorkload().build_graphs()
+    step, ref_extra = ck.restore(ref, target_mesh=_mesh_of(save_n))
+    assert step == 2 and "__reshard__" not in ref_extra
+
+    for restore_n in (1, 2, 4, 8):
+        graphs = InsuranceWorkload().build_graphs()
+        step, extra = ck.restore(graphs, target_mesh=_mesh_of(restore_n))
+        assert step == 2
+        if restore_n == save_n:
+            assert "__reshard__" not in extra
+        else:
+            info = extra["__reshard__"]
+            assert info["from"]["device_count"] == save_n
+            assert info["to"]["device_count"] == restore_n
+            # the resharded leaves really live on the target mesh
+            leaf = jax.tree.leaves(graphs["dis"].params)[0]
+            assert len(leaf.sharding.device_set) == restore_n
+        for name in ("dis", "gen", "gan", "classifier"):
+            _assert_tree_bitequal(
+                ref[name].params, graphs[name].params,
+                f"{save_n}->{restore_n} {name} params")
+            _assert_tree_bitequal(
+                ref[name].opt_state, graphs[name].opt_state,
+                f"{save_n}->{restore_n} {name} opt_state")
+        # iter-state rides the extra dict untouched by resharding
+        assert extra["iter_state"] == ref_extra["iter_state"]
+        assert np.array_equal(np.asarray(extra["soften_real"]),
+                              np.asarray(ref_extra["soften_real"]))
+
+
+# -- the mismatch bugfix ------------------------------------------------------
+
+
+def test_mesh_mismatch_without_target_names_both_shapes(tmp_path):
+    """A checkpoint from a BIGGER world than this host attaches, restored
+    without a target mesh, must raise CheckpointMeshMismatchError naming
+    both topologies — not a shape/sharding error deep in device_put."""
+    ck = TrainCheckpointer(str(tmp_path))
+    graphs = InsuranceWorkload().build_graphs()
+    fake = elastic.MeshSpec(axes={"data": 16}, device_count=16)
+    ck.save(1, graphs, extra={}, mesh_spec=fake.to_dict())
+
+    with pytest.raises(CheckpointMeshMismatchError) as exc:
+        ck.restore(InsuranceWorkload().build_graphs())
+    msg = str(exc.value)
+    assert "16 devices" in msg
+    assert f"only {len(jax.devices())} device(s)" in msg
+    # the recovery wrapper must classify it FATAL (a blind restart
+    # replays the identical mismatch)
+    assert isinstance(exc.value, ValueError)
+
+    # the SAME checkpoint restores fine once a target mesh is named
+    fresh = InsuranceWorkload().build_graphs()
+    step, extra = ck.restore(fresh, target_mesh=data_mesh(4))
+    assert step == 1
+    assert extra["__reshard__"]["to"]["device_count"] == 4
+
+
+def test_pre_elastic_checkpoint_keeps_legacy_restore(tmp_path):
+    """Checkpoints without a recorded mesh_spec (every save from before
+    this PR) restore exactly as before — no guard, no reshard."""
+    ck = TrainCheckpointer(str(tmp_path))
+    graphs = InsuranceWorkload().build_graphs()
+    ck.save(1, graphs, extra={})
+    assert ck.mesh_spec(1) is None
+    fresh = InsuranceWorkload().build_graphs()
+    step, extra = ck.restore(fresh)  # no target, no error
+    assert step == 1 and "__reshard__" not in extra
+    # even WITH a target there is nothing recorded to compare against
+    fresh2 = InsuranceWorkload().build_graphs()
+    step, extra = ck.restore(fresh2, target_mesh=data_mesh(2))
+    assert step == 1 and "__reshard__" not in extra
+
+
+# -- elastic mesh formation ---------------------------------------------------
+
+
+def test_elastic_clamp_reforms_on_shrunken_world(tmp_path):
+    """n_devices beyond what the host attaches re-forms on the largest
+    batch divisor that fits (elastic=True, the default) instead of
+    refusing to start; elastic=False keeps the old demand."""
+    cfg = _cfg(str(tmp_path / "a"), n_devices=16)
+    t = GANTrainer(InsuranceWorkload(), cfg)
+    assert t.c.n_devices == 8  # largest divisor of batch 16 within 8
+    with pytest.raises(ValueError):
+        GANTrainer(InsuranceWorkload(),
+                   _cfg(str(tmp_path / "b"), n_devices=16, elastic=False))
+
+
+def test_elastic_clamp_never_legalizes_a_bad_batch_split(tmp_path):
+    """The clamp only bypasses the world-size demand for VALID configs:
+    an n_devices that never divides the batch fails identically on
+    every host size instead of being silently clamped into legality."""
+    with pytest.raises(ValueError, match="not divisible"):
+        GANTrainer(InsuranceWorkload(),
+                   _cfg(str(tmp_path), n_devices=12))  # 16 % 12 != 0
+
+
+# (agree_world consensus tests — passthrough and mocked fleets — live
+# with the other agree_* consensus math in tests/test_multihost.py)
+
+
+# -- the chaos acceptance e2e: 8 -> 4 mid-run device loss --------------------
+
+
+def test_device_loss_8_to_4_resumes_finishes_banded(tmp_path):
+    """THE acceptance run (ISSUE 8): an 8-virtual-device training run
+    loses half its fleet mid-step; ``train_with_recovery`` re-forms the
+    mesh over the 4 survivors, reshards the last verified checkpoint
+    onto it, and the run FINISHES — loss trajectory banded against an
+    uninterrupted control, ``gan4j_reshard_total >= 1``, and the
+    ``reshard.restore`` / ``mesh.form`` markers on the timeline."""
+    ctrl_dir = str(tmp_path / "control")
+    ela_dir = str(tmp_path / "elastic")
+    kw = dict(num_iterations=6, checkpoint_every=2, metrics=True)
+
+    ctrl = GANTrainer(InsuranceWorkload(),
+                      _cfg(ctrl_dir, n_devices=8, **kw))
+    ctrl.metrics.flush_every = 1  # materialize per record (timeline)
+    ctrl_res = ctrl.train(log=lambda s: None)
+    assert ctrl_res["steps"] == 6
+
+    inj = ChaosInjector(SEED)
+    world = inj.shrink_world(kill_step=3, before=8, after=4)
+    trainers = []
+
+    def make_trainer(resume):
+        t = GANTrainer(
+            InsuranceWorkload(),
+            _cfg(ela_dir, n_devices=world.world_size(), resume=resume,
+                 **kw))
+        t.metrics.flush_every = 1
+        trainers.append(t)
+        return t
+
+    with world:
+        res = train_with_recovery(make_trainer, max_restarts=2,
+                                  log=lambda s: None, backoff_base_s=0)
+    assert world.fired and world.killed_at == 4
+    assert res["steps"] == 6
+    # drain the killed incarnation's metrics worker so its pre-crash
+    # records (steps 1-4 on the 8-device mesh) are on disk before the
+    # timeline comparison below
+    trainers[0].metrics.close()
+    t = trainers[-1]
+    assert t.c.n_devices == 4
+    assert t._mesh is not None and t._mesh.devices.size == 4
+
+    # reshard accounting: the counter the CI lane asserts on, plus the
+    # /healthz mesh block and the scrape series
+    scrape = t.registry.render()
+    reshard_line = [ln for ln in scrape.splitlines()
+                    if ln.startswith("gan4j_reshard_total ")]
+    assert reshard_line and float(reshard_line[0].split()[1]) >= 1.0
+    mesh_line = [ln for ln in scrape.splitlines()
+                 if ln.startswith("gan4j_mesh_devices ")]
+    assert mesh_line and float(mesh_line[0].split()[1]) == 4.0
+    health = t.registry.health()
+    assert health["mesh"]["devices"] == 4
+    assert health["mesh"]["reshard_total"] >= 1
+    assert health["mesh"]["ok"] is True  # formation is over
+
+    # timeline markers: the restore names the world change
+    names = []
+    reshard_events = []
+    with open(os.path.join(ela_dir, "events.jsonl")) as f:
+        for ln in f:
+            ev = json.loads(ln)
+            names.append(ev.get("name"))
+            if ev.get("name") == "reshard.restore":
+                reshard_events.append(ev)
+    assert "mesh.form" in names
+    assert "recovery.restart" in names
+    assert reshard_events
+    assert reshard_events[0]["from_devices"] == 8
+    assert reshard_events[0]["to_devices"] == 4
+
+    # banded loss trajectory: sync-BN + pmean gradient math is
+    # mesh-size-invariant up to float reduction order, so the resumed
+    # 4-device tail must track the 8-device control closely (the
+    # resumed run re-logs steps 3-6; last record per step wins)
+    def step_losses(res_dir):
+        out = {}
+        with open(os.path.join(res_dir, "insurance_metrics.jsonl")) as f:
+            for ln in f:
+                rec = json.loads(ln)
+                if isinstance(rec.get("step"), int) and "d_loss" in rec:
+                    out[rec["step"]] = (float(rec["d_loss"]),
+                                        float(rec["g_loss"]))
+        return out
+
+    ctrl_losses = step_losses(ctrl_dir)
+    ela_losses = step_losses(ela_dir)
+    assert set(ctrl_losses) == set(ela_losses) == set(range(1, 7))
+    for s in range(1, 7):
+        for c_val, e_val in zip(ctrl_losses[s], ela_losses[s]):
+            assert np.isfinite(e_val)
+            assert abs(c_val - e_val) <= 0.05 * max(1.0, abs(c_val)), (
+                f"step {s}: control {ctrl_losses[s]} vs elastic "
+                f"{ela_losses[s]} outside the band")
+
+
+def test_shrink_world_injector_contract(tmp_path):
+    """The injector mirrors the other chaos tools: seeded kill step,
+    one-shot firing, observable world size, validated shapes."""
+    inj = ChaosInjector(SEED)
+    with pytest.raises(ValueError):
+        inj.shrink_world(kill_step=1, before=4, after=4)
+    world = inj.lost_device(kill_step=2, before=8, lose=4)
+    assert world.world_size() == 8
+    from gan_deeplearning4j_tpu.train import gan_trainer as gt
+
+    with world:
+        gt._chaos_step(1)  # below the kill step: quiet
+        assert not world.fired
+        with pytest.raises(DeviceLostError):
+            gt._chaos_step(5)  # "at or past" the seeded step
+        assert world.fired and world.killed_at == 5
+        assert world.world_size() == 4
+        gt._chaos_step(6)  # one-shot: the restarted run trains on
+    assert gt._chaos_step_hook is None  # seam restored on exit

@@ -217,3 +217,35 @@ def test_rollback_min_bad_step_across_alarmed_hosts(monkeypatch):
 def test_rollback_none_triggered_is_quiet(monkeypatch):
     _mock_fleet(monkeypatch, [[0, 4, _NO_BAD], [0, 6, _NO_BAD]])
     assert multihost.agree_rollback(False, 5) == (False, 4, None)
+
+
+def test_agree_world_single_process_passthrough():
+    # no mock: jax.process_count() == 1 in the test rig — pure identity,
+    # no device contact (the elastic mesh-formation barrier costs
+    # nothing on a single host)
+    import jax
+
+    assert multihost.agree_world() == (1, len(jax.devices()))
+
+
+def test_agree_world_sums_surviving_devices(monkeypatch):
+    # two peers with 4 devices each survive alongside the local host's
+    # 8: the agreed world is 3 processes x 16 devices — what the
+    # re-formed mesh must be built over
+    import jax
+
+    _mock_fleet(monkeypatch, [[0, 4], [1, 4]])
+    monkeypatch.setattr(jax, "local_device_count", lambda: 8)
+    monkeypatch.setattr(jax, "process_index", lambda: 2)
+    assert multihost.agree_world() == (3, 16)
+
+
+def test_agree_world_shrunken_fleet(monkeypatch):
+    # only ONE peer returned after preemption: the barrier reports the
+    # smaller world instead of waiting for the original size forever
+    import jax
+
+    _mock_fleet(monkeypatch, [[0, 4]])
+    monkeypatch.setattr(jax, "local_device_count", lambda: 4)
+    monkeypatch.setattr(jax, "process_index", lambda: 1)
+    assert multihost.agree_world() == (2, 8)
