@@ -18,6 +18,16 @@ versioned JSON contract (``analysis/contracts/``): donation aliasing,
 dtype discipline, collective budgets, peak-HBM ceilings and
 compile-bucket coverage, enforced as a second zero-violations CI gate.
 
+The CONCURRENCY half (locks.py + the race rules in
+rules_concurrency.py, the ``gan4j-race`` console entry in race_cli.py)
+sees the threads and locks: a whole-package lock acquisition-order
+graph (lock-order cycles = potential deadlocks, reported with both
+chains), blocking calls made under locks, and thread-construction
+hygiene — plus the runtime ``lockdep`` sanitizer (sanitizers.py) that
+wraps lock allocations in order-tracking proxies and reports an
+observed inversion immediately with both stacks.  Third zero-findings
+CI gate (tier1.yml race lane).
+
 docs/STATIC_ANALYSIS.md is the operator manual: rule catalogue,
 suppression/baseline semantics, sanitizer wiring, program contracts.
 """
@@ -33,12 +43,22 @@ from gan_deeplearning4j_tpu.analysis.engine import (  # noqa: F401
     package_root,
     register,
 )
+from gan_deeplearning4j_tpu.analysis.rules_concurrency import (  # noqa: F401,E501
+    RACE_RULES,
+)
 from gan_deeplearning4j_tpu.analysis.sanitizers import (  # noqa: F401
+    LOCK_INVERSION_EVENT,
+    LOCK_INVERSION_METRIC,
+    LOCK_WAIT_METRIC,
     RECOMPILE_EVENT,
     RECOMPILE_METRIC,
+    LockdepSanitizer,
+    LockOrderError,
     RecompileError,
     RecompileSentinel,
+    ThreadLeakError,
     TransferGuardError,
+    lockdep,
     no_implicit_transfers,
 )
 

@@ -16,7 +16,7 @@ import argparse
 import os
 import subprocess
 import sys
-from typing import List, Optional
+from typing import List, Optional, Sequence
 
 from gan_deeplearning4j_tpu.analysis import baseline as baseline_mod
 from gan_deeplearning4j_tpu.analysis import reporters
@@ -27,9 +27,11 @@ from gan_deeplearning4j_tpu.analysis.engine import (
 )
 
 
-def build_parser() -> argparse.ArgumentParser:
+def build_parser(prog: str = "gan4j-lint",
+                 description: Optional[str] = None
+                 ) -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(
-        prog="gan4j-lint", description=__doc__,
+        prog=prog, description=description or __doc__,
         formatter_class=argparse.RawDescriptionHelpFormatter)
     p.add_argument("paths", nargs="*",
                    help="files/directories to lint (default: the "
@@ -113,13 +115,42 @@ def changed_py_files(ref: str, scope_paths: List[str]) -> List[str]:
     return out
 
 
-def main(argv: Optional[list] = None) -> int:
-    parser = build_parser()
+def main(argv: Optional[list] = None, *,
+         rule_subset: Optional[Sequence[str]] = None,
+         prog: str = "gan4j-lint",
+         description: Optional[str] = None,
+         allow_changed: bool = True) -> int:
+    """``rule_subset`` restricts the selectable rules (the
+    ``gan4j-race`` CLI passes its concurrency set); everything else —
+    baseline, suppressions, reporters, exit codes — is shared verbatim
+    between the two gates.  ``allow_changed=False`` rejects
+    ``--changed``: a tool whose rules reason over the whole-package
+    graph must not answer from a file subset (a cycle's other half may
+    live in an unchanged module — exit 2, not a false clean pass)."""
+    parser = build_parser(prog=prog, description=description)
     args = parser.parse_args(argv)
+    if args.changed is not None and not allow_changed:
+        print(f"{prog}: error: --changed is not supported: the "
+              f"lock-order graph is a whole-package property (a cycle "
+              f"closed by your edit may have its other half in an "
+              f"unchanged module) — run {prog} with no paths instead; "
+              f"the full run costs well under a second",
+              file=sys.stderr)
+        return 2
+    registry = all_rules()
+    # gan4j-lint's own set is the FILE-scope rules: the package-scope
+    # concurrency rules (lock-order-cycle et al.) belong to gan4j-race,
+    # whose whole-package default invocation is the only shape their
+    # graph analysis is meaningful in (`--changed` over a file subset
+    # would see a partial graph).  lint_package() — the bench/test repo
+    # gate — still runs everything.
+    selectable = (sorted(rule_subset) if rule_subset is not None
+                  else sorted(r for r in registry
+                              if registry[r].scope == "file"))
 
     if args.list_rules:
-        for name, cls in sorted(all_rules().items()):
-            print(f"{name}: {cls.summary}")
+        for name in selectable:
+            print(f"{name}: {registry[name].summary}")
         return 0
     if args.write_baseline and not args.baseline:
         parser.error("--write-baseline requires --baseline FILE")
@@ -132,24 +163,33 @@ def main(argv: Optional[list] = None) -> int:
     # (or a moved package dir) is a usage error, not a pass
     missing = [p for p in paths if not os.path.exists(p)]
     if missing:
-        print(f"gan4j-lint: error: no such path(s): "
+        print(f"{prog}: error: no such path(s): "
               f"{', '.join(missing)}", file=sys.stderr)
         return 2
     if args.changed is not None:
         try:
             paths = changed_py_files(args.changed, paths)
         except ValueError as e:
-            print(f"gan4j-lint: error: {e}", file=sys.stderr)
+            print(f"{prog}: error: {e}", file=sys.stderr)
             return 2
         if not paths:
             # unlike a typo'd path, an empty diff is a REAL verdict:
             # nothing in scope changed, so there is nothing to gate
-            print(f"gan4j-lint: no changed .py files vs "
+            print(f"{prog}: no changed .py files vs "
                   f"{args.changed} — clean")
             return 0
     rules = ([r.strip() for r in args.rules.split(",") if r.strip()]
-             if args.rules else None)
+             if args.rules else list(selectable))
     disable = [r.strip() for r in args.disable.split(",") if r.strip()]
+    # --disable gets the same jurisdiction check as --rules: silently
+    # no-op'ing a rule name from the OTHER tool would read as "narrowed
+    # the run" while changing nothing
+    outside = [r for r in rules + disable if r not in selectable]
+    if outside:
+        print(f"{prog}: error: rule(s) outside this tool's set: "
+              f"{', '.join(outside)}; selectable: "
+              f"{', '.join(selectable)}", file=sys.stderr)
+        return 2
 
     try:
         fingerprints = (baseline_mod.load(args.baseline)
@@ -158,29 +198,35 @@ def main(argv: Optional[list] = None) -> int:
         result = lint_paths(
             paths, rules=rules, disable=disable,
             baseline_fingerprints=fingerprints,
-            audit_suppressions=args.warn_unused_suppressions)
+            audit_suppressions=args.warn_unused_suppressions,
+            # this tool's own catalogue is the universe a run must
+            # cover to call a disable=all stale — the default run of
+            # EITHER gate keeps auditing "all" within its jurisdiction
+            audit_universe=set(selectable))
     except ValueError as e:
-        print(f"gan4j-lint: error: {e}", file=sys.stderr)
+        print(f"{prog}: error: {e}", file=sys.stderr)
         return 2
     if result.files_checked == 0:
-        print("gan4j-lint: error: no .py files under the given "
+        print(f"{prog}: error: no .py files under the given "
               "path(s) — refusing to report a vacuous pass",
               file=sys.stderr)
         return 2
 
     if args.write_baseline:
         n = baseline_mod.write(args.baseline, result.findings)
-        print(f"gan4j-lint: baseline written: {n} fingerprint(s) -> "
+        print(f"{prog}: baseline written: {n} fingerprint(s) -> "
               f"{args.baseline}")
         return 0
 
-    report = (reporters.render_json(result) if args.format == "json"
-              else reporters.render_human(result, verbose=args.verbose))
+    report = (reporters.render_json(result, tool=prog)
+              if args.format == "json"
+              else reporters.render_human(result, verbose=args.verbose,
+                                          tool=prog))
     if args.output:
         with open(args.output, "w") as f:
             f.write(report)
         # a one-line verdict still lands in the log next to the gate
-        print(f"gan4j-lint: {len(result.findings)} finding(s) "
+        print(f"{prog}: {len(result.findings)} finding(s) "
               f"({'ok' if result.ok else 'FAIL'}) -> {args.output}")
     else:
         sys.stdout.write(report)

@@ -38,8 +38,28 @@ import re
 import tokenize
 from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
 
-SUPPRESS_RE = re.compile(r"#\s*gan4j-lint:\s*disable=([A-Za-z0-9_,\-]+)")
+# gan4j-lint and gan4j-race share one directive namespace: NAMED rule
+# tokens are globally unique, so they are unambiguous under either
+# prefix.  ``disable=all`` is NOT — it is scoped to the prefix's own
+# jurisdiction (gan4j-race's "all" = the race rules, gan4j-lint's =
+# the file-scope rules), or a race-justified "all" would silently
+# bypass the lint gate on the same line.
+SUPPRESS_RE = re.compile(
+    r"#\s*gan4j-(lint|race):\s*disable=([A-Za-z0-9_,\-]+)")
 HOT_PATH_RE = re.compile(r"#\s*gan4j-lint:\s*hot-path")
+
+
+def _all_jurisdiction(prefix: str) -> Set[str]:
+    """The rules a ``disable=all`` under this prefix may silence."""
+    from gan_deeplearning4j_tpu.analysis.rules_concurrency import (
+        RACE_RULES,
+    )
+
+    registry = all_rules()
+    if prefix == "race":
+        return set(RACE_RULES)
+    return {name for name, cls in registry.items()
+            if cls.scope == "file"}
 
 
 @dataclasses.dataclass
@@ -76,6 +96,9 @@ class FileContext:
         self.tree = ast.parse(source, filename=path)
         # line (1-based) -> set of suppressed rule names (or {"all"})
         self.suppressions: Dict[int, Set[str]] = {}
+        # line -> the tool prefixes that wrote a disable=all there (an
+        # "all" only silences rules in its own prefix's jurisdiction)
+        self.all_prefixes: Dict[int, Set[str]] = {}
         self.hot_lines: Set[int] = set()
         # directives count only inside REAL comment tokens: a docstring
         # that merely documents the syntax must neither suppress a
@@ -83,8 +106,12 @@ class FileContext:
         for lineno, text in self._comment_lines(source):
             m = SUPPRESS_RE.search(text)
             if m:
-                self.suppressions[lineno] = {
-                    r.strip() for r in m.group(1).split(",") if r.strip()}
+                tokens = {r.strip() for r in m.group(2).split(",")
+                          if r.strip()}
+                self.suppressions[lineno] = tokens
+                if "all" in tokens:
+                    self.all_prefixes.setdefault(lineno, set()).add(
+                        m.group(1))
             if HOT_PATH_RE.search(text):
                 self.hot_lines.add(lineno)
 
@@ -124,7 +151,9 @@ class FileContext:
                 continue
             if rule in rules:
                 return (cand, rule)
-            if "all" in rules:
+            if "all" in rules and any(
+                    rule in _all_jurisdiction(prefix)
+                    for prefix in self.all_prefixes.get(cand, ())):
                 return (cand, "all")
         return None
 
@@ -152,12 +181,22 @@ class FileContext:
 class Rule:
     """A named check over one FileContext.  Subclasses set ``name`` and
     ``summary`` and implement ``check``; ``@register`` adds them to the
-    engine's default set."""
+    engine's default set.
+
+    ``scope = "package"`` rules see the WHOLE lint set at once: they
+    implement ``check_package`` over every parsed FileContext instead
+    of ``check`` — the shape a lock-order cycle needs (one acquisition
+    chain per module, the deadlock only visible across them)."""
 
     name: str = ""
     summary: str = ""
+    scope: str = "file"          # or "package"
 
     def check(self, ctx: FileContext) -> Iterable[Finding]:
+        raise NotImplementedError
+
+    def check_package(self, ctxs: Dict[str, FileContext]
+                      ) -> Iterable[Finding]:
         raise NotImplementedError
 
 
@@ -309,6 +348,7 @@ def lint_paths(paths: Sequence[str],
                disable: Sequence[str] = (),
                baseline_fingerprints: Optional[Set[str]] = None,
                audit_suppressions: bool = False,
+               audit_universe: Optional[Set[str]] = None,
                ) -> LintResult:
     """Run the (selected) rules over every ``.py`` under ``paths``.
 
@@ -320,7 +360,14 @@ def lint_paths(paths: Sequence[str],
     directive whose rule no longer fires on its line (the
     stale-suppression rot killer) as an ``unused-suppression`` finding.
     Directives naming rules that exist but were not selected this run
-    are left alone — only a full-rule-set run can call them stale."""
+    are left alone — only a full-rule-set run can call them stale.
+    ``audit_universe``: the rule set a run must cover to have standing
+    to call ``disable=all`` stale (default: every registered rule).
+    The CLIs pass their own catalogue — gan4j-lint's file-scope set,
+    gan4j-race's concurrency set — so each tool's default run keeps
+    auditing ``all`` within its jurisdiction; a ``disable=all`` that
+    guards the OTHER tool's finding should be narrowed to the exact
+    rule token (the audit message says so)."""
     registry = all_rules()
     selected = list(rules) if rules else sorted(registry)
     unknown = [r for r in list(selected) + list(disable)
@@ -331,8 +378,15 @@ def lint_paths(paths: Sequence[str],
     instances = [registry[r]() for r in selected if r not in set(disable)]
     active = {r.name for r in instances}
     baseline_fingerprints = baseline_fingerprints or set()
+    if audit_universe is None:
+        audit_universe = set(registry)
+
+    file_rules = [r for r in instances if r.scope == "file"]
+    package_rules = [r for r in instances if r.scope == "package"]
 
     result = LintResult([], [], [], [])
+    ctx_by_path: Dict[str, FileContext] = {}
+    findings_by_path: Dict[str, List[Finding]] = {}
     for path in iter_python_files(paths):
         result.files_checked += 1
         try:
@@ -345,9 +399,20 @@ def lint_paths(paths: Sequence[str],
                 rule="parse-error", path=path, line=int(lineno),
                 message=f"could not parse: {e.__class__.__name__}: {e}"))
             continue
-        file_findings: List[Finding] = []
-        for rule in instances:
-            file_findings.extend(rule.check(ctx))
+        ctx_by_path[path] = ctx
+        findings_by_path[path] = []
+        for rule in file_rules:
+            findings_by_path[path].extend(rule.check(ctx))
+    # package-scope rules run once over every parsed file: a lock-order
+    # cycle's two halves usually live in two modules
+    for rule in package_rules:
+        for f in rule.check_package(ctx_by_path):
+            if f.path in findings_by_path:
+                findings_by_path[f.path].append(f)
+            else:  # defensive: a finding pointing outside the lint set
+                result.findings.append(f)
+    for path, ctx in ctx_by_path.items():
+        file_findings = findings_by_path[path]
         file_findings.sort(key=lambda f: (f.line, f.rule))
         used_sites: Set[Tuple[int, str]] = set()
         classify: List[Finding] = []
@@ -360,7 +425,8 @@ def lint_paths(paths: Sequence[str],
             classify.append(f)
         if audit_suppressions:
             classify.extend(_audit_suppressions(ctx, used_sites, active,
-                                                registry, result))
+                                                registry, result,
+                                                audit_universe))
             classify.sort(key=lambda f: (f.line, f.rule))
         # occurrence index per (rule, snippet) so identical lines get
         # distinct baseline fingerprints
@@ -379,7 +445,8 @@ def lint_paths(paths: Sequence[str],
 def _audit_suppressions(ctx: FileContext,
                         used_sites: Set[Tuple[int, str]],
                         active: Set[str], registry: Dict[str, type],
-                        result: LintResult) -> List[Finding]:
+                        result: LintResult,
+                        audit_universe: Set[str]) -> List[Finding]:
     """``unused-suppression`` findings for every directive token that
     silenced nothing this run (its own suppression/baseline treatment
     happens in the caller's classification pass, so a justified
@@ -393,11 +460,12 @@ def _audit_suppressions(ctx: FileContext,
                 # "all" is spent if ANY rule was silenced at this site
                 if any(site_line == line for site_line, _ in used_sites):
                     continue
-                if active != set(registry):
-                    # a partial-rule run cannot call "all" stale: the
-                    # finding it silences may belong to a rule that
-                    # did not run (same unknowability as the
-                    # exact-token branch below)
+                if not audit_universe <= active:
+                    # a partial run (vs the auditing tool's own
+                    # catalogue) cannot call "all" stale: the finding
+                    # it silences may belong to a rule that did not
+                    # run (same unknowability as the exact-token
+                    # branch below)
                     continue
                 message = ("'disable=all' silenced nothing here — "
                            "stale; remove it or narrow it to a rule")

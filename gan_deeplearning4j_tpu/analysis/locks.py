@@ -1,0 +1,826 @@
+"""Whole-package lock model — the shared substrate of the gan4j-race
+static rules (rules_concurrency.py: ``lock-order-cycle``,
+``lock-held-blocking-call``, ``thread-hygiene``).
+
+The ops layer built in PRs 2-8 is deeply concurrent: ~12 modules own
+``threading.Lock``/``RLock``/``Event``, and the one concurrency rule
+that predates this (``unlocked-shared-write``) only sees a single lock
+inside a single class.  A deadlock needs TWO locks and usually two
+modules — so this module extracts a package-wide view from the ASTs
+the engine already parsed:
+
+* **lock identities** — ``self._lock = threading.Lock()`` in class C of
+  module M becomes the node ``M.C._lock`` (one node per *declaration
+  site*, the static analogue of a lockdep lock class); module-level
+  ``lock = threading.Lock()`` becomes ``M.lock``.  The factory kind is
+  kept: an RLock may be re-acquired by its holder, a Lock may not.
+* **acquisition order** — every function is walked with a held-lock
+  stack (``with self._lock:`` nesting plus straight-line
+  ``acquire()``/``release()`` pairs); acquiring B while holding A adds
+  the edge A→B with a witness chain (file:line frames a human can
+  follow).
+* **a direct call graph** — ``self.method()``, same-module ``f()`` and
+  imported-module ``mod.f()`` calls are resolved where unambiguous, so
+  nested acquisition propagates: if ``f`` holds A and calls ``g`` which
+  takes B, the edge A→B exists even though no single function shows it.
+* **blocking sites** — calls that park the thread (``join``, queue
+  ``get``/``put``, ``Event.wait``, ``block_until_ready``/
+  ``device_fence``, ``fsync``, ``sleep``, socket ops), again propagated
+  through the call graph, for the lock-held-across-blocking-call rule.
+* **thread construction sites** — every ``threading.Thread(...)`` call
+  with its ``name``/``daemon`` kwargs and, for non-daemon threads, the
+  bounded ``join`` reachability the hygiene rule demands.
+
+Everything here is a heuristic over source, deliberately conservative:
+dynamic dispatch (callback lists, ``getattr``) is unresolvable and
+silently skipped — the runtime half (``sanitizers.lockdep``) exists to
+catch what this cannot.  docs/STATIC_ANALYSIS.md § Concurrency
+discipline is the contract.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import os
+import re
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+from gan_deeplearning4j_tpu.analysis.engine import (
+    FileContext,
+    dotted_name,
+    last_segment,
+)
+
+LOCK_FACTORIES = {"Lock": "lock", "RLock": "rlock",
+                  "Condition": "lock", "Semaphore": "lock",
+                  "BoundedSemaphore": "lock"}
+
+# calls that park the calling thread (or serialize it on the device /
+# the disk / the network) — the things that must never run while a
+# shared lock is held: every other thread then stalls with you, which
+# is exactly how a slow checkpoint save becomes a fleet-wide hang
+_SOCKET_BLOCKERS = {"recv", "recvfrom", "sendall", "accept", "connect",
+                    "urlopen"}
+_QUEUE_RECV_RE = re.compile(r"^_?q(ueue)?s?$|^_?(job|task|work)s?(_q)?$",
+                            re.IGNORECASE)
+
+CLOSE_METHODS = {"close", "stop", "shutdown", "__exit__", "__del__",
+                 "join", "quiesce", "terminate", "wait", "finish"}
+
+
+@dataclasses.dataclass(frozen=True)
+class Frame:
+    """One step of a witness chain: a place in the source a human can
+    click through when reconstructing an acquisition order."""
+
+    path: str
+    line: int
+    what: str
+
+    def render(self) -> str:
+        return f"{os.path.basename(self.path)}:{self.line} {self.what}"
+
+
+@dataclasses.dataclass
+class ThreadSite:
+    """One ``threading.Thread(...)`` construction."""
+
+    path: str
+    line: int
+    func: str                    # enclosing function qualname
+    has_name: bool
+    has_daemon: bool
+    daemon_false: bool           # explicitly daemon=False
+    target_attr: Optional[str]   # self.<attr> it was assigned to
+    target_local: Optional[str]  # local name it was assigned to
+    cls: Optional[str]           # enclosing class name
+
+
+@dataclasses.dataclass
+class _FnInfo:
+    qualname: str                          # Module.Class.method display
+    path: str
+    cls: Optional[str]
+    name: str
+    # (lock_id, line, held_tuple) per acquisition in source order
+    acquisitions: List[Tuple[str, int, Tuple[str, ...]]]
+    # (callee_candidates, line, held_tuple) per resolvable call site
+    calls: List[Tuple[Tuple[str, ...], int, Tuple[str, ...]]]
+    # (description, line, held_tuple) per blocking call
+    blocking: List[Tuple[str, int, Tuple[str, ...]]]
+
+
+class LockModel:
+    """The package-wide lock/call/thread view (module docstring)."""
+
+    def __init__(self, ctxs: Dict[str, FileContext]):
+        self.ctxs = ctxs
+        # module key per path: the dotted-ish display name; import
+        # resolution goes through the basename index below
+        self._mod_name: Dict[str, str] = {}
+        basenames: Dict[str, List[str]] = {}
+        for path in ctxs:
+            base = os.path.splitext(os.path.basename(path))[0]
+            basenames.setdefault(base, []).append(path)
+        for base, paths in basenames.items():
+            if len(paths) == 1:
+                self._mod_name[paths[0]] = base
+                continue
+            # two files named worker.py must NOT merge their lock
+            # identities (a false cross-file cycle): qualify colliding
+            # names with as many parent directories as the GROUP needs
+            # to be pairwise distinct (paths are dict keys, so full
+            # paths always differ and the loop terminates)
+            def suffix(p: str, d: int) -> str:
+                parts = os.path.normpath(p).split(os.sep)
+                parts[-1] = base
+                return "/".join(parts[-d:])
+
+            depth = 2
+            while len({suffix(p, depth) for p in paths}) < len(paths):
+                depth += 1
+            for path in paths:
+                self._mod_name[path] = suffix(path, depth)
+        # unambiguous basename -> path (two files named util.py cannot
+        # be told apart from an import site: skip, stay conservative)
+        self._by_basename = {b: ps[0] for b, ps in basenames.items()
+                             if len(ps) == 1}
+
+        self.lock_kinds: Dict[str, str] = {}      # lock id -> lock|rlock
+        self.lock_sites: Dict[str, Frame] = {}    # lock id -> declaration
+        self.threads: List[ThreadSite] = []
+        self._fns: Dict[Tuple[str, str], _FnInfo] = {}
+        # per (path, class): attr -> lock id, plus module-level names
+        self._class_locks: Dict[Tuple[str, str], Dict[str, str]] = {}
+        self._module_locks: Dict[str, Dict[str, str]] = {}
+
+        for path, ctx in ctxs.items():
+            self._collect_locks(path, ctx)
+        for path, ctx in ctxs.items():
+            self._collect_functions(path, ctx)
+        self._trans_cache: Dict[Tuple[str, str],
+                                Dict[str, List[Frame]]] = {}
+        self._block_cache: Dict[Tuple[str, str],
+                                Optional[List[Frame]]] = {}
+        self._edges_cache: Optional[Dict[Tuple[str, str],
+                                         List[Frame]]] = None
+
+    # -- collection -----------------------------------------------------------
+
+    def _lock_factory_kind(self, call: ast.AST) -> Optional[str]:
+        if not isinstance(call, ast.Call):
+            return None
+        seg = last_segment(call.func)
+        return LOCK_FACTORIES.get(seg or "")
+
+    def _collect_locks(self, path: str, ctx: FileContext) -> None:
+        mod = self._mod_name[path]
+        module_locks: Dict[str, str] = {}
+        for stmt in ctx.tree.body:
+            if isinstance(stmt, ast.Assign):
+                kind = self._lock_factory_kind(stmt.value)
+                if kind:
+                    for t in stmt.targets:
+                        if isinstance(t, ast.Name):
+                            lock_id = f"{mod}.{t.id}"
+                            module_locks[t.id] = lock_id
+                            self.lock_kinds[lock_id] = kind
+                            self.lock_sites[lock_id] = Frame(
+                                path, stmt.lineno,
+                                f"declares {lock_id}")
+        self._module_locks[path] = module_locks
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.ClassDef):
+                continue
+            attrs: Dict[str, str] = {}
+            for sub in ast.walk(node):
+                if not isinstance(sub, ast.Assign):
+                    continue
+                kind = self._lock_factory_kind(sub.value)
+                if not kind:
+                    continue
+                for t in sub.targets:
+                    if (isinstance(t, ast.Attribute)
+                            and isinstance(t.value, ast.Name)
+                            and t.value.id == "self"):
+                        lock_id = f"{mod}.{node.name}.{t.attr}"
+                        attrs[t.attr] = lock_id
+                        self.lock_kinds[lock_id] = kind
+                        self.lock_sites[lock_id] = Frame(
+                            path, sub.lineno, f"declares {lock_id}")
+            if attrs:
+                self._class_locks[(path, node.name)] = attrs
+
+    def _collect_functions(self, path: str, ctx: FileContext) -> None:
+        mod = self._mod_name[path]
+        imports = self._import_map(ctx.tree)
+
+        def visit(node: ast.AST, cls: Optional[str]) -> None:
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, ast.ClassDef):
+                    visit(child, child.name)
+                elif isinstance(child, (ast.FunctionDef,
+                                        ast.AsyncFunctionDef)):
+                    qual = (f"{mod}.{cls}.{child.name}" if cls
+                            else f"{mod}.{child.name}")
+                    info = _FnInfo(qual, path, cls, child.name,
+                                   [], [], [])
+                    self._walk_body(child.body, [], info, path, cls,
+                                    imports)
+                    key = (path, f"{cls}.{child.name}" if cls
+                           else child.name)
+                    self._fns[key] = info
+                    visit(child, cls)  # nested defs keep the class
+                else:
+                    visit(child, cls)
+
+        visit(ctx.tree, None)
+
+    @staticmethod
+    def _import_map(tree: ast.Module) -> Dict[str, str]:
+        """local name -> imported module basename (``from x import
+        events`` and ``import x.y as z`` both land here)."""
+        out: Dict[str, str] = {}
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Import):
+                for alias in node.names:
+                    if alias.asname:
+                        # `import a.b as z`: z is bound to module a.b
+                        out[alias.asname] = alias.name.split(".")[-1]
+                    else:
+                        # `import a.b`: the bound name is the TOP
+                        # package a, not b
+                        top = alias.name.split(".")[0]
+                        out[top] = top
+            elif isinstance(node, ast.ImportFrom):
+                for alias in node.names:
+                    out[alias.asname or alias.name] = alias.name
+        return out
+
+    # -- the per-function walk ------------------------------------------------
+
+    def _lock_id_for_expr(self, expr: ast.AST, path: str,
+                          cls: Optional[str]) -> Optional[str]:
+        """``self._lock`` / module-level ``lockname`` (possibly behind
+        ``.acquire_timeout(...)``-style helpers) -> lock id."""
+        for node in ast.walk(expr):
+            if (isinstance(node, ast.Attribute)
+                    and isinstance(node.value, ast.Name)
+                    and node.value.id == "self" and cls):
+                lid = self._class_locks.get((path, cls), {}).get(
+                    node.attr)
+                if lid:
+                    return lid
+            if isinstance(node, ast.Name):
+                lid = self._module_locks.get(path, {}).get(node.id)
+                if lid:
+                    return lid
+        return None
+
+    def _walk_body(self, body: Sequence[ast.stmt], held: List[str],
+                   info: _FnInfo, path: str, cls: Optional[str],
+                   imports: Dict[str, str]) -> None:
+        for stmt in body:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef)):
+                continue  # separate scope, walked separately
+            if isinstance(stmt, (ast.With, ast.AsyncWith)):
+                pushed = 0
+                for item in stmt.items:
+                    lid = self._lock_id_for_expr(item.context_expr,
+                                                 path, cls)
+                    if lid:
+                        self._acquire(lid, item.context_expr.lineno,
+                                      held, info)
+                        held.append(lid)
+                        pushed += 1
+                    else:
+                        self._scan_expr(item.context_expr, held, info,
+                                        path, cls, imports)
+                self._walk_body(stmt.body, held, info, path, cls,
+                                imports)
+                for _ in range(pushed):
+                    held.pop()
+                continue
+            lid = self._explicit_lock_call(stmt, path, cls, "acquire")
+            if lid:
+                self._acquire(lid, stmt.lineno, held, info)
+                held.append(lid)
+                continue
+            lid = self._explicit_lock_call(stmt, path, cls, "release")
+            if lid:
+                if lid in held:
+                    held.remove(lid)
+                continue
+            if isinstance(stmt, ast.If):
+                self._scan_expr(stmt.test, held, info, path, cls,
+                                imports)
+                self._walk_body(stmt.body, list(held), info, path, cls,
+                                imports)
+                self._walk_body(stmt.orelse, list(held), info, path,
+                                cls, imports)
+            elif isinstance(stmt, (ast.For, ast.AsyncFor)):
+                self._scan_expr(stmt.iter, held, info, path, cls,
+                                imports)
+                self._walk_body(list(stmt.body), list(held), info,
+                                path, cls, imports)
+                self._walk_body(list(stmt.orelse), list(held), info,
+                                path, cls, imports)
+            elif isinstance(stmt, ast.While):
+                self._scan_expr(stmt.test, held, info, path, cls,
+                                imports)
+                self._walk_body(list(stmt.body), list(held), info,
+                                path, cls, imports)
+                self._walk_body(list(stmt.orelse), list(held), info,
+                                path, cls, imports)
+            elif isinstance(stmt, ast.Try):
+                # body/else/finally share the live held list: the
+                # canonical `acquire(); try: ... finally: release()`
+                # idiom must propagate its release OUT of the try — a
+                # copied list would leave the lock phantom-held for the
+                # rest of the function (false blocking/order findings).
+                # Handlers stay on a copy: they may or may not run.
+                for handler in stmt.handlers:
+                    self._walk_body(handler.body, list(held), info,
+                                    path, cls, imports)
+                for block in (stmt.body, stmt.orelse, stmt.finalbody):
+                    self._walk_body(block, held, info, path, cls,
+                                    imports)
+            else:
+                self._scan_expr(stmt, held, info, path, cls, imports)
+
+    def _explicit_lock_call(self, stmt: ast.stmt, path: str,
+                            cls: Optional[str],
+                            which: str) -> Optional[str]:
+        if not (isinstance(stmt, ast.Expr)
+                and isinstance(stmt.value, ast.Call)
+                and isinstance(stmt.value.func, ast.Attribute)
+                and stmt.value.func.attr == which):
+            return None
+        return self._lock_id_for_expr(stmt.value.func.value, path, cls)
+
+    def _acquire(self, lid: str, line: int, held: List[str],
+                 info: _FnInfo) -> None:
+        info.acquisitions.append((lid, line, tuple(held)))
+
+    def _scan_expr(self, node: ast.AST, held: List[str], info: _FnInfo,
+                   path: str, cls: Optional[str],
+                   imports: Dict[str, str]) -> None:
+        """Record resolvable calls, blocking calls and thread
+        constructions inside one statement/expression."""
+        for sub in ast.walk(node):
+            if not isinstance(sub, ast.Call):
+                continue
+            self._record_thread(sub, node, info, path, cls)
+            desc = _blocking_desc(sub)
+            effective_held = tuple(held)
+            if desc:
+                cond_lid = self._held_condition_wait_lock(sub, held,
+                                                          path, cls)
+                if cond_lid is not None:
+                    # `with self._cond: self._cond.wait()` — the
+                    # canonical condition-variable idiom: wait()
+                    # atomically RELEASES the condition's OWN lock
+                    # while parked.  Any OTHER lock held across the
+                    # wait stays held for the whole park, so those
+                    # still count — and the entry is kept even with
+                    # nothing else held, so a CALLER holding a lock
+                    # across this function still sees it as blocking.
+                    effective_held = tuple(h for h in held
+                                           if h != cond_lid)
+            if desc:
+                info.blocking.append((desc, sub.lineno, effective_held))
+                continue
+            cands = self._callee_candidates(sub, path, cls, imports)
+            if cands:
+                info.calls.append((cands, sub.lineno, tuple(held)))
+
+    def _held_condition_wait_lock(self, call: ast.Call,
+                                  held: List[str], path: str,
+                                  cls: Optional[str]) -> Optional[str]:
+        """The held lock id a ``cond.wait()`` call atomically releases
+        (the receiver's own lock), None when this is not that shape."""
+        f = call.func
+        if not (isinstance(f, ast.Attribute)
+                and f.attr in ("wait", "wait_for")):
+            return None
+        lid = self._lock_id_for_expr(f.value, path, cls)
+        return lid if lid is not None and lid in held else None
+
+    def _callee_candidates(self, call: ast.Call, path: str,
+                           cls: Optional[str],
+                           imports: Dict[str, str]
+                           ) -> Tuple[str, ...]:
+        """(path, fn_key) candidates encoded as "path::key" strings for
+        a call we can resolve statically; empty when dynamic.
+        Candidates are recorded WITHOUT checking they exist — collection
+        order must not matter (a callee defined later in the file, or in
+        a file walked later, still counts); the graph consumers resolve
+        against the finished function table and drop misses."""
+        f = call.func
+        out: List[str] = []
+        if (isinstance(f, ast.Attribute) and isinstance(f.value, ast.Name)
+                and f.value.id == "self" and cls):
+            out.append(f"{path}::{cls}.{f.attr}")
+        elif isinstance(f, ast.Name):
+            out.append(f"{path}::{f.id}")
+        elif isinstance(f, ast.Attribute) and isinstance(f.value,
+                                                         ast.Name):
+            mod = imports.get(f.value.id)
+            if mod:
+                target = self._by_basename.get(mod)
+                if target:
+                    out.append(f"{target}::{f.attr}")
+        return tuple(out)
+
+    def _record_thread(self, call: ast.Call, stmt: ast.AST,
+                       info: _FnInfo, path: str,
+                       cls: Optional[str]) -> None:
+        name = dotted_name(call.func)
+        if not (name == "threading.Thread"
+                or (isinstance(call.func, ast.Name)
+                    and call.func.id == "Thread")):
+            return
+        kwargs = {k.arg for k in call.keywords if k.arg}
+        daemon_false = any(
+            k.arg == "daemon" and isinstance(k.value, ast.Constant)
+            and k.value.value is False for k in call.keywords)
+        target_attr = target_local = None
+        if isinstance(stmt, ast.Assign) and stmt.value is call:
+            for t in stmt.targets:
+                if (isinstance(t, ast.Attribute)
+                        and isinstance(t.value, ast.Name)
+                        and t.value.id == "self"):
+                    target_attr = t.attr
+                elif isinstance(t, ast.Name):
+                    target_local = t.id
+        self.threads.append(ThreadSite(
+            path=path, line=call.lineno, func=info.qualname,
+            has_name="name" in kwargs, has_daemon="daemon" in kwargs,
+            daemon_false=daemon_false, target_attr=target_attr,
+            target_local=target_local, cls=cls))
+
+    # -- derived views --------------------------------------------------------
+
+    def functions(self) -> Iterable[_FnInfo]:
+        return self._fns.values()
+
+    def transitive_locks(self, key: Tuple[str, str],
+                         _seen: Optional[Set] = None
+                         ) -> Dict[str, List[Frame]]:
+        """lock id -> witness frames for every lock ``key``'s function
+        acquires directly or through resolvable calls."""
+        if key in self._trans_cache:
+            return self._trans_cache[key]
+        _seen = _seen or set()
+        if key in _seen or key not in self._fns:
+            return {}
+        _seen.add(key)
+        info = self._fns[key]
+        out: Dict[str, List[Frame]] = {}
+        for lid, line, _held in info.acquisitions:
+            out.setdefault(lid, [Frame(info.path, line,
+                                       f"{info.qualname} acquires "
+                                       f"{lid}")])
+        for cands, line, _held in info.calls:
+            for cand in cands:
+                cpath, ckey = cand.split("::", 1)
+                sub = self.transitive_locks((cpath, ckey), _seen)
+                for lid, frames in sub.items():
+                    if lid not in out:
+                        out[lid] = [Frame(info.path, line,
+                                          f"{info.qualname} calls "
+                                          f"{ckey.split('.')[-1]}()")
+                                    ] + frames
+        # cached unconditionally: inside a call cycle the result may be
+        # conservative (a lint under-approximation, never a crash)
+        self._trans_cache[key] = out
+        return out
+
+    def blocking_chain(self, key: Tuple[str, str],
+                       _seen: Optional[Set] = None
+                       ) -> Optional[List[Frame]]:
+        """Witness frames to the first blocking site reachable from
+        ``key``'s function WITHOUT an intervening release — approximated
+        as: any blocking call in it or any resolvable callee.  None when
+        the function provably (at this heuristic's strength) never
+        blocks."""
+        if key in self._block_cache:
+            return self._block_cache[key]
+        _seen = _seen or set()
+        if key in _seen or key not in self._fns:
+            return None
+        _seen.add(key)
+        info = self._fns[key]
+        result: Optional[List[Frame]] = None
+        for desc, line, _held in info.blocking:
+            result = [Frame(info.path, line,
+                            f"{info.qualname} blocks in {desc}")]
+            break
+        if result is None:
+            for cands, line, _held in info.calls:
+                for cand in cands:
+                    cpath, ckey = cand.split("::", 1)
+                    sub = self.blocking_chain((cpath, ckey), _seen)
+                    if sub:
+                        result = [Frame(info.path, line,
+                                        f"{info.qualname} calls "
+                                        f"{ckey.split('.')[-1]}()")
+                                  ] + sub
+                        break
+                if result:
+                    break
+        self._block_cache[key] = result
+        return result
+
+    def acquisition_edges(self) -> Dict[Tuple[str, str], List[Frame]]:
+        """(held, acquired) -> witness chain, over direct nesting AND
+        call-propagated nesting.  Reentrant (rlock) self-edges are
+        dropped; a plain-Lock self-edge is kept — that one is not an
+        ordering hazard but a guaranteed self-deadlock.  Memoized: the
+        cycle finder and the rule both read the same edge set."""
+        if self._edges_cache is not None:
+            return self._edges_cache
+        edges: Dict[Tuple[str, str], List[Frame]] = {}
+
+        def add(a: str, b: str, frames: List[Frame]) -> None:
+            if a == b and self.lock_kinds.get(a) == "rlock":
+                return  # reentrant: the holder may re-enter
+            edges.setdefault((a, b), frames)
+
+        for (path, fkey), info in self._fns.items():
+            for lid, line, held in info.acquisitions:
+                for h in held:
+                    add(h, lid,
+                        [Frame(info.path, line,
+                               f"{info.qualname} acquires {lid} "
+                               f"while holding {h}")])
+            for cands, line, held in info.calls:
+                if not held:
+                    continue
+                for cand in cands:
+                    cpath, ckey = cand.split("::", 1)
+                    sub = self.transitive_locks((cpath, ckey))
+                    for lid, frames in sub.items():
+                        for h in held:
+                            # add() drops reentrant self-edges; a
+                            # plain-Lock self-edge through a call chain
+                            # is kept — holder re-entering its own
+                            # non-reentrant lock is a self-deadlock
+                            add(h, lid,
+                                [Frame(info.path, line,
+                                       f"{info.qualname} holds {h} and "
+                                       f"calls {ckey.split('.')[-1]}()")
+                                 ] + frames)
+        self._edges_cache = edges
+        return edges
+
+    def lock_cycles(self) -> List[List[Tuple[str, str]]]:
+        """Cycles in the acquisition-order graph, each as a list of
+        (held, acquired) edges — a 2-cycle [(A,B),(B,A)] is the classic
+        AB/BA deadlock; a self-loop [(A,A)] is a plain Lock re-entered
+        by its own holder."""
+        edges = self.acquisition_edges()
+        adj: Dict[str, Set[str]] = {}
+        for (a, b) in edges:
+            adj.setdefault(a, set()).add(b)
+        cycles: List[List[Tuple[str, str]]] = []
+        seen_cycles: Set[Tuple[str, ...]] = set()
+
+        for (a, b) in sorted(edges):
+            if a == b:
+                cycles.append([(a, a)])
+                continue
+            # path b -> ... -> a closes the cycle a -> b -> ... -> a
+            path = shortest_path(adj, b, a)
+            if path is None:
+                continue
+            nodes = [a] + path
+            canon = tuple(sorted(set(nodes)))
+            if canon in seen_cycles:
+                continue  # one report per distinct lock set
+            seen_cycles.add(canon)
+            cycles.append([(nodes[i], nodes[i + 1])
+                           for i in range(len(nodes) - 1)])
+        return cycles
+
+    def held_blocking_sites(self) -> List[Tuple[str, int, str, str,
+                                                List[Frame]]]:
+        """(path, line, lock, desc, chain) for every blocking call made
+        while a known lock is held — directly, or through a resolvable
+        call chain."""
+        out = []
+        for (path, fkey), info in self._fns.items():
+            for desc, line, held in info.blocking:
+                for h in held:
+                    out.append((info.path, line, h, desc,
+                                [Frame(info.path, line,
+                                       f"{info.qualname} blocks in "
+                                       f"{desc} holding {h}")]))
+            for cands, line, held in info.calls:
+                if not held:
+                    continue
+                for cand in cands:
+                    cpath, ckey = cand.split("::", 1)
+                    chain = self.blocking_chain((cpath, ckey))
+                    if not chain:
+                        continue
+                    for h in held:
+                        out.append((
+                            info.path, line, h,
+                            chain[-1].what,
+                            [Frame(info.path, line,
+                                   f"{info.qualname} holds {h} and "
+                                   f"calls {ckey.split('.')[-1]}()")
+                             ] + chain))
+                    break  # one candidate witness is enough
+        return out
+
+    def join_bounded(self, site: ThreadSite) -> bool:
+        """True when a bounded ``X.join(timeout)`` for the thread is
+        reachable from a close/stop-style path: a method of the OWNING
+        class whose name is in ``CLOSE_METHODS`` joining
+        ``self.<attr>``, or — for a function-local thread — a bounded
+        join anywhere in the same file (locals rarely outlive their
+        function).  A join in an unrelated class or in the worker loop
+        itself does not count: the contract is that the thread's owner
+        can shut it down."""
+        ctx = self.ctxs.get(site.path)
+        if ctx is None:
+            return False
+        attr = site.target_attr
+        local = site.target_local
+        if attr and site.cls:
+            owner = next(
+                (n for n in ast.walk(ctx.tree)
+                 if isinstance(n, ast.ClassDef) and n.name == site.cls),
+                None)
+            if owner is None:
+                return False
+            for method in owner.body:
+                if not isinstance(method, (ast.FunctionDef,
+                                           ast.AsyncFunctionDef)):
+                    continue
+                if method.name not in CLOSE_METHODS:
+                    continue
+                if self._joins_self_attr(method, attr):
+                    return True
+            return False
+        if local:
+            for node in ast.walk(ctx.tree):
+                if (isinstance(node, ast.Call)
+                        and isinstance(node.func, ast.Attribute)
+                        and node.func.attr == "join"
+                        and _bounded_join(node)
+                        and isinstance(node.func.value, ast.Name)
+                        and node.func.value.id == local):
+                    return True
+        return False
+
+    @staticmethod
+    def _joins_self_attr(method: ast.AST, attr: str) -> bool:
+        for node in ast.walk(method):
+            if (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "join"
+                    and _bounded_join(node)):
+                recv = node.func.value
+                if (isinstance(recv, ast.Attribute)
+                        and recv.attr == attr
+                        and isinstance(recv.value, ast.Name)
+                        and recv.value.id == "self"):
+                    return True
+                # the swap-then-join pattern (watchdog.stop): the attr
+                # is copied to a local under the lock and joined outside
+                if isinstance(recv, ast.Name) and _local_holds_attr(
+                        method, recv.id, attr):
+                    return True
+        return False
+
+
+def shortest_path(adj: Dict[str, Set[str]], src: str,
+                  dst: str) -> Optional[List[str]]:
+    """Shortest src->...->dst node list (both ends included), BFS; None
+    when unreachable.  Shared by the static cycle finder above and the
+    runtime lockdep graph (sanitizers.LockdepSanitizer) — one
+    implementation, deterministic via sorted expansion."""
+    from collections import deque
+
+    if src == dst:
+        return [src]
+    prev: Dict[str, str] = {}
+    dq = deque([src])
+    seen = {src}
+    while dq:
+        cur = dq.popleft()
+        for nxt in sorted(adj.get(cur, ())):
+            if nxt in seen:
+                continue
+            prev[nxt] = cur
+            if nxt == dst:
+                out = [dst]
+                while out[-1] != src:
+                    out.append(prev[out[-1]])
+                return list(reversed(out))
+            seen.add(nxt)
+            dq.append(nxt)
+    return None
+
+
+# one LockModel per lint run: the engine hands every package-scope rule
+# the SAME ctxs dict, and building the model (a whole-package AST walk)
+# three times for identical input would triple the gate's cost.  Single
+# slot, identity-keyed — a new run's dict is a new object.
+_MODEL_MEMO: List[Tuple[object, "LockModel"]] = []
+
+
+def build_lock_model(ctxs: Dict[str, FileContext]) -> "LockModel":
+    if _MODEL_MEMO and _MODEL_MEMO[0][0] is ctxs:
+        return _MODEL_MEMO[0][1]
+    model = LockModel(ctxs)
+    _MODEL_MEMO[:] = [(ctxs, model)]
+    return model
+
+
+def _local_holds_attr(method: ast.AST, local: str, attr: str) -> bool:
+    """True when ``local`` is assigned from ``self.<attr>`` somewhere in
+    the method — the swap-under-the-lock, join-outside pattern."""
+    for node in ast.walk(method):
+        if not isinstance(node, ast.Assign):
+            continue
+        values = (node.value.elts
+                  if isinstance(node.value, ast.Tuple) else [node.value])
+        for t in node.targets:
+            targets = t.elts if isinstance(t, ast.Tuple) else [t]
+            for tt, vv in zip(targets, values):
+                if (isinstance(tt, ast.Name) and tt.id == local
+                        and isinstance(vv, ast.Attribute)
+                        and vv.attr == attr
+                        and isinstance(vv.value, ast.Name)
+                        and vv.value.id == "self"):
+                    return True
+    return False
+
+
+def _bounded_join(call: ast.Call) -> bool:
+    """``t.join(5)`` / ``t.join(timeout=...)``: bounded.  ``t.join()``
+    is unbounded; ``", ".join(xs)`` is not a thread join at all."""
+    if any(k.arg == "timeout" for k in call.keywords):
+        return True
+    return (len(call.args) == 1
+            and isinstance(call.args[0], (ast.Constant, ast.Name,
+                                          ast.BinOp, ast.Attribute))
+            and not (isinstance(call.args[0], ast.Constant)
+                     and isinstance(call.args[0].value, str)))
+
+
+def _blocking_desc(call: ast.Call) -> Optional[str]:
+    """A short description when ``call`` is a thread-parking primitive,
+    None otherwise.  The allowlist (module docstring) is deliberately
+    narrow: ``dict.get(key)`` must never match, ``q.get()`` must."""
+    f = call.func
+    name = dotted_name(f)
+    seg = last_segment(f)
+    if seg is None:
+        return None
+    if seg == "join":
+        # thread/queue join: no positional args (timeout kw allowed) or
+        # one numeric timeout; str.join always has a non-numeric arg
+        if isinstance(f, ast.Attribute) and isinstance(
+                f.value, ast.Constant):
+            return None
+        if not call.args:
+            return f"{seg}()"
+        if (len(call.args) == 1
+                and isinstance(call.args[0], ast.Constant)
+                and isinstance(call.args[0].value, (int, float))):
+            return f"{seg}()"
+        return None
+    if seg == "wait":
+        return f"{seg}()"
+    if seg in ("get", "put"):
+        recv = last_segment(f.value) if isinstance(f, ast.Attribute) \
+            else None
+        if not (recv and _QUEUE_RECV_RE.match(recv)):
+            return None
+        if seg == "get" and not all(
+                isinstance(a, ast.Constant)
+                and isinstance(a.value, (bool, int, float))
+                for a in call.args):
+            # Queue.get takes only (block, timeout); a non-numeric
+            # positional is a KEY — `jobs.get(key)` on a dict that
+            # happens to carry a queue-ish name must never match
+            return None
+        # bounded or not: holding a shared lock for up to a queue
+        # timeout still stalls every other thread for that long
+        return f"{recv}.{seg}()"
+    if seg in ("block_until_ready", "device_fence", "fsync"):
+        return f"{seg}()"
+    if seg == "sleep":
+        return "sleep()"
+    if seg in _SOCKET_BLOCKERS:
+        return f"{seg}()"
+    if name and name.startswith("subprocess."):
+        return name
+    return None

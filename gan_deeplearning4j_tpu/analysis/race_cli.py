@@ -1,0 +1,49 @@
+"""``gan4j-race`` console entry point — the concurrency gate.
+
+The third pillar of the static-analysis story: gan4j-lint sees the AST,
+gan4j-prove sees the lowered program, gan4j-race sees the THREADS AND
+LOCKS — the whole-package acquisition-order graph, blocking calls made
+under locks, and thread construction hygiene
+(docs/STATIC_ANALYSIS.md § Concurrency discipline).  Same engine, exit
+codes and baseline semantics as gan4j-lint, restricted to the
+concurrency rule set (``rules_concurrency.RACE_RULES``):
+
+  lock-order-cycle          potential deadlock across modules
+  lock-held-blocking-call   slow op under a lock = fleet hang shape
+  thread-hygiene            name= / explicit daemon= / bounded join
+  unlocked-shared-write     the PR 6 single-class lock rule
+
+Exit codes: 0 no active findings, 1 findings or parse errors, 2 usage
+error.  With no paths, checks the installed package — ``gan4j-race``
+alone IS the repo gate (tier1.yml race lane).  Suppressions use
+``# gan4j-race: disable=<rule> — <reason>`` (the comment is the
+justification record; same policy as gan4j-lint).  The runtime half of
+the same contract is the ``lockdep()`` sanitizer
+(analysis/sanitizers.py), which catches the dynamic-dispatch orderings
+this static view cannot resolve.
+"""
+
+from __future__ import annotations
+
+import sys
+from typing import Optional
+
+from gan_deeplearning4j_tpu.analysis import cli as lint_cli
+from gan_deeplearning4j_tpu.analysis.rules_concurrency import RACE_RULES
+
+
+def main(argv: Optional[list] = None) -> int:
+    # allow_changed=False: a whole-package graph gate must not answer
+    # from a --changed file subset (the cycle's other half may live in
+    # an unchanged module) — and the full run costs under a second
+    return lint_cli.main(argv, rule_subset=RACE_RULES,
+                         prog="gan4j-race", description=__doc__,
+                         allow_changed=False)
+
+
+def cli(argv: Optional[list] = None) -> None:
+    sys.exit(main(argv))
+
+
+if __name__ == "__main__":
+    cli()

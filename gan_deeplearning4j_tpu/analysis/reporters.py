@@ -18,7 +18,8 @@ from typing import Dict
 from gan_deeplearning4j_tpu.analysis.engine import LintResult
 
 
-def render_human(result: LintResult, verbose: bool = False) -> str:
+def render_human(result: LintResult, verbose: bool = False,
+                 tool: str = "gan4j-lint") -> str:
     lines = []
     for f in result.errors:
         lines.append(f"{f.path}:{f.line}: {f.rule}: {f.message}")
@@ -34,7 +35,7 @@ def render_human(result: LintResult, verbose: bool = False) -> str:
             lines.append(f"{f.path}:{f.line}: {f.rule}: baselined: "
                          f"{f.message}")
     lines.append(
-        f"gan4j-lint: {len(result.findings)} finding(s), "
+        f"{tool}: {len(result.findings)} finding(s), "
         f"{len(result.suppressed)} suppressed, "
         f"{len(result.baselined)} baselined, "
         f"{len(result.errors)} parse error(s) "
@@ -42,9 +43,9 @@ def render_human(result: LintResult, verbose: bool = False) -> str:
     return "\n".join(lines) + "\n"
 
 
-def render_json(result: LintResult) -> str:
+def render_json(result: LintResult, tool: str = "gan4j-lint") -> str:
     doc: Dict = {
-        "tool": "gan4j-lint",
+        "tool": tool,
         "summary": {
             "findings": len(result.findings),
             "suppressed": len(result.suppressed),

@@ -12,6 +12,25 @@ bitten or nearly bitten:
   PR 4 restart-marker bug was exactly this shape (an over-narrow
   swallow masking real errors); the rule makes the pattern
   un-reintroducible without a written justification.
+
+PR 9 adds the gan4j-race set on top — the whole-package view a
+deadlock needs (one ``threading.Lock`` per class is survivable; the
+ORDER two classes take each other's locks in is where the watchdog-bait
+hangs live).  Built on the lock model in ``analysis/locks.py``:
+
+* ``lock-order-cycle``      — a cycle in the package-wide acquisition-
+  order graph (potential deadlock; both acquisition chains reported);
+* ``lock-held-blocking-call`` — ``join``/queue ``get``/``put``/
+  ``Event.wait``/``block_until_ready``/``device_fence``/``fsync``/
+  socket ops under ``with self._lock`` — the exact shape that turns a
+  slow save into a fleet hang;
+* ``thread-hygiene``        — every ``threading.Thread`` names itself
+  and states its daemon-ness, and a non-daemon thread has a bounded
+  ``join`` reachable from a ``close()``/``stop()`` path.
+
+Their suppressions use the ``# gan4j-race: disable=<rule> — <reason>``
+prefix (same engine, same policy: the comment IS the justification).
+``RACE_RULES`` below is the subset the ``gan4j-race`` CLI runs.
 """
 
 from __future__ import annotations
@@ -27,8 +46,20 @@ from gan_deeplearning4j_tpu.analysis.engine import (
     register,
 )
 
-LOCK_FACTORIES = {"Lock", "RLock", "Condition", "Semaphore",
-                  "BoundedSemaphore"}
+# the subset the `gan4j-race` CLI runs (race_cli.py): the three
+# whole-package lock rules plus the single-class lock rule they extend
+RACE_RULES = ("lock-order-cycle", "lock-held-blocking-call",
+              "thread-hygiene", "unlocked-shared-write")
+
+# ONE lock-factory catalogue: analysis/locks.py owns the kind-map (it
+# needs Lock-vs-RLock to honor reentrancy); this rule only needs the
+# names — deriving the set keeps the two halves of the gate agreeing
+# about what a lock is
+from gan_deeplearning4j_tpu.analysis.locks import (  # noqa: E402
+    LOCK_FACTORIES as _LOCK_FACTORY_KINDS,
+)
+
+LOCK_FACTORIES = frozenset(_LOCK_FACTORY_KINDS)
 # methods exempt from the lock discipline: construction happens-before
 # publication; *_locked is the repo's documented "caller holds the
 # lock" convention (telemetry/exporter.py, telemetry/events.py).
@@ -247,3 +278,149 @@ class SwallowedException(Rule):
     @staticmethod
     def _reraises(handler: ast.ExceptHandler) -> bool:
         return any(isinstance(n, ast.Raise) for n in ast.walk(handler))
+
+
+# -- the gan4j-race set (PR 9) — whole-package lock analysis ------------------
+
+
+@register
+class LockOrderCycle(Rule):
+    """A cycle in the package-wide lock acquisition-order graph
+    (analysis/locks.py): somewhere thread 1 can take A then B while
+    thread 2 takes B then A — a potential deadlock no single file
+    shows.  Each finding carries BOTH acquisition chains (file:line
+    witness frames), anchored at the first chain's acquisition site.
+    Reentrant (RLock) self-edges are exempt; a plain ``Lock`` acquired
+    by code already holding it — directly or through a call chain — is
+    reported as a self-cycle, the guaranteed single-thread deadlock."""
+
+    name = "lock-order-cycle"
+    summary = ("lock-order cycle across the package — a potential "
+               "deadlock (both acquisition chains reported)")
+    scope = "package"
+
+    def check_package(self, ctxs) -> Iterable[Finding]:
+        from gan_deeplearning4j_tpu.analysis.locks import (
+            build_lock_model,
+        )
+
+        model = build_lock_model(ctxs)
+        edges = model.acquisition_edges()
+        findings: List[Finding] = []
+        for cycle in model.lock_cycles():
+            chains = []
+            for i, edge in enumerate(cycle, 1):
+                frames = edges.get(edge, [])
+                chain = " -> ".join(fr.render() for fr in frames)
+                chains.append(f"chain {i}: {chain}")
+            order = " -> ".join([cycle[0][0]] + [b for _, b in cycle])
+            anchor = edges.get(cycle[0], [None])[0]
+            if anchor is None:
+                continue
+            ctx = ctxs.get(anchor.path)
+            if ctx is None:
+                continue
+            findings.append(ctx.finding(
+                self.name, anchor.line,
+                ("potential deadlock: lock-order cycle "
+                 f"{order}; " + "; ".join(chains)
+                 + " — pick ONE order and document it "
+                   "(docs/STATIC_ANALYSIS.md, concurrency discipline)")
+                if len(cycle) > 1 else
+                (f"self-deadlock: non-reentrant {cycle[0][0]} acquired "
+                 f"while already held; {chains[0]} — use an RLock or "
+                 f"the *_locked caller-holds-it convention")))
+        return findings
+
+
+@register
+class LockHeldBlockingCall(Rule):
+    """A blocking call — ``join``, queue ``get``/``put``,
+    ``Event.wait``, ``block_until_ready``/``device_fence``, ``fsync``,
+    ``sleep``, socket ops — made while a known lock is held, directly
+    or through a statically resolvable call chain.  Every other thread
+    needing that lock (a /healthz scrape, the watchdog's report feed, a
+    worker handing off records) then stalls behind the slow operation:
+    the exact shape that turns a slow checkpoint save into a
+    fleet-wide hang.  Move the slow call outside the critical section
+    (snapshot under the lock, do the work after — the pattern
+    ``train/watchdog.py`` ``stop()`` documents)."""
+
+    name = "lock-held-blocking-call"
+    summary = ("blocking call (join/queue/wait/fence/fsync/socket) "
+               "while holding a lock")
+    scope = "package"
+
+    def check_package(self, ctxs) -> Iterable[Finding]:
+        from gan_deeplearning4j_tpu.analysis.locks import (
+            build_lock_model,
+        )
+
+        model = build_lock_model(ctxs)
+        findings: List[Finding] = []
+        seen = set()
+        for path, line, lock, desc, chain in model.held_blocking_sites():
+            key = (path, line, lock)
+            if key in seen:
+                continue
+            seen.add(key)
+            ctx = ctxs.get(path)
+            if ctx is None:
+                continue
+            via = " -> ".join(fr.render() for fr in chain)
+            findings.append(ctx.finding(
+                self.name, line,
+                f"{desc} while holding {lock} ({via}) — every thread "
+                f"needing the lock stalls behind it; move the blocking "
+                f"call outside the critical section"))
+        return findings
+
+
+@register
+class ThreadHygiene(Rule):
+    """Every ``threading.Thread(...)`` must pass ``name=`` (a nameless
+    thread is an unreadable flight record, an unattributable lock hold
+    and an undebuggable stack dump) and an EXPLICIT ``daemon=`` (the
+    default silently inherits the creator's daemon-ness — whether the
+    process can exit while this thread runs is a decision, not an
+    accident).  A ``daemon=False`` thread must additionally have a
+    bounded ``join(timeout)`` reachable from a ``close()``/``stop()``
+    path — a non-daemon thread nobody joins is a process that never
+    exits."""
+
+    name = "thread-hygiene"
+    summary = ("threading.Thread without name=/explicit daemon=, or a "
+               "non-daemon thread with no bounded join on a close path")
+    scope = "package"
+
+    def check_package(self, ctxs) -> Iterable[Finding]:
+        from gan_deeplearning4j_tpu.analysis.locks import (
+            build_lock_model,
+        )
+
+        model = build_lock_model(ctxs)
+        findings: List[Finding] = []
+        for site in model.threads:
+            ctx = ctxs.get(site.path)
+            if ctx is None:
+                continue
+            missing = []
+            if not site.has_name:
+                missing.append("name=")
+            if not site.has_daemon:
+                missing.append("explicit daemon=")
+            if missing:
+                findings.append(ctx.finding(
+                    self.name, site.line,
+                    f"threading.Thread in {site.func} without "
+                    f"{' and '.join(missing)} — name the thread "
+                    f"(flight records and lock reports key on it) and "
+                    f"state its daemon-ness explicitly"))
+            if site.daemon_false and not model.join_bounded(site):
+                findings.append(ctx.finding(
+                    self.name, site.line,
+                    f"non-daemon thread in {site.func} with no bounded "
+                    f"join(timeout) reachable from a close()/stop() "
+                    f"path — an unjoined non-daemon thread is a "
+                    f"process that never exits"))
+        return findings

@@ -22,6 +22,24 @@ whatever slips past them, on the real program:
   directions are guarded — the CI (CPU) gate therefore proves the
   host->device half and the TPU bench run proves both.
 
+* ``lockdep`` (PR 9) — the runtime half of gan4j-race: while active,
+  ``threading.Lock``/``RLock`` allocations return order-tracking
+  proxies.  Each thread carries a held-set; every blocking acquisition
+  of B while holding A adds the edge A->B to a global acquisition-order
+  graph (keyed by ALLOCATION SITE — the lockdep "lock class", so two
+  instances of the same registry share one node), and an acquisition
+  that closes a cycle is an INVERSION: reported immediately with both
+  stacks (the current one and the first witness of the reverse path),
+  counted in ``gan4j_lock_inversions_total``, traced as a
+  ``lock.inversion`` event.  Wait time paid blocking on tracked locks
+  feeds ``gan4j_lock_wait_seconds_total``.  ``check()`` raises
+  ``LockOrderError`` on inversions and ``ThreadLeakError`` when
+  non-daemon threads born inside the window outlive it (the exit-time
+  thread-leak audit).  Non-blocking (``acquire(False)``) probes never
+  add edges — a trylock cannot deadlock.  Shipped as the ``lockdep``
+  pytest fixture and, under ``GAN4J_LOCKDEP=1``, wrapped around every
+  test in the chaos/supervision CI lanes (tests/conftest.py).
+
 Wiring: bench ``--dryrun`` (``sanitizer_ok`` folded into ``ok``),
 ``GANTrainer(sanitize=True)`` / ``--sanitize`` (observational: metric +
 event + warning, never kills a production run), and the
@@ -32,6 +50,7 @@ event + warning, never kills a production run), and the
 from __future__ import annotations
 
 import logging
+import os
 import threading
 from contextlib import contextmanager
 from typing import Callable, Dict, List, Optional
@@ -233,6 +252,380 @@ class RecompileSentinel:
                 f"{', '.join(sorted(set(self.recompiles)))} — the hot "
                 f"path promised a cached program (see "
                 f"docs/STATIC_ANALYSIS.md, rule recompile-hazard)")
+
+
+LOCK_WAIT_METRIC = "gan4j_lock_wait_seconds_total"
+LOCK_INVERSION_METRIC = "gan4j_lock_inversions_total"
+LOCK_INVERSION_EVENT = "lock.inversion"
+
+
+class LockOrderError(RuntimeError):
+    """An observed lock-order inversion under the lockdep sanitizer."""
+
+
+class ThreadLeakError(RuntimeError):
+    """Non-daemon threads created inside a lockdep window were still
+    alive at its end — a process that may never exit."""
+
+
+class _LockProxy:
+    """Order-tracking wrapper around one threading.Lock/RLock.
+
+    Bookkeeping happens AFTER a successful inner acquire and after a
+    successful inner release, never while the tracker's graph lock and
+    the wrapped lock interleave the other way — the sanitizer must not
+    introduce the bug class it hunts.  Once the owning tracker
+    deactivates (uninstall), the proxy degrades to a plain forwarder;
+    locks allocated during a window keep working forever after it."""
+
+    __slots__ = ("_inner", "_dep", "site", "_reentrant", "__weakref__")
+
+    def __init__(self, inner, dep: "LockdepSanitizer", site: str,
+                 reentrant: bool):
+        self._inner = inner
+        self._dep = dep
+        self.site = site
+        self._reentrant = reentrant
+
+    def acquire(self, blocking: bool = True, timeout: float = -1):
+        dep = self._dep
+        if dep is None or not dep.active or dep._in_hook():
+            return self._inner.acquire(blocking, timeout)
+        import time as _time
+
+        t0 = _time.perf_counter()
+        ok = self._inner.acquire(blocking, timeout)
+        if ok:
+            dep._acquired(self, blocking,
+                          _time.perf_counter() - t0 if blocking else 0.0)
+        return ok
+
+    def release(self) -> None:
+        self._inner.release()
+        dep = self._dep
+        if dep is not None and dep.active and not dep._in_hook():
+            dep._released(self)
+
+    def locked(self) -> bool:
+        return self._inner.locked()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.release()
+
+    def __getattr__(self, name):
+        # RLock internals Condition probes for (_is_owned,
+        # _release_save, _acquire_restore) forward to the real lock —
+        # those paths bypass tracking, which is conservative, never
+        # wrong (a missed edge, not a false inversion)
+        return getattr(self._inner, name)
+
+
+class LockdepSanitizer:
+    """Runtime lock-order verifier (module docstring).  Use via the
+    ``lockdep()`` context manager / pytest fixture; ``install()``/
+    ``uninstall()`` patch and restore ``threading.Lock``/``RLock``.
+
+    ``registry``: a telemetry MetricsRegistry — inversions increment
+    ``gan4j_lock_inversions_total`` and blocking-acquire wait time
+    accumulates into ``gan4j_lock_wait_seconds_total`` there (both
+    pre-created at 0 so the series exist before the first incident).
+    ``on_inversion``: extra callback per inversion report dict."""
+
+    def __init__(self, registry=None, on_inversion=None,
+                 stack_depth: int = 12):
+        self.registry = registry
+        self.on_inversion = on_inversion
+        self.stack_depth = int(stack_depth)
+        self.active = False
+        self.inversions: List[Dict] = []
+        self.acquisitions = 0              # proof the hook is alive
+        self.wait_seconds = 0.0
+        self.hold_seconds: Dict[str, float] = {}   # site -> total held
+        # edge (site_a, site_b) -> first witness {thread, stack}
+        self._edges: Dict = {}
+        self._adj: Dict[str, set] = {}
+        # inversion pairs already reported: one report per DISTINCT
+        # (held, acquiring) pair — an inverted pair inside a step loop
+        # must not flood the event log / grow memory per iteration
+        self._reported: set = set()
+        # id(proxy) -> live held entry [proxy, count, t0, holder_list].
+        # threading.Lock explicitly permits release from ANY thread
+        # (the handoff pattern), so release bookkeeping must find the
+        # HOLDER's entry, not the releasing thread's — keyed here,
+        # mutated only under the graph lock
+        self._live: Dict[int, list] = {}
+        self._tls = threading.local()
+        # the graph lock is a RAW lock from the ORIGINAL factory — the
+        # tracker must never route its own bookkeeping through a proxy
+        self._orig: Dict[str, Callable] = {}
+        self._graph_lock = threading.Lock()
+        self._baseline_threads: set = set()
+
+    # -- lifecycle ------------------------------------------------------------
+
+    def install(self) -> "LockdepSanitizer":
+        if self.active:
+            return self
+        with self._graph_lock:
+            self._orig = {"Lock": threading.Lock,
+                          "RLock": threading.RLock}
+            self._baseline_threads = {
+                t.ident for t in threading.enumerate()}
+        dep = self
+
+        def make_lock():
+            return _LockProxy(dep._orig["Lock"](), dep,
+                              dep._alloc_site("Lock"), reentrant=False)
+
+        def make_rlock():
+            return _LockProxy(dep._orig["RLock"](), dep,
+                              dep._alloc_site("RLock"), reentrant=True)
+
+        threading.Lock = make_lock
+        threading.RLock = make_rlock
+        with self._graph_lock:
+            self.active = True
+        if self.registry is not None:
+            # both series visible from the first scrape, incident or not
+            self.registry.inc(LOCK_INVERSION_METRIC, 0.0)
+            self.registry.inc(LOCK_WAIT_METRIC, 0.0)
+        return self
+
+    def uninstall(self) -> None:
+        if not self.active:
+            return
+        with self._graph_lock:
+            self.active = False
+            wait_total = self.wait_seconds
+        threading.Lock = self._orig["Lock"]
+        threading.RLock = self._orig["RLock"]
+        if self.registry is not None and wait_total > 0.0:
+            # one flush per window, outside any user lock (see
+            # _acquired) — the series carries the window's total
+            self.registry.inc(LOCK_WAIT_METRIC, wait_total)
+
+    def __enter__(self) -> "LockdepSanitizer":
+        return self.install()
+
+    def __exit__(self, *exc) -> None:
+        self.uninstall()
+
+    def _alloc_site(self, kind: str) -> str:
+        """dir/file:line of the Lock()/RLock() call — the lock-class
+        identity the order graph is keyed on.  The parent directory is
+        kept so two same-named files (utils/config.py vs
+        server/config.py) cannot merge into one lock class — a merge
+        would both exclude their real inversions (same-site pairs are
+        skipped) and pair unrelated locks into false ones."""
+        import traceback
+
+        for frame in reversed(traceback.extract_stack(limit=8)[:-2]):
+            fn = frame.filename
+            if fn.endswith("sanitizers.py") or "threading" in fn:
+                continue
+            tail = "/".join(os.path.normpath(fn).split(os.sep)[-2:])
+            return f"{tail}:{frame.lineno}({kind})"
+        return f"?({kind})"
+
+    # -- per-acquisition hooks -------------------------------------------------
+
+    def _in_hook(self) -> bool:
+        return getattr(self._tls, "in_hook", False)
+
+    def _held(self) -> List:
+        held = getattr(self._tls, "held", None)
+        if held is None:
+            held = self._tls.held = []
+        return held
+
+    def _acquired(self, proxy: _LockProxy, blocking: bool,
+                  waited: float) -> None:
+        self._tls.in_hook = True
+        try:
+            import time as _time
+
+            held = self._held()
+            report = None
+            with self._graph_lock:
+                entry = self._live.get(id(proxy))
+                if entry is not None:
+                    if entry[3] is held:
+                        entry[1] += 1   # reentrant re-acquire (RLock)
+                        return
+                    # stale entry from a holder whose release was never
+                    # seen (pre-window acquire): adopt the lock fresh
+                    if entry in entry[3]:
+                        entry[3].remove(entry)
+                self.acquisitions += 1
+                if waited >= 50e-6:
+                    # below ~50µs is uncontended acquire latency (plus
+                    # proxy bookkeeping), not time spent BLOCKED — the
+                    # series is a contention trend, not an op counter
+                    self.wait_seconds += waited
+                if blocking:
+                    for e in held:
+                        report = (self._add_edge_locked(e[0], proxy)
+                                  or report)
+                entry = [proxy, 1, _time.perf_counter(), held]
+                self._live[id(proxy)] = entry
+                held.append(entry)
+            # wait time accumulates in self.wait_seconds (above, under
+            # the graph lock) and flushes to the registry at
+            # uninstall() — a per-acquire registry.inc here would take
+            # the shared registry lock while the USER's lock is held,
+            # serializing every proxied thread through one global lock
+            # and inflating the very contention being measured
+            if report is not None:
+                self._report(report)
+        finally:
+            self._tls.in_hook = False
+
+    def _released(self, proxy: _LockProxy) -> None:
+        """Release bookkeeping resolves the HOLDER's entry via the live
+        map — a Lock handed off and released by another thread (legal
+        for threading.Lock) must not leave a phantom held entry on the
+        acquiring thread."""
+        self._tls.in_hook = True
+        try:
+            import time as _time
+
+            with self._graph_lock:
+                entry = self._live.get(id(proxy))
+                if entry is None:
+                    return  # acquired before the window: untracked
+                entry[1] -= 1
+                if entry[1] > 0:
+                    return
+                dt = _time.perf_counter() - entry[2]
+                self.hold_seconds[proxy.site] = (
+                    self.hold_seconds.get(proxy.site, 0.0) + dt)
+                del self._live[id(proxy)]
+                if entry in entry[3]:
+                    entry[3].remove(entry)
+        finally:
+            self._tls.in_hook = False
+
+    def _add_edge_locked(self, held_proxy: _LockProxy,
+                         new_proxy: _LockProxy) -> Optional[Dict]:
+        """Record held->new in the site graph (caller holds the graph
+        lock — the *_locked convention); returns an inversion report
+        when the reverse path already exists.  Same-site different-instance pairs are skipped — the
+        classic lockdep false positive (two queues born on one line)."""
+        a, b = held_proxy.site, new_proxy.site
+        if a == b or (a, b) in self._reported:
+            return None
+        import traceback
+
+        if (a, b) not in self._edges:
+            self._edges[(a, b)] = {
+                "thread": threading.current_thread().name,
+                "stack": "".join(traceback.format_stack(
+                    limit=self.stack_depth)[:-3]),
+            }
+            self._adj.setdefault(a, set()).add(b)
+        # inversion iff b can already reach a: taking b while holding a
+        # closes the cycle a -> b -> ... -> a (one BFS implementation,
+        # shared with the static model)
+        from gan_deeplearning4j_tpu.analysis.locks import shortest_path
+
+        path = shortest_path(self._adj, b, a)
+        if path is None:
+            return None
+        self._reported.add((a, b))
+        witness = self._edges.get((path[0], path[1])) or {}
+        return {
+            "lock_acquiring": b, "lock_held": a,
+            "cycle": [a] + path,  # a -> b -> ... -> a
+            "thread": threading.current_thread().name,
+            "stack": "".join(traceback.format_stack(
+                limit=self.stack_depth)[:-3]),
+            "prior_thread": witness.get("thread"),
+            "prior_stack": witness.get("stack"),
+        }
+
+    def _report(self, report: Dict) -> None:
+        """An inversion reports IMMEDIATELY (metric + event + record),
+        with both stacks — the observing run may be about to deadlock
+        on exactly this pair."""
+        self.inversions.append(report)
+        if self.registry is not None:
+            self.registry.inc(LOCK_INVERSION_METRIC)
+        from gan_deeplearning4j_tpu.telemetry import events
+
+        events.instant(LOCK_INVERSION_EVENT,
+                       acquiring=report["lock_acquiring"],
+                       held=report["lock_held"],
+                       thread=report["thread"])
+        if self.on_inversion is not None:
+            self.on_inversion(report)
+
+    # -- verdicts -------------------------------------------------------------
+
+    def leaked_threads(self) -> List[threading.Thread]:
+        """Non-daemon threads born after install() and still alive —
+        the exit-time audit half of the thread-hygiene rule."""
+        return [t for t in threading.enumerate()
+                if t.ident not in self._baseline_threads
+                and t.is_alive() and not t.daemon]
+
+    @property
+    def ok(self) -> bool:
+        return not self.inversions
+
+    def report(self) -> Dict:
+        with self._graph_lock:
+            return {"acquisitions": self.acquisitions,
+                    "edges": len(self._edges),
+                    "inversions": len(self.inversions),
+                    "wait_seconds": round(self.wait_seconds, 6),
+                    "hold_seconds": {k: round(v, 6) for k, v
+                                     in self.hold_seconds.items()}}
+
+    def check(self, threads: bool = True) -> None:
+        """Raise on any observed inversion (both stacks in the message)
+        and, with ``threads=True``, on leaked non-daemon threads."""
+        if self.inversions:
+            r = self.inversions[0]
+            raise LockOrderError(
+                f"{len(self.inversions)} lock-order inversion(s); "
+                f"first: acquiring {r['lock_acquiring']} while holding "
+                f"{r['lock_held']} on thread {r['thread']} inverts the "
+                f"established order (first taken the other way on "
+                f"thread {r['prior_thread']}).\n"
+                f"--- current acquisition stack ---\n{r['stack']}"
+                f"--- prior (reverse-order) stack ---\n"
+                f"{r['prior_stack']}"
+                f"(see docs/STATIC_ANALYSIS.md, rule lock-order-cycle)")
+        if threads:
+            leaked = self.leaked_threads()
+            if leaked:
+                names = ", ".join(t.name for t in leaked)
+                raise ThreadLeakError(
+                    f"{len(leaked)} non-daemon thread(s) created in "
+                    f"this lockdep window still alive at its end: "
+                    f"{names} — join them from a close()/stop() path "
+                    f"(rule thread-hygiene)")
+
+
+@contextmanager
+def lockdep(registry=None, strict: bool = True, threads: bool = True):
+    """Context-managed lockdep window: patch lock allocation on entry,
+    restore on exit; with ``strict`` (default) re-raise any observed
+    inversion / thread leak at exit via ``check()``.  The pytest
+    fixture (tests/conftest.py) and the chaos/supervision CI lanes
+    (``GAN4J_LOCKDEP=1``) are the standing consumers."""
+    dep = LockdepSanitizer(registry=registry)
+    dep.install()
+    try:
+        yield dep
+    finally:
+        dep.uninstall()
+    if strict:
+        dep.check(threads=threads)
 
 
 @contextmanager

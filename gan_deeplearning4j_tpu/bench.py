@@ -537,6 +537,64 @@ def lint_dryrun() -> dict:
             "ok": res.ok}
 
 
+def race_dryrun(registry=None) -> dict:
+    """The concurrency gate as a bench verdict (gan4j-race,
+    docs/STATIC_ANALYSIS.md § Concurrency discipline), both halves:
+
+    * static — the race rule set (lock-order cycles, lock-held blocking
+      calls, thread hygiene, unlocked shared writes) over the whole
+      package with an EMPTY baseline, zero findings;
+    * runtime — a short ``lockdep`` window driving the exact shape the
+      exporter runs in production (a MetricsRegistry + EventRecorder
+      hammered from worker threads, locks allocated UNDER the proxies)
+      with zero observed inversions — and at least one TRACKED
+      acquisition, so a dead patch cannot pass as clean.
+
+    The wait/inversion series land in ``registry`` (pre-created at 0;
+    the dryrun scrape asserts both are present)."""
+    import queue
+    import threading
+
+    from gan_deeplearning4j_tpu import analysis
+    from gan_deeplearning4j_tpu.telemetry import events as events_mod
+
+    from gan_deeplearning4j_tpu.telemetry import MetricsRegistry
+
+    static = analysis.lint_package(rules=list(analysis.RACE_RULES))
+    with analysis.lockdep(registry=registry, strict=False) as dep:
+        # all three allocated INSIDE the window: their locks are the
+        # order-tracking proxies, so the hammering below is tracked
+        scratch = MetricsRegistry()             # proxied RLock
+        recorder = events_mod.EventRecorder()   # proxied RLock
+        q: "queue.Queue" = queue.Queue()        # proxied mutex
+
+        def worker() -> None:
+            for k in range(50):
+                scratch.observe_record({"step": k, "d_loss": 0.1})
+                recorder.instant("race.dryrun", k=k)
+                q.put(k)
+
+        threads = [threading.Thread(target=worker,
+                                    name=f"gan4j-race-dryrun-{i}",
+                                    daemon=True) for i in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=30.0)
+        while not q.empty():
+            q.get_nowait()
+    rep = dep.report()
+    return {"static_findings": len(static.findings),
+            "static_parse_errors": len(static.errors),
+            "tracked_acquisitions": rep["acquisitions"],
+            "order_edges": rep["edges"],
+            "inversions": rep["inversions"],
+            "lock_wait_s": rep["wait_seconds"],
+            "ok": bool(static.ok and dep.ok
+                       and rep["acquisitions"] >= 1
+                       and not dep.leaked_threads())}
+
+
 def dryrun(telemetry: bool = True,
            metrics_port: Optional[int] = None) -> dict:
     """CI smoke: build and execute the fused protocol program — single
@@ -580,7 +638,13 @@ def dryrun(telemetry: bool = True,
     point resolvable on this topology against its committed program
     contract (``prove_dryrun``) — donation aliasing, dtype discipline,
     collective budget, peak-HBM ceiling and bucket coverage, verified
-    from the actual lowering, also folded into ``ok``."""
+    from the actual lowering, also folded into ``ok``.
+
+    gan4j-race completes the set (PR 9): ``race_ok`` asserts zero
+    static concurrency findings (lock-order cycles, lock-held blocking
+    calls, thread hygiene) over the package AND a clean ``lockdep``
+    runtime window (``race_dryrun``) with the ``gan4j_lock_*`` series
+    present in the scrape, folded into ``ok``."""
     global BATCH
     prev_batch, BATCH = BATCH, DRYRUN_BATCH
     try:
@@ -657,6 +721,12 @@ def dryrun(telemetry: bool = True,
                 # peak-HBM under ceiling, batch shapes inside buckets
                 with events_mod.span("bench.prove"):
                     prove = prove_dryrun()
+                # gan4j-race (PR 9): the concurrency gate both ways —
+                # zero static race findings AND a lockdep window over
+                # the registry/recorder/queue shape with zero observed
+                # inversions; feeds gan4j_lock_* into the scrape below
+                with events_mod.span("bench.race"):
+                    race = race_dryrun(registry=registry)
                 # one record through the registry feed, then a REAL
                 # scrape over the socket: the CI assertion that the
                 # exporter answers with the step/goodput/NaN series
@@ -686,6 +756,12 @@ def dryrun(telemetry: bool = True,
                     and "gan4j_watchdog_last_beat_age_seconds" in m_body
                     and "gan4j_rollback_total " in m_body
                     and "gan4j_recompiles_total " in m_body)
+                # lockdep surface: both gan4j_lock_* series must exist
+                # from the first scrape (pre-created at 0, fed by the
+                # race_dryrun window above)
+                race_ok = (race["ok"]
+                           and "gan4j_lock_wait_seconds_total " in m_body
+                           and "gan4j_lock_inversions_total " in m_body)
                 # stalled contract, healthy half: the scrape above ran
                 # against a LIVE (beating) watchdog-armed run and must
                 # say so — 200 with "stalled": false
@@ -722,7 +798,7 @@ def dryrun(telemetry: bool = True,
                            and exporter_ok and events_ok
                            and watchdog_ok and data_ok
                            and lint["ok"] and sanitizer["ok"]
-                           and prove["ok"]),
+                           and prove["ok"] and race_ok),
                 "platform": device.platform,
                 "telemetry": telemetry,
                 "checkpoint": ckpt,
@@ -736,6 +812,8 @@ def dryrun(telemetry: bool = True,
                 "sanitizer": sanitizer,
                 "prove_ok": bool(prove["ok"]),
                 "prove": prove,
+                "race_ok": bool(race_ok),
+                "race": race,
                 "watchdog_beat_us": round(beat_us, 3)}
     finally:
         BATCH = prev_batch

@@ -66,7 +66,9 @@ class PrefetchIterator:
         self._consumed_state = self._source_state()
         self._q: queue.Queue = queue.Queue(maxsize=prefetch_depth)
         self._stop = threading.Event()
-        self._thread = threading.Thread(target=self._worker, daemon=True)
+        self._thread = threading.Thread(target=self._worker,
+                                        name="gan4j-prefetch",
+                                        daemon=True)
         self._thread.start()
 
     def _source_state(self):
@@ -212,7 +214,9 @@ class PrefetchIterator:
         self._consumed_state = self._source_state()
         self._q = queue.Queue(maxsize=self.prefetch_depth)
         self._stop = threading.Event()
-        self._thread = threading.Thread(target=self._worker, daemon=True)
+        self._thread = threading.Thread(target=self._worker,
+                                        name="gan4j-prefetch",
+                                        daemon=True)
         self._thread.start()
 
     def close(self, timeout: float = 5.0):

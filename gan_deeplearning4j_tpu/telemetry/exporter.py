@@ -46,6 +46,16 @@ Metric names (all ``gan4j_``-prefixed):
                                         on a DIFFERENT mesh and were
                                         resharded onto it
   gan4j_reshard_seconds        gauge    cumulative time paid resharding
+  gan4j_lock_wait_seconds_total counter seconds threads spent BLOCKED
+                                        acquiring tracked locks under
+                                        the lockdep sanitizer
+                                        (analysis/sanitizers.py) — the
+                                        lock-contention trend
+  gan4j_lock_inversions_total  counter  observed lock-order inversions
+                                        (any increment = a potential
+                                        deadlock witnessed at runtime;
+                                        docs/STATIC_ANALYSIS.md,
+                                        rule lock-order-cycle)
 """
 
 from __future__ import annotations
@@ -97,6 +107,13 @@ class MetricsRegistry:
             # is rare by design, so the alert rule needs the series at
             # 0 long before the first one happens
             ("gan4j_reshard_total", ()): 0.0,
+            # lockdep sanitizer (analysis/sanitizers.py): the inversion
+            # counter must exist at 0 from the first scrape — an
+            # inversion is exactly the event after which the next
+            # scrape may never come — and the wait-time series is the
+            # lock-contention trend an alert watches long before one
+            ("gan4j_lock_inversions_total", ()): 0.0,
+            ("gan4j_lock_wait_seconds_total", ()): 0.0,
         }
         self._gauges: Dict[Tuple[str, tuple], float] = {
             # age since the last data-plane incident; 0 until one

@@ -278,7 +278,8 @@ def serve_metrics(jsonl_path: str, port: int = 8080,
             pass
 
     server = ThreadingHTTPServer((host, port), Handler)
-    thread = threading.Thread(target=server.serve_forever, daemon=True)
+    thread = threading.Thread(target=server.serve_forever,
+                              name="gan4j-live-ui", daemon=True)
     thread.start()
 
     def stop() -> None:

@@ -70,7 +70,9 @@ class MetricsLogger:
         self._on_record = on_record
         if async_io:
             self._q = queue.Queue()
-            self._worker = threading.Thread(target=self._drain, daemon=True)
+            self._worker = threading.Thread(target=self._drain,
+                                            name="gan4j-metrics-writer",
+                                            daemon=True)
             self._worker.start()
         if path:
             os.makedirs(os.path.dirname(path) or ".", exist_ok=True)

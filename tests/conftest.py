@@ -62,3 +62,37 @@ def transfer_guard():
 
     with no_implicit_transfers():
         yield
+
+
+@pytest.fixture
+def lockdep():
+    """Runtime lock-order sanitizer (analysis/sanitizers.py): lock
+    allocations inside the test become order-tracking proxies; the
+    fixture FAILS the test at teardown on any observed lock-order
+    inversion (both stacks in the error) or leaked non-daemon thread.
+    Tests asserting ON an inversion should read ``dep.inversions`` and
+    clear it before teardown."""
+    from gan_deeplearning4j_tpu.analysis import sanitizers
+
+    with sanitizers.lockdep(strict=False) as dep:
+        yield dep
+    dep.check()  # raises LockOrderError/ThreadLeakError -> test fails
+
+
+@pytest.fixture(autouse=True)
+def _lockdep_everywhere(request):
+    """CI race lane (tier1.yml): with ``GAN4J_LOCKDEP=1`` every test in
+    the selected suites runs under the lockdep sanitizer — the chaos
+    and supervision e2e suites double as lock-order torture tests.
+    Without the env var this fixture is a no-op, and a test that
+    already requested the explicit ``lockdep`` fixture is left alone
+    (no nested patching)."""
+    if (os.environ.get("GAN4J_LOCKDEP") != "1"
+            or "lockdep" in request.fixturenames):
+        yield
+        return
+    from gan_deeplearning4j_tpu.analysis import sanitizers
+
+    with sanitizers.lockdep(strict=False) as dep:
+        yield
+    dep.check()
