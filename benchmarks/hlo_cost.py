@@ -187,6 +187,34 @@ def analyze_hlo(txt: str) -> List[dict]:
     return rows
 
 
+def overlap_bounds(total_flops: float, total_bytes: float,
+                   peak: float = PEAK_FLOPS, bw: float = HBM_BW) -> dict:
+    """The DMA/compute overlap envelope of a program (RESULTS.md
+    "Overlap experiment series"): with ZERO overlap the step costs
+    flops-time + bytes-time; with PERFECT overlap it costs
+    max(flops-time, bytes-time).  The measured step time falling at the
+    no-overlap bound (b1600 fast mode, r5: 7.6ms bytes + 3.7ms flops ~=
+    12.2ms measured) is the diagnosis the overlap series attacks; the
+    all-overlap MFU is the ceiling any scheduling/restructure work can
+    reach without removing traffic."""
+    flops_s = total_flops / peak
+    bytes_s = total_bytes / bw
+    no_overlap_s = flops_s + bytes_s
+    all_overlap_s = max(flops_s, bytes_s)
+    return {
+        "flops_us": round(flops_s * 1e6, 1),
+        "bytes_us": round(bytes_s * 1e6, 1),
+        "no_overlap_us": round(no_overlap_s * 1e6, 1),
+        "all_overlap_us": round(all_overlap_s * 1e6, 1),
+        # MFU = flops-time / step-time at each envelope edge
+        "mfu_at_no_overlap": (round(flops_s / no_overlap_s, 4)
+                              if no_overlap_s > 0 else None),
+        "mfu_at_all_overlap": (round(flops_s / all_overlap_s, 4)
+                               if all_overlap_s > 0 else None),
+        "bound": "bytes" if bytes_s > flops_s else "flops",
+    }
+
+
 def summarize(rows: List[dict], top: int) -> dict:
     for r in rows:
         r["t_est_us"] = max(r["flops"] / PEAK_FLOPS,
@@ -218,6 +246,9 @@ def summarize(rows: List[dict], top: int) -> dict:
         "roofline_us_per_step": round(roofline_us, 1),
         "flops_us": round(total_flops / PEAK_FLOPS * 1e6, 1),
         "bytes_us": round(total_bytes / HBM_BW * 1e6, 1),
+        # the overlap envelope from the per-instruction totals; the
+        # canonical (cost-model-flops) version lands in run_program
+        "bounds": overlap_bounds(total_flops, total_bytes),
         "top_ops": out_rows,
         "t_est_by_opkind_us": {k: round(v, 1) for k, v in
                                sorted(by_kind.items(),
@@ -324,6 +355,10 @@ def run_program(name: str, top: int, measure: bool,
     if summary["xla_cost_flops"]:
         summary["flops_xla_us"] = round(
             summary["xla_cost_flops"] / PEAK_FLOPS * 1e6, 1)
+        # canonical overlap envelope: cost-model flops (no loop-peel
+        # double count) against the per-instruction byte total
+        summary["bounds"] = overlap_bounds(
+            summary["xla_cost_flops"], summary["total_toplevel_bytes"])
         summary["flops_us_note"] = ("per-instruction total; upper bound "
                                     "(loop-peel duplicates included) — "
                                     "flops_xla_us is canonical")

@@ -1,0 +1,182 @@
+"""Per-flag A/B driver for the DMA/compute overlap series (RESULTS.md
+"Overlap experiment series").
+
+Why a subprocess per lane: XLA parses ``XLA_FLAGS`` exactly ONCE, at
+backend initialization, and a flag unknown to the build is a hard
+``F``-check abort (parse_flags_from_env.cc), not an exception.  An
+in-process loop over flag sets would either measure the first lane's
+flags forever (silently — the A/B lie) or die on the first lane the
+build doesn't know.  So each lane re-execs
+``python -m gan_deeplearning4j_tpu.bench`` with its own environment and
+classifies the outcome:
+
+  measured      — the inner bench printed its JSON line;
+  flag-rejected — the backend aborted on an unknown flag (recorded with
+                  the stderr tail: ON THIS BUILD the flag doesn't exist,
+                  which is itself a result for the experiment log);
+  failed        — anything else (timeout, crash), stderr tail kept.
+
+Lanes (the experiment matrix; restructure lanes measure the OLD lowering
+via the bench's --no-* flags so the committed default is the candidate):
+
+  baseline                 the shipped configuration, no extra flags
+  no-carry-dedup           scan carry WITH the mirrored-W/b copies
+  no-upsample-sum-bwd      autodiff broadcast+reduce upsample backward
+  no-pool-argmax-bwd       select-and-scatter maxpool backward
+  lhs                      --xla_tpu_enable_latency_hiding_scheduler
+  lhs-async-copy           + async copy/DMA scheduling knobs
+
+Run:  python benchmarks/overlap_ab.py [--lanes baseline,lhs,...]
+      [--output FILE] [--timeout SEC] [--bench-args "--skip-celeba ..."]
+Prints ONE JSON line (the lane table); human-readable rows to stderr.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+from typing import Dict, List, Optional, Tuple
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# lane -> (extra XLA_FLAGS or None, extra bench argv)
+LANES: Dict[str, Tuple[Optional[str], List[str]]] = {
+    "baseline": (None, []),
+    "no-carry-dedup": (None, ["--no-carry-dedup"]),
+    "no-upsample-sum-bwd": (None, ["--no-upsample-sum-bwd"]),
+    "no-pool-argmax-bwd": (None, ["--no-pool-argmax-bwd"]),
+    # the latency-hiding scheduler: XLA's own DMA/compute overlap pass,
+    # off by default for TPU while-loop programs of this shape
+    "lhs": ("--xla_tpu_enable_latency_hiding_scheduler=true", []),
+    # + async copy scheduling: let the scheduler issue the big HBM
+    # copies as overlapped async pairs it can hide under the MXU work
+    "lhs-async-copy": (
+        "--xla_tpu_enable_latency_hiding_scheduler=true "
+        "--xla_tpu_enable_async_collective_fusion=true", []),
+}
+
+# the default per-lane inner-bench arguments: the protocol multistep +
+# fast-mode blocks carry the overlap story; e2e/celeba ride full runs
+DEFAULT_BENCH_ARGS = ["--skip-e2e", "--skip-celeba"]
+
+
+def run_lane(name: str, xla_flags: Optional[str], bench_args: List[str],
+             timeout_s: float) -> dict:
+    env = dict(os.environ)
+    if xla_flags:
+        prev = env.get("XLA_FLAGS", "")
+        env["XLA_FLAGS"] = (prev + " " + xla_flags).strip()
+    cmd = [sys.executable, "-m", "gan_deeplearning4j_tpu.bench",
+           *bench_args]
+    rec: dict = {"lane": name, "xla_flags": xla_flags,
+                 "bench_args": bench_args}
+    try:
+        proc = subprocess.run(cmd, env=env, cwd=_REPO,
+                              capture_output=True, text=True,
+                              timeout=timeout_s)
+    except subprocess.TimeoutExpired:
+        rec["status"] = "failed"
+        rec["error"] = f"timeout after {timeout_s}s"
+        return rec
+    tail = (proc.stderr or "")[-2000:]
+    if proc.returncode != 0:
+        rejected = "Unknown flags in XLA_FLAGS" in (proc.stderr or "")
+        rec["status"] = "flag-rejected" if rejected else "failed"
+        rec["error"] = tail[-400:]
+        return rec
+    # the inner bench prints ONE JSON line last; tolerate log lines above
+    payload = None
+    for line in reversed((proc.stdout or "").splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                payload = json.loads(line)
+                break
+            except ValueError:
+                continue
+    if payload is None:
+        rec["status"] = "failed"
+        rec["error"] = "no JSON line in bench stdout; stderr: " + tail[-300:]
+        return rec
+    rec["status"] = "measured"
+    rec["capture"] = payload
+    rec["summary"] = _summarize(payload)
+    return rec
+
+
+def _summarize(cap: dict) -> dict:
+    """The experiment-table row: the numbers RESULTS.md's per-experiment
+    table cites per lane."""
+    out = {"multistep_step_ms": cap.get("multistep_step_ms"),
+           "mfu": cap.get("mfu")}
+    spread = cap.get("spread")
+    if isinstance(spread, dict):
+        out["iqr_ms"] = spread.get("iqr_ms")
+    fast = cap.get("fast_mode")
+    if isinstance(fast, dict):
+        out["fast_step_ms"] = fast.get("multistep_step_ms")
+        out["fast_mfu"] = fast.get("multistep_mfu")
+        if isinstance(fast.get("spread"), dict):
+            out["fast_iqr_ms"] = fast["spread"].get("iqr_ms")
+    return out
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--lanes", default=",".join(LANES),
+                   help="comma-separated lane names to run "
+                        f"(default: all of {sorted(LANES)})")
+    p.add_argument("--output", default=None, metavar="FILE",
+                   help="also write the lane table (indented) here")
+    p.add_argument("--timeout", type=float, default=2400.0,
+                   help="per-lane subprocess timeout (seconds)")
+    p.add_argument("--bench-args", default=" ".join(DEFAULT_BENCH_ARGS),
+                   help="inner-bench argv shared by every lane "
+                        "(lane-specific --no-* flags append to these)")
+    args = p.parse_args(argv)
+
+    shared = args.bench_args.split()
+    lanes = []
+    for name in args.lanes.split(","):
+        name = name.strip()
+        if not name:
+            continue
+        if name not in LANES:
+            raise SystemExit(f"unknown lane {name!r}; have {sorted(LANES)}")
+        lanes.append(name)
+
+    results = []
+    for name in lanes:
+        xla_flags, extra = LANES[name]
+        print(f"[overlap-ab] lane {name}"
+              + (f" (XLA_FLAGS: {xla_flags})" if xla_flags else ""),
+              file=sys.stderr, flush=True)
+        rec = run_lane(name, xla_flags, shared + extra, args.timeout)
+        results.append(rec)
+        if rec["status"] == "measured":
+            s = rec["summary"]
+            print(f"[overlap-ab]   {name}: step "
+                  f"{s.get('multistep_step_ms')}ms mfu {s.get('mfu')}"
+                  + (f" | fast {s.get('fast_step_ms')}ms "
+                     f"mfu {s.get('fast_mfu')}"
+                     if s.get("fast_step_ms") else ""),
+                  file=sys.stderr, flush=True)
+        else:
+            print(f"[overlap-ab]   {name}: {rec['status']} — "
+                  f"{rec.get('error', '')[:160]}",
+                  file=sys.stderr, flush=True)
+    table = {"metric": "overlap_ab", "lanes": results}
+    if args.output:
+        with open(args.output, "w") as f:
+            json.dump(table, f, indent=1)
+    print(json.dumps(table))
+    # exit 0 when every lane is at least CLASSIFIED (a rejected flag is
+    # a result); nonzero only when a lane failed outright
+    return 1 if any(r["status"] == "failed" for r in results) else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

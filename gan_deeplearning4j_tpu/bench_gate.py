@@ -1,0 +1,143 @@
+"""Variance-aware bench regression gate (the "bench of record" half of
+the overlap series, RESULTS.md).
+
+A fixed percentage threshold over a noisy capture is either deaf (too
+wide) or a flake machine (too tight) — the r5 celeba capture's 11%
+min/max spread would trip any <11% gate on pure tunnel noise.  The v7
+captures carry a median±IQR spread block per multistep series
+(bench._slope_stats), so the gate can scale its tolerance to the
+MEASURED dispersion of both captures:
+
+    allowed slowdown (ms) = max(rel_floor * old_median,
+                                iqr_mult * (old_IQR + new_IQR))
+
+A regression verdict therefore means "slower by more than the noise of
+both measurements plus the floor", not "slower than a guess".  Series
+present in only one capture are reported as ``skipped`` (a new bench
+block must not fail the gate retroactively; a REMOVED one is loud).
+
+Used by ``bench.py --dryrun`` (bench_stable_ok: the gate must PASS the
+capture against itself and provably FAIL an injected 2x-regressed copy)
+and by the measured bench run, which checks its fresh capture against
+``BENCH_LASTGOOD.json`` and ships the verdict in the JSON line.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import List, Optional, Tuple
+
+# (human label, path to the step-time block) — every multistep series a
+# capture can carry.  step_ms medians compare LOWER-IS-BETTER.
+SERIES: Tuple[Tuple[str, Tuple[str, ...]], ...] = (
+    ("multistep", ()),
+    ("fast_mode", ("fast_mode",)),
+    ("celeba", ("celeba",)),
+    ("celeba_fast", ("celeba_fast",)),
+)
+
+# Tolerance floor: 5% — the day-to-day jitter of a healthy capture on
+# the shared tunnel (BENCH_r0*.json history), below which a "regression"
+# is indistinguishable from load.  IQR multiplier: 3 — the slope sets
+# are medians-of-windows already, so their IQR understates tail noise.
+REL_FLOOR = 0.05
+IQR_MULT = 3.0
+
+
+def _dig(capture: dict, path: Tuple[str, ...]) -> Optional[dict]:
+    node = capture
+    for key in path:
+        if not isinstance(node, dict) or key not in node:
+            return None
+        node = node[key]
+    return node if isinstance(node, dict) else None
+
+
+def _median_iqr(block: dict) -> Tuple[Optional[float], float]:
+    """(median_ms, iqr_ms) of a bench block: the spread block when the
+    capture is v7+, falling back to the flat step_ms (IQR 0 — the gate
+    then runs on the floor alone against legacy captures)."""
+    spread = block.get("spread") if isinstance(block.get("spread"),
+                                               dict) else None
+    if spread is not None:
+        med = spread.get("median_ms")
+        iqr = spread.get("iqr_ms", 0.0)
+        if isinstance(med, (int, float)):
+            return float(med), float(iqr or 0.0)
+    med = block.get("multistep_step_ms", block.get("step_ms"))
+    if isinstance(med, (int, float)):
+        return float(med), 0.0
+    return None, 0.0
+
+
+def series_stats(capture: dict) -> List[Tuple[str, float, float]]:
+    """``[(label, median_ms, iqr_ms)]`` for every series the capture
+    carries — the exporter feed (``gan4j_bench_*``,
+    docs/OBSERVABILITY.md) and the gate read the capture one way."""
+    out: List[Tuple[str, float, float]] = []
+    for label, path in SERIES:
+        block = _dig(capture, path)
+        if block is None:
+            continue
+        med, iqr = _median_iqr(block)
+        if med is not None:
+            out.append((label, med, iqr))
+    return out
+
+
+def check_capture(capture: dict, lastgood: dict,
+                  rel_floor: float = REL_FLOOR,
+                  iqr_mult: float = IQR_MULT) -> dict:
+    """Gate ``capture`` against ``lastgood``.  Returns a verdict dict:
+    ``ok`` (no series regressed), per-series ``checks`` rows with the
+    allowed/observed slowdown, and ``skipped`` for series missing from
+    either side.  Only step-time medians are gated — throughput and MFU
+    are derived from them, and flops change legitimately with lowering
+    work."""
+    checks: List[dict] = []
+    skipped: List[str] = []
+    for label, path in SERIES:
+        new_block = _dig(capture, path)
+        old_block = _dig(lastgood, path)
+        if new_block is None or old_block is None:
+            skipped.append(label)
+            continue
+        new_med, new_iqr = _median_iqr(new_block)
+        old_med, old_iqr = _median_iqr(old_block)
+        if new_med is None or old_med is None:
+            skipped.append(label)
+            continue
+        allowed = max(rel_floor * old_med, iqr_mult * (old_iqr + new_iqr))
+        slower_by = new_med - old_med
+        checks.append({
+            "series": label,
+            "old_median_ms": old_med,
+            "new_median_ms": new_med,
+            "old_iqr_ms": old_iqr,
+            "new_iqr_ms": new_iqr,
+            "allowed_slowdown_ms": round(allowed, 4),
+            "slower_by_ms": round(slower_by, 4),
+            "regressed": bool(slower_by > allowed),
+        })
+    return {
+        "ok": bool(checks) and not any(c["regressed"] for c in checks),
+        "compared": len(checks),
+        "checks": checks,
+        "skipped": skipped,
+        "rel_floor": rel_floor,
+        "iqr_mult": iqr_mult,
+    }
+
+
+def check_against_lastgood(capture: dict, lastgood_path: str) -> dict:
+    """The measured-run entry: gate a fresh capture against the cached
+    last-good record.  Missing/unparsable cache = vacuous pass with a
+    reason (first capture on a fresh checkout must not fail)."""
+    try:
+        with open(lastgood_path) as f:
+            lastgood = json.load(f)
+    except (OSError, ValueError) as e:
+        return {"ok": True, "compared": 0, "checks": [],
+                "skipped": [s for s, _ in SERIES],
+                "reason": f"no usable lastgood: {e}"}
+    return check_capture(capture, lastgood)

@@ -2,7 +2,9 @@
 leaves HBM bandwidth on the table (SURVEY.md §7 step 2: "Pallas only
 where profiling says so"; the north-star names batchnorm and conv).
 
-Currently: fused train-mode BatchNorm+activation (bn_act.py).  Kernels
+Currently: fused train-mode BatchNorm+activation (bn_act.py), the fused
+RmsProp update chain (fused_update.py), and the double-buffered DMA
+pipeline for the upsample backward reduce (dma_pipeline.py).  Kernels
 are opt-in (``enable(True)`` or env GAN4J_PALLAS=1) and TPU-only at
 runtime; tests exercise them anywhere via ``interpret=True``.
 """
@@ -16,6 +18,10 @@ import jax
 from gan_deeplearning4j_tpu.ops.pallas.bn_act import (
     fused_bn_act_train,
     fused_bn_act_train_4d,
+)
+from gan_deeplearning4j_tpu.ops.pallas.dma_pipeline import (
+    supports_upsample_bwd,
+    upsample_bwd_dma,
 )
 
 _ENABLED = os.environ.get("GAN4J_PALLAS", "0") == "1"
@@ -40,4 +46,5 @@ def enabled() -> bool:
         return False
 
 
-__all__ = ["fused_bn_act_train", "fused_bn_act_train_4d", "enable", "enabled"]
+__all__ = ["fused_bn_act_train", "fused_bn_act_train_4d",
+           "supports_upsample_bwd", "upsample_bwd_dma", "enable", "enabled"]

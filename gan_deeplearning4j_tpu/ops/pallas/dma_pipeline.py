@@ -1,0 +1,144 @@
+"""Pallas TPU kernel: double-buffered DMA pipeline for the upsample
+backward reduce — the 60.2MB byte sink hlo_cost_r5.json ranks #3 in the
+fused step (RESULTS.md "Overlap experiment series").
+
+The adjoint of a nearest-neighbour repeat is a factor-block sum:
+``g[B,C,H*sh,W*sw] -> dx[B,C,H,W]`` summing each ``sh x sw`` block.  The
+XLA lowering (ops/upsample.py sum-backward) is one fused strided reduce;
+whether its HBM reads overlap anything is the scheduler's call.  This
+kernel makes the overlap explicit: the cotangent streams HBM -> VMEM
+through ``pltpu.make_async_copy`` into a two-slot scratch, and while
+chunk ``i`` is being reduced the DMA engine is already fetching chunk
+``i+1`` — compute hides under the copy it depends on (Pallas guide,
+"Patterns: Double Buffering"; same grid discipline as bn_act.py).
+
+Layout: ``g`` is viewed as ``[R, cols] = [B*C*H*sh, W*sw]`` — a free
+contiguous reshape.  Per chunk of ``R``:
+
+* the ``sw`` (lane-interleaved) sum is a dot with a static 0/1
+  selection matrix ``S[W*sw, W]`` (``S[w*sw+t, w] = 1``) — the MXU does
+  strided lane gathers for free, and at these widths the matmul is
+  roofline-invisible (2*chunk*W*sw*W flops vs chunk*W*sw*4 bytes);
+* the ``sh`` sum is a sublane-group reshape+sum, which Mosaic lowers
+  natively.
+
+Chunks must keep ``sh``-row groups whole, so the chunk size is the
+largest divisor of ``R`` that is a multiple of ``lcm(sh, SUBLANE)`` and
+fits the two-slot scratch budget; ``supports_upsample_bwd`` returns
+False (callers fall back to the XLA path) when no such divisor exists
+or the dtype isn't f32.
+
+Opt-in like every kernel here: ``ops.pallas.enable(True)`` /
+GAN4J_PALLAS=1, TPU-only at runtime, ``interpret=True`` in tests.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+LANE = 128
+SUBLANE = 8
+N_SLOTS = 2
+# two scratch slots + the blocked output must fit comfortably under the
+# ~16MB scoped-vmem limit alongside the selection matrix
+_VMEM_BUDGET = 6 << 20
+
+
+def _chunk_rows(rows: int, cols: int, sh: int) -> int:
+    """Largest divisor of ``rows`` that keeps sh-row groups whole, tiles
+    the sublanes, and fits N_SLOTS chunks in the scratch budget.
+    Returns 0 when none exists."""
+    base = (sh * SUBLANE) // math.gcd(sh, SUBLANE)
+    cols_pad = -(-cols // LANE) * LANE  # VMEM lane padding is physical
+    max_rows = _VMEM_BUDGET // (N_SLOTS * cols_pad * 4)
+    max_k = min(max_rows, rows) // base
+    for k in range(max_k, 0, -1):
+        if rows % (base * k) == 0:
+            return base * k
+    return 0
+
+
+def supports_upsample_bwd(g_shape, sh: int, sw: int, dtype) -> bool:
+    """True iff the pipeline kernel handles this cotangent; callers fall
+    back to the XLA strided-reduce lowering otherwise."""
+    if dtype != jnp.float32 or len(g_shape) != 4:
+        return False
+    B, C, Hs, Wsw = g_shape
+    if Hs % sh or Wsw % sw:
+        return False
+    return _chunk_rows(B * C * Hs, Wsw, sh) > 0
+
+
+def _select_matrix(W: int, sw: int) -> jax.Array:
+    s = np.zeros((W * sw, W), np.float32)
+    for w in range(W):
+        s[w * sw:(w + 1) * sw, w] = 1.0
+    return jnp.asarray(s)
+
+
+def _bwd_kernel(x_hbm, s_ref, out_ref, scratch, sems, *,
+                chunk: int, sh: int, out_w: int):
+    i = pl.program_id(0)
+    n = pl.num_programs(0)
+
+    def dma(slot, idx):
+        return pltpu.make_async_copy(
+            x_hbm.at[pl.ds(idx * chunk, chunk), :],
+            scratch.at[slot],
+            sems.at[slot])
+
+    # warm-up: the first grid step issues its own fetch
+    @pl.when(i == 0)
+    def _():
+        dma(0, 0).start()
+
+    # prefetch chunk i+1 while chunk i is (or finishes) in flight; slot
+    # (i+1)%2 was consumed at step i-1, so the overwrite is safe
+    @pl.when(i + 1 < n)
+    def _():
+        dma((i + 1) % N_SLOTS, i + 1).start()
+
+    dma(i % N_SLOTS, i).wait()
+    x = scratch[i % N_SLOTS]                       # [chunk, W*sw]
+    # sw-sum: lane-interleaved gather as an MXU dot with the 0/1 matrix
+    col = jnp.dot(x, s_ref[:], preferred_element_type=jnp.float32)
+    # sh-sum: sublane-group reduce
+    out_ref[:] = col.reshape(chunk // sh, sh, out_w).sum(axis=1)
+
+
+def upsample_bwd_dma(g: jax.Array, sh: int, sw: int, *,
+                     interpret: bool = False) -> jax.Array:
+    """dx[B,C,H,W] = the (sh, sw) block sum of g[B,C,H*sh,W*sw], streamed
+    through the double-buffered pipeline.  Caller must have checked
+    ``supports_upsample_bwd``."""
+    B, C, Hs, Wsw = g.shape
+    H, W = Hs // sh, Wsw // sw
+    rows = B * C * Hs
+    chunk = _chunk_rows(rows, Wsw, sh)
+    if chunk <= 0:  # defensive: supports_upsample_bwd gates callers
+        return g.reshape(B, C, H, sh, W, sw).sum(axis=(3, 5))
+    kernel = functools.partial(_bwd_kernel, chunk=chunk, sh=sh, out_w=W)
+    out = pl.pallas_call(
+        kernel,
+        grid=(rows // chunk,),
+        in_specs=[
+            # the cotangent stays in HBM; the kernel DMAs its own chunks
+            pl.BlockSpec(memory_space=pltpu.ANY),
+            pl.BlockSpec((Wsw, W), lambda i: (0, 0)),
+        ],
+        out_specs=pl.BlockSpec((chunk // sh, W), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((rows // sh, W), jnp.float32),
+        scratch_shapes=[
+            pltpu.VMEM((N_SLOTS, chunk, Wsw), jnp.float32),
+            pltpu.SemaphoreType.DMA((N_SLOTS,)),
+        ],
+        interpret=interpret,
+    )(g.reshape(rows, Wsw), _select_matrix(W, sw))
+    return out.reshape(B, C, H, W)

@@ -4,15 +4,99 @@ The reference uses the unusual max-pool 2x2 **stride 1**
 (dl4jGANComputerVision.java:134-138 — kernel (2,2), stride (1,1), Truncate),
 which shrinks each spatial dim by exactly 1.  Lowered to
 ``lax.reduce_window`` which XLA maps onto the VPU.
+
+Backward: by default the recomputed-argmax form (RESULTS.md "Overlap
+experiment series") instead of the ``select-and-scatter`` op autodiff
+emits — hlo_cost_r5.json names select-and-scatter as a top byte sink
+(41.9MB at b200, ~0.5ms of estimated time at b1600) and TPUs lower it as
+a slow sequential window walk.  The restructured backward recomputes the
+window max from the saved input (no stored argmax, no extra residual) and
+scatters each output cotangent to the FIRST window element equal to the
+max, walking window offsets in row-major order — exactly
+select-and-scatter's ``GE`` tie rule, so gradients match the reference
+lowering elementwise.  Every piece is an elementwise/pad op XLA fuses and
+overlaps, unlike the opaque select-and-scatter.
 """
 
 from __future__ import annotations
 
+from functools import partial
 from typing import Sequence
 
 import jax
 import jax.numpy as jnp
 from jax import lax
+
+_ARGMAX_BWD = True
+
+
+def set_argmax_bwd(on: bool) -> None:
+    """Toggle the recomputed-argmax backward (trace-time flag); off = the
+    select-and-scatter autodiff lowering, kept as the A/B baseline."""
+    global _ARGMAX_BWD
+    _ARGMAX_BWD = bool(on)
+
+
+def _reduce_window_max(x, kh, kw, sh, sw, ph, pw):
+    return lax.reduce_window(
+        x,
+        -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) else jnp.iinfo(x.dtype).min,
+        lax.max,
+        window_dimensions=(1, 1, kh, kw),
+        window_strides=(1, 1, sh, sw),
+        padding=[(0, 0), (0, 0), (ph, ph), (pw, pw)],
+    )
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(1, 2, 3, 4, 5, 6))
+def _max_pool2d_argmax(x, kh, kw, sh, sw, ph, pw):
+    return _reduce_window_max(x, kh, kw, sh, sw, ph, pw)
+
+
+def _max_pool2d_fwd(x, kh, kw, sh, sw, ph, pw):
+    return _reduce_window_max(x, kh, kw, sh, sw, ph, pw), x
+
+
+def _max_pool2d_bwd(kh, kw, sh, sw, ph, pw, x, g):
+    B, C, H, W = x.shape
+    Hp, Wp = H + 2 * ph, W + 2 * pw
+    Ho, Wo = (Hp - kh) // sh + 1, (Wp - kw) // sw + 1
+    xp = jnp.pad(x, [(0, 0), (0, 0), (ph, ph), (pw, pw)],
+                 constant_values=-jnp.inf) if (ph or pw) else x
+
+    # each window offset (i, j) as a strided view aligned to the output
+    # grid: view[b, c, o, p] = xp[b, c, o*sh + i, p*sw + j]
+    def view(i, j):
+        return lax.slice(
+            xp, (0, 0, i, j),
+            (B, C, i + (Ho - 1) * sh + 1, j + (Wo - 1) * sw + 1),
+            (1, 1, sh, sw))
+
+    offsets = [(i, j) for i in range(kh) for j in range(kw)]
+    # recompute the window max from the saved input (elementwise tree of
+    # maxes — no reduce_window in the backward, no stored argmax/indices)
+    y = view(0, 0)
+    for i, j in offsets[1:]:
+        y = jnp.maximum(y, view(i, j))
+
+    dxp = jnp.zeros((B, C, Hp, Wp), g.dtype)
+    claimed = jnp.zeros((B, C, Ho, Wo), jnp.bool_)
+    for i, j in offsets:  # row-major = select-and-scatter's GE tie order
+        hit = (view(i, j) == y) & ~claimed
+        claimed = claimed | hit
+        contrib = jnp.where(hit, g, jnp.zeros((), g.dtype))
+        # scatter the output-grid contribution back onto the padded input
+        # frame: offset by (i, j), stride via interior padding
+        dxp = dxp + lax.pad(
+            contrib, jnp.zeros((), g.dtype),
+            [(0, 0, 0), (0, 0, 0),
+             (i, Hp - (i + (Ho - 1) * sh + 1), sh - 1),
+             (j, Wp - (j + (Wo - 1) * sw + 1), sw - 1)])
+    dx = dxp[:, :, ph:ph + H, pw:pw + W] if (ph or pw) else dxp
+    return (dx,)
+
+
+_max_pool2d_argmax.defvjp(_max_pool2d_fwd, _max_pool2d_bwd)
 
 
 def max_pool2d(
@@ -25,14 +109,10 @@ def max_pool2d(
     kh, kw = kernel
     sh, sw = stride
     ph, pw = padding
-    return lax.reduce_window(
-        x,
-        -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) else jnp.iinfo(x.dtype).min,
-        lax.max,
-        window_dimensions=(1, 1, kh, kw),
-        window_strides=(1, 1, sh, sw),
-        padding=[(0, 0), (0, 0), (ph, ph), (pw, pw)],
-    )
+    if _ARGMAX_BWD and jnp.issubdtype(x.dtype, jnp.floating):
+        return _max_pool2d_argmax(x, int(kh), int(kw), int(sh), int(sw),
+                                  int(ph), int(pw))
+    return _reduce_window_max(x, kh, kw, sh, sw, ph, pw)
 
 
 def avg_pool2d(

@@ -8,6 +8,7 @@ roadmap model families that do use real deconvs.
 
 from __future__ import annotations
 
+from functools import partial
 from typing import Sequence
 
 import jax
@@ -16,6 +17,56 @@ from jax import lax
 
 from gan_deeplearning4j_tpu.ops.conv import DIMENSION_NUMBERS
 
+# Rematerialized upsample backward (RESULTS.md "Overlap experiment
+# series"): ``jnp.repeat``'s autodiff transpose lowers to the 60.2MB
+# broadcast+reduce chain hlo_cost_r5.json names as the #3 byte sink of
+# the fused step.  The exact adjoint of a nearest-neighbour repeat is a
+# factor-block sum: reshape [B,C,H*sh,W*sw] -> [B,C,H,sh,W,sw] (a free
+# bitcast — the split dims are exactly the row-major strides) and sum
+# the (sh, sw) axes — ONE fused strided reduce that reads the cotangent
+# once.  False = the pre-restructure autodiff lowering, kept as the A/B
+# baseline.
+_SUM_BWD = True
+
+
+def set_sum_bwd(on: bool) -> None:
+    """Toggle the restructured reshape-sum backward (trace-time flag)."""
+    global _SUM_BWD
+    _SUM_BWD = bool(on)
+
+
+def _repeat2d(x: jax.Array, sh: int, sw: int) -> jax.Array:
+    x = jnp.repeat(x, sh, axis=2)
+    x = jnp.repeat(x, sw, axis=3)
+    return x
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(1, 2))
+def _upsample2d_sumbwd(x: jax.Array, sh: int, sw: int) -> jax.Array:
+    return _repeat2d(x, sh, sw)
+
+
+def _upsample2d_fwd(x, sh, sw):
+    return _repeat2d(x, sh, sw), x.shape
+
+
+def _upsample2d_bwd(sh, sw, x_shape, g):
+    B, C, H, W = x_shape
+    # Opt-in Pallas path (GAN4J_PALLAS=1 / ops.pallas.enable): stream the
+    # cotangent through the double-buffered DMA pipeline so the reduce's
+    # HBM reads overlap compute explicitly instead of at the scheduler's
+    # discretion.  Lazy import: ops.pallas pulls in the kernel stack.
+    from gan_deeplearning4j_tpu.ops import pallas as pallas_kernels
+    if pallas_kernels.enabled():
+        from gan_deeplearning4j_tpu.ops.pallas import dma_pipeline
+        if dma_pipeline.supports_upsample_bwd(g.shape, sh, sw, g.dtype):
+            return (dma_pipeline.upsample_bwd_dma(g, sh, sw),)
+    dx = g.reshape(B, C, H, sh, W, sw).sum(axis=(3, 5))
+    return (dx,)
+
+
+_upsample2d_sumbwd.defvjp(_upsample2d_fwd, _upsample2d_bwd)
+
 
 def upsample2d(x: jax.Array, size: int | Sequence[int] = 2) -> jax.Array:
     """x: [B, C, H, W] -> [B, C, H*sh, W*sw] by nearest-neighbour repeat."""
@@ -23,9 +74,9 @@ def upsample2d(x: jax.Array, size: int | Sequence[int] = 2) -> jax.Array:
         sh = sw = size
     else:
         sh, sw = size
-    x = jnp.repeat(x, sh, axis=2)
-    x = jnp.repeat(x, sw, axis=3)
-    return x
+    if _SUM_BWD:
+        return _upsample2d_sumbwd(x, int(sh), int(sw))
+    return _repeat2d(x, sh, sw)
 
 
 def conv_transpose2d(

@@ -55,6 +55,47 @@ def apply_env_platform() -> None:
     jax.config.update("jax_platforms", ",".join(platforms))
 
 
+def backend_initialized() -> bool:
+    """Whether any XLA backend has already been created in this process
+    (after which XLA_FLAGS edits are silently ignored)."""
+    try:
+        from jax._src import xla_bridge
+
+        return bool(xla_bridge._backends)
+    except Exception:  # gan4j-lint: disable=swallowed-exception — private-API probe; an unknown jax layout just means "assume initialized" (the conservative answer)
+        return True
+
+
+def apply_xla_flags(flags: str, strict: bool = False) -> bool:
+    """Append scheduling/overlap flags (XLA_FLAGS syntax, space-separated
+    — e.g. ``--xla_tpu_enable_latency_hiding_scheduler=true``) to the
+    process environment, BEFORE the jax backend initializes.
+
+    XLA parses the env var exactly once, at backend creation: flags
+    applied later are silently ignored, which is how a scheduling A/B
+    silently measures two identical programs.  Returns True when the
+    flags can still take effect; on an already-initialized backend it
+    warns and returns False (raises under ``strict``) so callers that
+    need a guarantee — bench's per-flag lanes — re-exec a fresh process
+    instead (benchmarks/overlap_ab.py)."""
+    if not flags:
+        return True
+    if backend_initialized():
+        msg = ("XLA backend already initialized; XLA_FLAGS %r would be "
+               "silently ignored — set them before the first jax "
+               "device/compile call (bench's flag lanes re-exec for this)"
+               % flags)
+        if strict:
+            raise RuntimeError(msg)
+        import logging
+
+        logging.getLogger(__name__).warning(msg)
+        return False
+    prev = os.environ.get("XLA_FLAGS", "")
+    os.environ["XLA_FLAGS"] = (prev + " " + flags).strip()
+    return True
+
+
 @dataclasses.dataclass
 class RuntimeConfig:
     """Runtime equivalent of the reference's hardcoded backend constants."""

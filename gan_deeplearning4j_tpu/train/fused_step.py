@@ -77,6 +77,56 @@ def _apply_sync(dst_params: Dict, src_params: Dict, mapping) -> Dict:
     return out
 
 
+# Carry-dedup (the 51MB-copy fix, RESULTS.md "Overlap experiment series"):
+# under ``lax.scan`` the carried ProtocolState holds every synced weight
+# TWICE — gen_params duplicates the gan graph's generator side
+# (``gan_to_gen``), the gan graph's frozen discriminator tail duplicates
+# dis_params (``dis_to_gan``), and the classifier's frozen feature
+# extractor duplicates dis_params again (``dis_to_classifier``).  Two scan-carry outputs can never alias one
+# buffer, so XLA materializes a full HBM copy of each duplicate EVERY
+# step (the two 51.4MB ``copy`` ops in hlo_cost_r5.json — the #1/#2 byte
+# sinks of the b200 program).  The fix: carry each duplicated weight ONCE
+# and rebuild the mirror by the same ``_apply_sync`` merge (free aliasing
+# inside one iteration), restoring the full state after the scan.
+#
+# Only ``W``/``b`` are deduped.  BatchNorm running statistics (mean/var)
+# of the frozen tail are NOT rematerializable — the G-step's forward pass
+# updates them by momentum regardless of the lr-0 freeze — and
+# gamma/beta are kilobytes; all BN params therefore stay in the carry.
+# W/b of the tail (and of the classifier's synced feature extractor) ARE
+# exact: the per-step sync overwrites them before any read, and the
+# frozen RmsProp update is ``p - 0.0 * clip(...)`` = ``p`` bitwise for
+# finite grads (a diverged NaN grad would differ — the divergence
+# sentinel owns that regime).
+_DEDUP_NAMES = frozenset({"W", "b"})
+
+
+def _dedup_strip(params: Dict, mapping) -> Dict:
+    """Drop the deduped (synced W/b) entries of every mapped dst layer —
+    the scan-carry form.  Layer keys stay (possibly empty) so the pytree
+    keeps one dict per layer."""
+    out = dict(params)
+    for dst_layer, _src_layer, names in mapping:
+        drop = _DEDUP_NAMES.intersection(names)
+        out[dst_layer] = {
+            k: v for k, v in out[dst_layer].items() if k not in drop
+        }
+    return out
+
+
+def _dedup_rebuild(params: Dict, src_params: Dict, mapping) -> Dict:
+    """Inverse of ``_dedup_strip``: re-add the stripped entries from the
+    sync source (pure aliasing in XLA — no copies)."""
+    out = dict(params)
+    for dst_layer, src_layer, names in mapping:
+        add = _DEDUP_NAMES.intersection(names)
+        out[dst_layer] = {
+            **out[dst_layer],
+            **{n: src_params[src_layer][n] for n in names if n in add},
+        }
+    return out
+
+
 def make_protocol_step(
     dis, gen, gan, classifier,
     dis_to_gan, gan_to_gen, dis_to_classifier,
@@ -92,6 +142,7 @@ def make_protocol_step(
     codec_chunk_decode: bool = False,
     chunk_indexed: bool = False,
     telemetry: bool = False,
+    carry_dedup: bool = True,
 ):
     """Build the fused step:
     (state, real, labels, z_key, rng_key, y_real, y_fake, ones) ->
@@ -151,6 +202,17 @@ def make_protocol_step(
     streaming-chunk mode, where the f32 working copy is chunk-sized and
     the decode cost amortizes over steps_per_call; per-step decode (the
     default) keeps a u8-RESIDENT table at 1/4 HBM for its whole life.
+
+    ``carry_dedup`` (scan path only): carry every cross-graph-synced W/b
+    ONCE instead of twice, rebuilding the mirrors by aliasing — removes
+    the per-step 51.4MB scan-carry copies XLA otherwise emits for the
+    duplicated weights (see the module-level dedup note).  Bitwise
+    identical to the undeduped program for ANY input state: the first
+    step runs unrolled against the caller's literal gen/gan weights (a
+    fresh graph's gen init is NOT the projection of its gan init), and
+    every later step's mirror is exactly the sync the body would have
+    applied anyway.  Off = the pre-dedup lowering, kept as the A/B
+    baseline for the overlap experiment series.
 
     ``chunk_indexed``: the step takes an extra ``row_idx`` argument
     (after ``labels``) and ``real``/``labels`` are DISTINCT-row tables,
@@ -305,6 +367,48 @@ def make_protocol_step(
             donate = False
         inner = step
 
+        def _strip(s: ProtocolState) -> ProtocolState:
+            return s._replace(
+                gan_params=_dedup_strip(s.gan_params, dis_to_gan),
+                gen_params=_dedup_strip(s.gen_params, gan_to_gen),
+                clf_params=_dedup_strip(s.clf_params, dis_to_classifier))
+
+        def _scan_steps(state, run_one):
+            """``run_one(state) -> (state', losses)`` applied
+            ``steps_per_call`` times under ``lax.scan``; with
+            ``carry_dedup`` the duplicated W/b leave the carry (module
+            dedup note) and step 0 runs unrolled for exactness against
+            arbitrary (fresh-init) input states."""
+            if not carry_dedup:
+                return lax.scan(lambda s, _: run_one(s), state, None,
+                                length=steps_per_call)
+            state, l0 = run_one(state)
+
+            def body(s, _):
+                # gen W/b = the gan->gen sync of the previous step,
+                # rebuilt by aliasing; the gan tail's and classifier's
+                # W/b need no rebuild here — the body's own dis->* syncs
+                # re-add them before any read
+                full = s._replace(gen_params=_dedup_rebuild(
+                    s.gen_params, s.gan_params, gan_to_gen))
+                full, losses = run_one(full)
+                return _strip(full), losses
+
+            carry, ls = lax.scan(body, _strip(state), None,
+                                 length=steps_per_call - 1)
+            gan_params = _dedup_rebuild(
+                carry.gan_params, carry.dis_params, dis_to_gan)
+            gen_params = _dedup_rebuild(
+                carry.gen_params, gan_params, gan_to_gen)
+            state = carry._replace(
+                gan_params=gan_params, gen_params=gen_params,
+                clf_params=_dedup_rebuild(
+                    carry.clf_params, carry.dis_params, dis_to_classifier))
+            losses = jax.tree.map(
+                lambda a, b: jnp.concatenate([jnp.expand_dims(a, 0), b]),
+                l0, ls)
+            return state, losses
+
         if chunk_indexed:
             def step(state, real, labels, row_idx, z_key, rng_key,
                      y_real, y_fake, ones):
@@ -312,16 +416,10 @@ def make_protocol_step(
                     # one exact decode of the distinct-row table —
                     # amortized over the scan AND over row repetitions
                     real = dequant(real)
-
-                def body(s, _):
-                    s, losses = inner(s, real, labels, z_key, rng_key,
-                                      y_real, y_fake, ones,
-                                      row_idx=row_idx)
-                    return s, losses
-
-                state, losses = lax.scan(
-                    body, state, None, length=steps_per_call)
-                return state, losses
+                return _scan_steps(
+                    state,
+                    lambda s: inner(s, real, labels, z_key, rng_key,
+                                    y_real, y_fake, ones, row_idx=row_idx))
         else:
             def step(state, real, labels, z_key, rng_key, y_real, y_fake,
                      ones):
@@ -330,15 +428,11 @@ def make_protocol_step(
                     # the K scanned steps (the per-step decode would
                     # re-pay the one-hot matmul every iteration)
                     real = dequant(real)
-
-                def body(s, _):
-                    s, losses = inner(s, real, labels, z_key, rng_key,
-                                      y_real, y_fake, ones)
-                    return s, losses
-
-                state, losses = lax.scan(
-                    body, state, None, length=steps_per_call)
-                return state, losses  # each loss stacked [steps_per_call]
+                # each loss stacked [steps_per_call]
+                return _scan_steps(
+                    state,
+                    lambda s: inner(s, real, labels, z_key, rng_key,
+                                    y_real, y_fake, ones))
 
     if mesh is None:
         return jax.jit(step, donate_argnums=(0,) if donate else ())

@@ -266,6 +266,30 @@ class GANTrainerConfig:
     # per COMPILE, not per step).  bench --dryrun and the pytest
     # fixtures run the STRICT version of both.
     sanitize: bool = False
+    # -- DMA/compute overlap restructures (RESULTS.md "Overlap
+    # experiment series"; each default-on flag keeps the previous
+    # lowering reachable as its A/B baseline) --
+    # Drop the mirrored W/b (gen mirror of the gan's gen side, the
+    # gan's frozen dis tail, the classifier's frozen feature extractor)
+    # from the multistep scan carry: two carry outputs can't alias one
+    # buffer, so every mirror otherwise costs a per-step HBM copy of
+    # the 1024x6272 dense weight (the 51.4MB sinks of hlo_cost_r5).
+    # Bitwise-exact (step 0 runs unrolled; see fused_step._DEDUP_NAMES).
+    carry_dedup: bool = True
+    # Upsample backward as one reshape+strided-sum instead of the
+    # autodiff broadcast+reduce chain (the 60.2MB sink), and maxpool
+    # backward as a recomputed-argmax scatter instead of
+    # select-and-scatter (the 41.9MB sink).  Trace-time process-global
+    # toggles (ops/upsample.py, ops/pool.py) — set before tracing.
+    upsample_sum_bwd: bool = True
+    pool_argmax_bwd: bool = True
+    # Extra XLA scheduling flags (space-separated, XLA_FLAGS syntax),
+    # e.g. "--xla_tpu_enable_latency_hiding_scheduler=true".  XLA parses
+    # the env var once at backend init, so these only take effect when
+    # the trainer is constructed BEFORE anything initializes the jax
+    # backend — bench.py's flag lanes re-exec a fresh process per flag
+    # set for exactly this reason (runtime/backend.py apply_xla_flags).
+    xla_flags: Optional[str] = None
 
 
 class Workload:
@@ -674,6 +698,17 @@ class GANTrainer:
             from gan_deeplearning4j_tpu.train.preemption import parse_signals
 
             self._preempt_signal_nums = parse_signals(config.preempt_signals)
+        # overlap-restructure toggles are trace-time process globals —
+        # set them before ANY graph construction below traces an op
+        from gan_deeplearning4j_tpu.ops import pool as _pool
+        from gan_deeplearning4j_tpu.ops import upsample as _upsample
+
+        _upsample.set_sum_bwd(config.upsample_sum_bwd)
+        _pool.set_argmax_bwd(config.pool_argmax_bwd)
+        if config.xla_flags:
+            from gan_deeplearning4j_tpu.runtime.backend import apply_xla_flags
+
+            apply_xla_flags(config.xla_flags)
         os.makedirs(config.res_path, exist_ok=True)
 
         graphs = workload.build_graphs()
@@ -1625,6 +1660,7 @@ class GANTrainer:
                     self._fused_multi = self._fused_lib.make_protocol_step(
                         *graphs, *maps, data_on_device=True,
                         steps_per_call=self._steps_per_call,
+                        carry_dedup=c.carry_dedup,
                         data_codec=multi_codec,
                         codec_chunk_decode=(multi_codec is not None
                                             and not resident),

@@ -1,0 +1,87 @@
+"""The variance-aware bench regression gate (bench_gate.py): tolerance
+math, lower-is-better direction, spread-vs-legacy fallbacks, and the
+missing-series / missing-lastgood semantics the CI lane leans on."""
+
+import json
+
+from gan_deeplearning4j_tpu import bench_gate
+
+
+def _capture(med, iqr, **extra_series):
+    cap = {"multistep_step_ms": med,
+           "spread": {"median_ms": med, "iqr_ms": iqr}}
+    for name, (m, q) in extra_series.items():
+        cap[name] = {"multistep_step_ms": m,
+                     "spread": {"median_ms": m, "iqr_ms": q}}
+    return cap
+
+
+def test_self_comparison_passes():
+    cap = _capture(10.0, 0.1, fast_mode=(12.0, 0.2))
+    verdict = bench_gate.check_capture(cap, cap)
+    assert verdict["ok"] and verdict["compared"] == 2
+    assert all(not c["regressed"] for c in verdict["checks"])
+
+
+def test_regression_beyond_floor_and_iqr_fails():
+    old = _capture(10.0, 0.1)
+    new = _capture(20.0, 0.1)  # 2x slower: way past 5% floor and 3*IQR
+    verdict = bench_gate.check_capture(new, old)
+    assert not verdict["ok"]
+    row = verdict["checks"][0]
+    assert row["regressed"] and row["slower_by_ms"] == 10.0
+
+
+def test_speedup_never_regresses():
+    old = _capture(10.0, 0.1)
+    new = _capture(2.0, 0.1)
+    assert bench_gate.check_capture(new, old)["ok"]
+
+
+def test_noisy_captures_widen_the_gate():
+    # 8% slower would trip the 5% floor, but both captures carry ~0.5ms
+    # IQR: allowed = max(0.5, 3*(0.5+0.5)) = 3.0ms, so 0.8ms passes
+    old = _capture(10.0, 0.5)
+    new = _capture(10.8, 0.5)
+    verdict = bench_gate.check_capture(new, old)
+    assert verdict["ok"]
+    assert verdict["checks"][0]["allowed_slowdown_ms"] == 3.0
+    # same medians with tight IQRs: now 0.8ms IS a regression
+    tight_old, tight_new = _capture(10.0, 0.0), _capture(10.8, 0.0)
+    assert not bench_gate.check_capture(tight_new, tight_old)["ok"]
+
+
+def test_legacy_capture_without_spread_uses_flat_step_ms():
+    old = {"multistep_step_ms": 10.0}  # pre-v7 lastgood
+    new = _capture(10.2, 0.0)
+    verdict = bench_gate.check_capture(new, old)
+    assert verdict["ok"]  # 2% < the 5% floor; IQR fallback is 0
+    row = verdict["checks"][0]
+    assert row["old_iqr_ms"] == 0.0 and row["old_median_ms"] == 10.0
+
+
+def test_series_missing_on_either_side_is_skipped_not_failed():
+    old = _capture(10.0, 0.1)
+    new = _capture(10.0, 0.1, celeba=(3.0, 0.05))  # new block, no old
+    verdict = bench_gate.check_capture(new, old)
+    assert verdict["ok"] and "celeba" in verdict["skipped"]
+    # and nothing comparable at all -> not ok (a vacuous green is a lie)
+    assert not bench_gate.check_capture({}, old)["ok"]
+
+
+def test_missing_lastgood_file_is_a_vacuous_pass(tmp_path):
+    cap = _capture(10.0, 0.1)
+    verdict = bench_gate.check_against_lastgood(
+        cap, str(tmp_path / "nope.json"))
+    assert verdict["ok"] and verdict["compared"] == 0
+    assert "no usable lastgood" in verdict["reason"]
+
+
+def test_lastgood_roundtrip_through_file(tmp_path):
+    old = _capture(10.0, 0.1)
+    path = tmp_path / "BENCH_LASTGOOD.json"
+    path.write_text(json.dumps(old))
+    assert bench_gate.check_against_lastgood(
+        _capture(10.1, 0.1), str(path))["ok"]
+    assert not bench_gate.check_against_lastgood(
+        _capture(25.0, 0.1), str(path))["ok"]

@@ -1,0 +1,45 @@
+"""Evidence hygiene: every committed ``*.json`` must be valid JSON.
+
+The r5 acceptance/cgan captures were shell redirects of stdout, so
+driver log lines landed ABOVE the JSON object and every downstream
+consumer (the RESULTS tables, the regression gate, jq) had to re-learn
+the strip-the-preamble trick or crash.  Logs belong in the ``.log``
+sibling; the ``.json`` file is the machine-readable record, full stop.
+"""
+
+import json
+import os
+import subprocess
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _committed_json_files():
+    try:
+        out = subprocess.run(
+            ["git", "ls-files", "*.json"], cwd=REPO, capture_output=True,
+            text=True, timeout=60, check=True).stdout
+    except (OSError, subprocess.SubprocessError):
+        return None  # not a git checkout (installed wheel / export)
+    return [line for line in out.splitlines() if line.strip()]
+
+
+FILES = _committed_json_files()
+
+
+@pytest.mark.skipif(FILES is None, reason="not a git checkout")
+def test_every_committed_json_parses():
+    assert FILES, "git ls-files found no committed *.json"
+    bad = {}
+    for rel in FILES:
+        path = os.path.join(REPO, rel)
+        if not os.path.exists(path):  # deleted in the worktree
+            continue
+        try:
+            with open(path) as f:
+                json.load(f)
+        except ValueError as e:
+            bad[rel] = str(e)
+    assert not bad, f"unparsable committed JSON: {bad}"

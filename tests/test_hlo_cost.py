@@ -60,3 +60,56 @@ def test_conv_flops_and_byte_rules():
     s = hlo_cost.summarize(rows, top=5)
     assert s["total_conv_dot_flops"] == conv["flops"]
     assert s["top_ops"][0]["op"].startswith(("fusion", "convolution"))
+
+
+def test_overlap_bounds_math():
+    """The overlap envelope: no-overlap = serial sum, all-overlap = the
+    max; MFU at each edge follows from flops-time / step-time."""
+    peak, bw = 200e12, 800e9
+    # bytes-bound program: 1 GFLOP (5us) + 8 MB (10us)
+    b = hlo_cost.overlap_bounds(1e9, 8e6, peak=peak, bw=bw)
+    assert b["flops_us"] == 5.0 and b["bytes_us"] == 10.0
+    assert b["no_overlap_us"] == 15.0
+    assert b["all_overlap_us"] == 10.0
+    assert b["bound"] == "bytes"
+    assert b["mfu_at_no_overlap"] == round(5.0 / 15.0, 4)
+    assert b["mfu_at_all_overlap"] == round(5.0 / 10.0, 4)
+
+    # flops-bound program: the envelope collapses onto the flops time
+    f = hlo_cost.overlap_bounds(4e9, 8e6, peak=peak, bw=bw)
+    assert f["bound"] == "flops"
+    assert f["all_overlap_us"] == f["flops_us"] == 20.0
+    assert f["mfu_at_all_overlap"] == 1.0
+
+    # degenerate: an empty program must not divide by zero
+    z = hlo_cost.overlap_bounds(0.0, 0.0, peak=peak, bw=bw)
+    assert z["mfu_at_no_overlap"] is None
+    assert z["mfu_at_all_overlap"] is None
+
+
+def test_summarize_carries_bounds_and_ranking():
+    """summarize() ships the envelope computed from its own totals and
+    ranks top_ops by the roofline estimate (descending)."""
+    rows = hlo_cost.analyze_hlo(FRAGMENT)
+    s = hlo_cost.summarize(rows, top=5)
+    b = s["bounds"]
+    assert b["no_overlap_us"] >= b["all_overlap_us"] > 0
+    assert b["flops_us"] == s["flops_us"]
+    assert b["bytes_us"] == s["bytes_us"]
+    est = [r["t_est_us"] for r in s["top_ops"]]
+    assert est == sorted(est, reverse=True)
+
+
+def test_dma_halves_counted_once_in_totals():
+    """The copy-start/copy-done pair of an overlapped transfer must not
+    add the payload to the byte total at all — the consuming op already
+    counts it (charging both halves serially double-counts the DMA)."""
+    rows = hlo_cost.analyze_hlo(FRAGMENT)
+    total = sum(r["bytes"] for r in rows)
+    no_dma = FRAGMENT.replace(
+        "  %copy-start.3 = f32[1000,64]{1,0} copy-start(%p2)\n", ""
+    ).replace(
+        "  %copy-done.3 = f32[1000,64]{1,0} copy-done(%copy-start.3)\n",
+        "")
+    rows2 = hlo_cost.analyze_hlo(no_dma)
+    assert sum(r["bytes"] for r in rows2) == total

@@ -1,0 +1,182 @@
+"""The DMA/compute-overlap restructures (RESULTS.md "Overlap experiment
+series"): the rematerialized upsample backward, the recomputed-argmax
+maxpool backward, and the scan-carry weight dedup — each must reproduce
+the reference lowering's numerics (exactly where the op is
+order-independent, to 1-ulp summation-order tolerance where overlapping
+windows make float addition order visible)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from gan_deeplearning4j_tpu.ops import pool, upsample
+
+
+@pytest.fixture(autouse=True)
+def _restore_toggles():
+    yield
+    upsample.set_sum_bwd(True)
+    pool.set_argmax_bwd(True)
+
+
+def _vjp_pair(fn, x, g):
+    y, vjp = jax.vjp(fn, x)
+    return np.asarray(y), np.asarray(vjp(g)[0])
+
+
+def test_upsample_sum_bwd_matches_repeat_autodiff():
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.randn(3, 5, 7, 6).astype(np.float32))
+    g = jnp.asarray(rng.randn(3, 5, 14, 12).astype(np.float32))
+    fn = lambda x: upsample.upsample2d(x, 2)
+    upsample.set_sum_bwd(False)
+    y_ref, dx_ref = _vjp_pair(fn, x, g)
+    upsample.set_sum_bwd(True)
+    y_new, dx_new = _vjp_pair(fn, x, g)
+    # forward is the identical repeat either way
+    np.testing.assert_array_equal(y_ref, y_new)
+    # backward sums the same (sh*sw) cotangents per cell; only the
+    # association order differs -> 1-ulp tolerance
+    np.testing.assert_allclose(dx_ref, dx_new, rtol=1e-6, atol=1e-7)
+
+
+def test_upsample_sum_bwd_rectangular_factors():
+    rng = np.random.RandomState(1)
+    x = jnp.asarray(rng.randn(2, 3, 4, 5).astype(np.float32))
+    g = jnp.asarray(rng.randn(2, 3, 12, 10).astype(np.float32))
+    fn = lambda x: upsample.upsample2d(x, (3, 2))
+    upsample.set_sum_bwd(False)
+    _, dx_ref = _vjp_pair(fn, x, g)
+    upsample.set_sum_bwd(True)
+    _, dx_new = _vjp_pair(fn, x, g)
+    np.testing.assert_allclose(dx_ref, dx_new, rtol=1e-6, atol=1e-7)
+
+
+@pytest.mark.parametrize("kernel,stride,padding", [
+    ((2, 2), (1, 1), (0, 0)),   # the reference's overlapping pool
+    ((2, 2), (2, 2), (0, 0)),   # non-overlapping: must be bitwise
+    ((3, 3), (2, 2), (0, 0)),
+    ((2, 2), (1, 1), (1, 1)),   # padded windows
+])
+def test_maxpool_argmax_bwd_matches_select_and_scatter(kernel, stride,
+                                                       padding):
+    # quantized values force heavy max TIES — the case where a wrong tie
+    # rule (first-match vs last-match) diverges by O(1), not by ulps
+    rng = np.random.RandomState(7)
+    x = jnp.asarray(rng.randint(0, 3, (2, 3, 9, 8)).astype(np.float32))
+    fn = lambda x: pool.max_pool2d(x, kernel, stride, padding)
+    pool.set_argmax_bwd(False)
+    y_ref, vjp_ref = jax.vjp(fn, x)
+    g = jnp.asarray(rng.randn(*y_ref.shape).astype(np.float32))
+    dx_ref = np.asarray(vjp_ref(g)[0])
+    pool.set_argmax_bwd(True)
+    y_new, vjp_new = jax.vjp(fn, x)
+    dx_new = np.asarray(vjp_new(g)[0])
+    np.testing.assert_array_equal(np.asarray(y_ref), np.asarray(y_new))
+    if stride >= kernel:  # non-overlapping: single contribution per cell
+        np.testing.assert_array_equal(dx_ref, dx_new)
+    else:  # overlapping windows add up to kh*kw cotangents per cell;
+        # only the float addition order differs -> ulp tolerance
+        np.testing.assert_allclose(dx_ref, dx_new, rtol=1e-6, atol=1e-6)
+
+
+def test_maxpool_argmax_bwd_tie_goes_to_first_window_element():
+    # an all-equal plane: every window's max ties across all elements;
+    # select-and-scatter routes each window's cotangent to its FIRST
+    # (row-major) element — the restructured backward must agree exactly
+    x = jnp.ones((1, 1, 4, 4), jnp.float32)
+    fn = lambda x: pool.max_pool2d(x, (2, 2), (1, 1))
+    pool.set_argmax_bwd(False)
+    _, vjp_ref = jax.vjp(fn, x)
+    g = jnp.asarray(np.arange(9, dtype=np.float32).reshape(1, 1, 3, 3) + 1)
+    pool.set_argmax_bwd(True)
+    _, vjp_new = jax.vjp(fn, x)
+    np.testing.assert_array_equal(np.asarray(vjp_ref(g)[0]),
+                                  np.asarray(vjp_new(g)[0]))
+
+
+def test_carry_dedup_state_matches_undeduped(cpu_devices):
+    """The deduped scan carry must reproduce the undeduped program's
+    final state BITWISE — including the fresh-graph case where the gen
+    init is NOT the projection of the gan init (the unrolled first
+    step's job)."""
+    from gan_deeplearning4j_tpu.models import mlpgan_insurance as M
+    from gan_deeplearning4j_tpu.train import fused_step as fused
+
+    K = 4
+    B = 20
+    rng_np = np.random.RandomState(3)
+    table = jnp.asarray(rng_np.rand(3 * B, 12).astype(np.float32))
+    labels = jnp.asarray((rng_np.rand(3 * B, 1) > 0.5).astype(np.float32))
+    ones = jnp.ones((B, 1), dtype=jnp.float32)
+    key = jax.random.key(5)
+    inv = (key, jax.random.fold_in(key, 11), ones + 0.02, ones * 0.0 - 0.01,
+           ones)
+
+    outs = {}
+    for dedup in (False, True):
+        dis = M.build_discriminator()
+        gen = M.build_generator()
+        gan = M.build_gan()
+        clf = M.build_classifier(dis)
+        step = fused.make_protocol_step(
+            dis, gen, gan, clf,
+            M.DIS_TO_GAN, M.GAN_TO_GEN, M.DIS_TO_CLASSIFIER,
+            z_size=2, num_features=12, data_on_device=True,
+            steps_per_call=K, donate=False, carry_dedup=dedup)
+        state = fused.state_from_graphs(dis, gen, gan, clf)
+        outs[dedup] = step(state, table, labels, *inv)
+
+    s0, l0 = outs[False]
+    s1, l1 = outs[True]
+    for a, b in zip(jax.tree.leaves(l0), jax.tree.leaves(l1)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    for a, b in zip(jax.tree.leaves(s0), jax.tree.leaves(s1)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_carry_dedup_removes_mirror_weights_from_carry(cpu_devices):
+    """Structural check on the jaxpr (platform-independent, unlike the
+    compiled HLO): with dedup the scan carry drops one copy of every
+    cross-graph-synced W/b, so the carry is strictly smaller."""
+    from gan_deeplearning4j_tpu.models import mlpgan_insurance as M
+    from gan_deeplearning4j_tpu.train import fused_step as fused
+
+    def carry_bytes(dedup):
+        dis = M.build_discriminator()
+        gen = M.build_generator()
+        gan = M.build_gan()
+        clf = M.build_classifier(dis)
+        step = fused.make_protocol_step(
+            dis, gen, gan, clf,
+            M.DIS_TO_GAN, M.GAN_TO_GEN, M.DIS_TO_CLASSIFIER,
+            z_size=2, num_features=12, data_on_device=True,
+            steps_per_call=4, donate=False, carry_dedup=dedup)
+        state = fused.state_from_graphs(dis, gen, gan, clf)
+        table = jnp.zeros((40, 12), jnp.float32)
+        labels = jnp.zeros((40, 1), jnp.float32)
+        ones = jnp.ones((20, 1), jnp.float32)
+        key = jax.random.key(0)
+        jaxpr = jax.make_jaxpr(step)(
+            state, table, labels, key, key, ones, ones * 0, ones)
+
+        def find_scans(jx):  # the jitted step nests the scan under a pjit
+            for e in jx.eqns:
+                if e.primitive.name == "scan":
+                    yield e
+                sub = e.params.get("jaxpr")
+                if sub is not None:
+                    yield from find_scans(sub.jaxpr)
+
+        scans = list(find_scans(jaxpr.jaxpr))
+        assert scans, "multistep program must contain a scan"
+        n_carry = scans[-1].params["num_carry"]
+        invars = scans[-1].params["jaxpr"].jaxpr.invars[:n_carry]
+        return sum(int(np.prod(v.aval.shape)) * v.aval.dtype.itemsize
+                   for v in invars)
+
+    full, deduped = carry_bytes(False), carry_bytes(True)
+    # every synced W/b counted once instead of twice: gen mirror + gan
+    # frozen tail + classifier feature extractor
+    assert deduped < full, (deduped, full)
