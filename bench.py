@@ -137,14 +137,28 @@ def _record_lastgood(payload: dict, platform: str, rt_ms: float) -> None:
             or payload.get("compute_bf16")):
         _log("non-default run; BENCH_LASTGOOD.json left untouched")
         return
+    record = {
+        **payload,
+        "captured_platform": platform,
+        "captured_probe_rt_ms": round(rt_ms, 1),
+        "captured_unix_time": int(time.time()),
+    }
     try:
+        # carry the per-series gate record forward, refreshed with this
+        # capture's own series — a main-bench refresh must not un-gate
+        # the fleet baseline (bench_gate.py per-series records)
+        from gan_deeplearning4j_tpu import bench_gate
+        try:
+            with open(LASTGOOD_PATH) as f:
+                series = dict(json.load(f).get("series") or {})
+        except (OSError, ValueError):
+            series = {}
+        for label, med, iqr in bench_gate.series_stats(payload):
+            series[label] = {"median_ms": med, "iqr_ms": iqr}
+        if series:
+            record["series"] = series
         with open(LASTGOOD_PATH, "w") as f:
-            json.dump({
-                **payload,
-                "captured_platform": platform,
-                "captured_probe_rt_ms": round(rt_ms, 1),
-                "captured_unix_time": int(time.time()),
-            }, f, indent=1)
+            json.dump(record, f, indent=1)
     except OSError as e:  # a read-only checkout must not fail the bench
         _log(f"could not write {LASTGOOD_PATH}: {e}")
 

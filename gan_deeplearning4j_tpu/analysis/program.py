@@ -406,6 +406,63 @@ def _build_serving_infer(donate: bool = False) -> List[Built]:
     return built
 
 
+def _build_fleet_step(donate: bool = True) -> List[Built]:
+    import jax
+    import jax.numpy as jnp
+
+    from gan_deeplearning4j_tpu import bench
+    from gan_deeplearning4j_tpu.models import mlpgan_insurance as I
+    from gan_deeplearning4j_tpu.train import fleet, fused_step as fused
+    from gan_deeplearning4j_tpu.parallel import fleet as pfleet
+
+    b = bench.DRYRUN_BATCH
+    cfg = I.InsuranceConfig()
+    dis, gen = I.build_discriminator(), I.build_generator()
+    gan, classifier = I.build_gan(), I.build_classifier(dis)
+
+    def fleet_args(n):
+        state = fleet.replicate_state(
+            fused.state_from_graphs(dis, gen, gan, classifier), n)
+        keys = fleet.tenant_keys(jax.random.key(0), n)
+        ones = jnp.ones((b, 1), jnp.float32)
+        return state, (
+            state, jnp.zeros((n, b, cfg.num_features), jnp.float32),
+            jnp.zeros((n, b, 1), jnp.float32),
+            keys, fleet.tenant_keys(jax.random.key(1), n),
+            ones, 0.0 * ones, ones)
+
+    mk = dict(z_size=cfg.z_size, num_features=cfg.num_features,
+              per_tenant_data=True, donate=donate)
+    built = []
+    # t8: CI-sized; t1024: the flagship tenant count the HBM ceiling is
+    # pinned at.  Donation aliasing and the (empty) collective schedule
+    # are tenant-count-independent; lowering both proves it.
+    for n in (8, 1024):
+        step = fleet.make_fleet_step(
+            dis, gen, gan, classifier, I.DIS_TO_GAN, I.GAN_TO_GEN,
+            I.DIS_TO_CLASSIFIER, **mk)
+        state, args = fleet_args(n)
+        built.append(Built(
+            f"t{n}", step, abstractify(args),
+            len(jax.tree.leaves(state)) if donate else 0, b))
+    # the shard_map tenant-parallel variant: same program spread over a
+    # tenant mesh — the contract claim is ZERO collectives (tenants
+    # never communicate)
+    n_dev = min(8, len(jax.devices()))
+    if n_dev >= 2:
+        mesh = pfleet.tenant_mesh(n_dev)
+        step = pfleet.make_sharded_fleet_step(
+            dis, gen, gan, classifier, I.DIS_TO_GAN, I.GAN_TO_GEN,
+            I.DIS_TO_CLASSIFIER, mesh=mesh, **mk)
+        n = 2 * n_dev
+        state, args = fleet_args(n)
+        built.append(Built(
+            f"spmd{n_dev}", step, abstractify(args),
+            len(jax.tree.leaves(state)) if donate else 0, b,
+            mesh_shape={pfleet.AXIS: n_dev}))
+    return built
+
+
 def _serving_bucket_spec() -> Dict:
     from gan_deeplearning4j_tpu.parallel.inference import (
         DEFAULT_SERVING_BUCKETS,
@@ -454,6 +511,15 @@ register_entry(EntryPoint(
     bucket_spec=lambda: {"mode": "exact",
                          "code_declared": reachable_pair_batches(),
                          "reachable": reachable_pair_batches()},
+))
+
+register_entry(EntryPoint(
+    name="fleet_step",
+    summary="multi-tenant fleet step: the fused protocol step vmapped "
+            "over the tenant axis (train/fleet.py), lowered at 8 and "
+            "1024 tenants plus the shard_map tenant-mesh variant "
+            "(parallel/fleet.py; zero collectives by construction)",
+    build=_build_fleet_step,
 ))
 
 register_entry(EntryPoint(

@@ -463,6 +463,373 @@ def e2e_img_per_sec(res_path: str, data_on_device=None,
     return value
 
 
+# -- multi-tenant fleet bench (train/fleet.py): tenants*steps/sec ----------
+#
+# The fleet sweep: each tenant count is ONE bounded subprocess stage
+# (--fleet-stage N prints one JSON line), so an OOM or wedge at the
+# 4096-tenant end records a structured failure and the sweep continues —
+# the request-queue machinery folded in from the retired
+# benchmarks/tpu_queue.py round-3 queue.
+FLEET_SWEEP = (1, 64, 256, 1024, 4096)
+FLEET_FLAGSHIP = 1024
+FLEET_BATCH = 16        # FleetConfig's per-tenant batch default
+FLEET_RUN_STEPS = 100   # FleetConfig's num_iterations default: the run
+#                         length the sequential-equivalent accounting
+#                         charges per segment (insurance_main's 5000
+#                         would amortize compile away; a tiny K would
+#                         inflate it)
+FLEET_OUT_DIR = "outputs/fleet_bench"
+
+
+def _build_fleet_step_and_args(device, n_tenants: int, batch: int):
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from gan_deeplearning4j_tpu.models import mlpgan_insurance as I
+    from gan_deeplearning4j_tpu.train import fleet, fused_step as fused
+
+    cfg = I.InsuranceConfig()
+    dis, gen = I.build_discriminator(), I.build_generator()
+    gan, classifier = I.build_gan(), I.build_classifier(dis)
+    step = fleet.make_fleet_step(
+        dis, gen, gan, classifier,
+        I.DIS_TO_GAN, I.GAN_TO_GEN, I.DIS_TO_CLASSIFIER,
+        z_size=cfg.z_size, num_features=cfg.num_features,
+        per_tenant_data=True)
+    state = jax.device_put(fleet.replicate_state(
+        fused.state_from_graphs(dis, gen, gan, classifier), n_tenants),
+        device)
+    rng = np.random.RandomState(0)
+    real = jax.device_put(
+        rng.rand(n_tenants, batch, cfg.num_features).astype(np.float32),
+        device)
+    labels = jax.device_put(np.ones((n_tenants, batch, 1), np.float32),
+                            device)
+    key = jax.random.key(0)
+    ones = jnp.ones((batch, 1), jnp.float32)
+    inv = (
+        fleet.tenant_keys(key, n_tenants),
+        fleet.tenant_keys(jax.random.fold_in(key, 1), n_tenants),
+        ones + 0.05 * jnp.asarray(rng.randn(batch, 1), jnp.float32),
+        0.05 * jnp.asarray(rng.randn(batch, 1), jnp.float32),
+        ones,
+    )
+    return step, state, real, labels, inv
+
+
+def fleet_stage_time(n_tenants: int, batch: int = FLEET_BATCH,
+                     repeats: int = REPEATS,
+                     target_s: float = WINDOW_TARGET_S,
+                     want_flops: bool = False,
+                     want_hlo: bool = False) -> dict:
+    """One fleet measurement: seconds per FUSED fleet dispatch (all
+    ``n_tenants`` advance one protocol step in one XLA program), via the
+    v7 adaptive-window slope recipe.  The published rate is
+    tenants*steps/sec = n_tenants / step_seconds.  ``want_hlo`` adds the
+    hlo_cost.py roofline attribution of THIS tenant count's compiled
+    program (the knee diagnosis)."""
+    import jax
+
+    device = jax.devices()[0]
+    with jax.default_device(device):
+        step, state, real, labels, inv = _build_fleet_step_and_args(
+            device, n_tenants, batch)
+        flops, hlo_block, hlo_error = None, None, None
+        if want_flops or want_hlo:
+            try:
+                compiled = step.lower(
+                    state, real, labels, *inv).compile()
+            except Exception as e:
+                compiled, hlo_error = None, str(e)[:200]
+            if compiled is not None and want_flops:
+                try:
+                    cost = compiled.cost_analysis()
+                    # the CPU backend returns a one-element list of the
+                    # per-computation dicts; TPU returns the dict
+                    if isinstance(cost, (list, tuple)):
+                        cost = cost[0] if cost else {}
+                    flops = float(cost.get("flops", 0.0)) or None
+                except Exception:
+                    flops = None  # per-backend optional, like _peak_flops
+            if compiled is not None and want_hlo:
+                try:
+                    import sys as _sys
+                    root = os.path.dirname(os.path.dirname(
+                        os.path.abspath(__file__)))
+                    if root not in _sys.path:
+                        _sys.path.insert(0, root)
+                    from benchmarks import hlo_cost
+
+                    rows = hlo_cost.analyze_hlo(compiled.as_text())
+                    hlo_block = hlo_cost.summarize(rows, top=5)
+                except Exception as e:
+                    hlo_error = str(e)[:200]
+
+        for _ in range(WARMUP):
+            state, losses = step(state, real, labels, *inv)
+        _fence(losses)
+
+        def window(n):
+            nonlocal state
+            losses = None
+            t0 = time.perf_counter()
+            for _ in range(n):
+                state, losses = step(state, real, labels, *inv)
+            _fence(losses)
+            return time.perf_counter() - t0
+
+        stats = _slope_stats(window, 1, repeats, target_s)
+    t = stats["seconds"]
+    out = {
+        "tenants": n_tenants,
+        "batch": batch,
+        "step_ms": round(t * 1e3, 4),
+        "steps_per_sec": round(1.0 / t, 3),
+        "tenants_steps_per_sec": round(n_tenants / t, 2),
+        "spread": stats["spread"],
+    }
+    if flops:
+        out["flops_per_step"] = flops
+    if hlo_block:
+        out["hlo_cost"] = hlo_block
+    if hlo_error and want_hlo:
+        out["hlo_cost_error"] = hlo_error
+    return out
+
+
+def fleet_run_wall(n_tenants: int, steps: int,
+                   batch: int = FLEET_BATCH) -> dict:
+    """Wall seconds of a complete fleet RUN at ``n_tenants``: model
+    build + XLA compile + ``steps`` fused dispatches, fenced.  With
+    ``n_tenants=0`` it measures the SINGLE-MODEL run instead — the
+    plain ``make_protocol_step`` program an independently-launched
+    single-tenant run executes, not the vmapped program at N=1.  The
+    pair is the sequential-equivalent comparison: a fleet run pays the
+    build+compile once; N sequential runs re-pay it N times."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    device = jax.devices()[0]
+    t0 = time.perf_counter()
+    with jax.default_device(device):
+        if n_tenants:
+            step, state, real, labels, inv = _build_fleet_step_and_args(
+                device, n_tenants, batch)
+        else:
+            from gan_deeplearning4j_tpu.models import mlpgan_insurance as I
+            from gan_deeplearning4j_tpu.train import fused_step as fused
+
+            cfg = I.InsuranceConfig()
+            dis, gen = I.build_discriminator(), I.build_generator()
+            gan, classifier = I.build_gan(), I.build_classifier(dis)
+            step = fused.make_protocol_step(
+                dis, gen, gan, classifier,
+                I.DIS_TO_GAN, I.GAN_TO_GEN, I.DIS_TO_CLASSIFIER,
+                z_size=cfg.z_size, num_features=cfg.num_features)
+            state = jax.device_put(fused.state_from_graphs(
+                dis, gen, gan, classifier), device)
+            rng = np.random.RandomState(0)
+            real = jax.device_put(
+                rng.rand(batch, cfg.num_features).astype(np.float32),
+                device)
+            labels = jax.device_put(np.ones((batch, 1), np.float32),
+                                    device)
+            key = jax.random.key(0)
+            ones = jnp.ones((batch, 1), jnp.float32)
+            inv = (key, jax.random.fold_in(key, 1),
+                   ones + 0.05 * jnp.asarray(rng.randn(batch, 1),
+                                             jnp.float32),
+                   0.05 * jnp.asarray(rng.randn(batch, 1), jnp.float32),
+                   ones)
+        losses = None
+        for _ in range(steps):
+            state, losses = step(state, real, labels, *inv)
+        _fence(losses)
+    return {"tenants": n_tenants, "batch": batch, "steps": steps,
+            "run_wall_s": round(time.perf_counter() - t0, 3),
+            "includes_compile": True}
+
+
+def _run_fleet_stage(name: str, cmd: list, timeout_s: float,
+                     out_dir: str, summary: dict) -> bool:
+    """Run one sweep stage as a bounded subprocess; capture tail + last
+    JSON line; False on failure.  Folded from the retired
+    benchmarks/tpu_queue.py: own process group (a timeout must kill the
+    stage's grandchildren too), last-JSON-line result parse, and the
+    exit-0 structured-skip contract (rc 0 with ``"skipped"`` is NOT a
+    measurement and never reports as a successful stage)."""
+    import signal
+    import subprocess
+
+    log_path = os.path.join(out_dir, f"{name}.log")
+    t0 = time.perf_counter()
+    proc = subprocess.Popen([sys.executable] + cmd,
+                            stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+                            text=True, start_new_session=True)
+    try:
+        stdout, stderr = proc.communicate(timeout=timeout_s)
+        timed_out = False
+    except subprocess.TimeoutExpired:
+        os.killpg(proc.pid, signal.SIGKILL)
+        stdout, stderr = proc.communicate()
+        timed_out = True
+    with open(log_path, "w") as f:
+        f.write((stdout or "") + "\n--- stderr ---\n" + (stderr or ""))
+    rec: dict = {"ok": (not timed_out) and proc.returncode == 0,
+                 "wall_s": round(time.perf_counter() - t0, 1)}
+    for line in reversed((stdout or "").strip().splitlines()):
+        try:
+            parsed = json.loads(line)
+        except ValueError:  # gan4j-lint: disable=swallowed-exception — scanning the tail for the one JSON result line; non-JSON progress lines are expected, the full stdout is already in the stage log
+            continue
+        if isinstance(parsed, dict):  # the result object, not a scalar
+            rec["result"] = parsed
+            break
+    if timed_out:
+        rec["error"] = f"timeout >{timeout_s:.0f}s (partial log kept)"
+    elif proc.returncode != 0:
+        rec["error"] = (stderr or "").strip().splitlines()[-1:]
+    elif isinstance(rec.get("result"), dict) and rec["result"].get("skipped"):
+        rec["ok"] = False
+        rec["error"] = ("stage self-skipped: "
+                        + str(rec["result"].get("reason",
+                                                "no reason given")))
+    summary[name] = rec
+    print(f"[fleet] {name}: ok={rec['ok']} wall={rec['wall_s']}s",
+          file=sys.stderr, flush=True)
+    return rec["ok"]
+
+
+def fleet_bench(sweep=FLEET_SWEEP, flagship: int = FLEET_FLAGSHIP,
+                batch: int = FLEET_BATCH, stage_timeout_s: float = 900.0,
+                run_steps: int = FLEET_RUN_STEPS,
+                out_dir: str = FLEET_OUT_DIR) -> dict:
+    """The fleet bench of record: sweep tenant counts as bounded
+    subprocess stages, publish the flagship tenants*steps/sec with the
+    v7 spread block, the multiple over the sequential one-model-at-a-
+    time equivalent (run-wall accounting: each sequential run re-pays
+    build + XLA compile; the fused fleet pays once), and the
+    hlo_cost.py roofline attribution of the scaling knee."""
+    os.makedirs(out_dir, exist_ok=True)
+    summary: dict = {}
+    sweep = sorted(set(sweep) | {1, flagship})
+    for n in sweep:
+        cmd = ["-m", "gan_deeplearning4j_tpu.bench",
+               "--fleet-stage", str(n), "--fleet-batch", str(batch)]
+        _run_fleet_stage(f"fleet_t{n}", cmd, stage_timeout_s,
+                         out_dir, summary)
+    stages = {n: summary[f"fleet_t{n}"]["result"] for n in sweep
+              if summary[f"fleet_t{n}"]["ok"]
+              and isinstance(summary[f"fleet_t{n}"].get("result"), dict)}
+    failed = {f"fleet_t{n}": summary[f"fleet_t{n}"].get("error")
+              for n in sweep if n not in stages}
+
+    import jax
+
+    out: dict = {
+        "metric": "gan4j_fleet_tenants_steps_per_sec",
+        "unit": "tenants*steps/sec",
+        "platform": jax.devices()[0].platform,
+        "batch_per_tenant": batch,
+        "methodology_version": METHODOLOGY_VERSION,
+        "scaling": [stages[n] for n in sorted(stages)],
+    }
+    if failed:
+        out["failed_stages"] = failed
+    if not stages:
+        out.update({"skipped": True,
+                    "reason": "every fleet stage failed"})
+        return out
+
+    flag_n = flagship if flagship in stages else max(stages)
+    flag = stages[flag_n]
+    out["value"] = flag["tenants_steps_per_sec"]
+    out["tenants"] = flag_n
+    # the gate-compatible series block ("fleet" in bench_gate.SERIES):
+    # per-dispatch median ms + spread, like every other series
+    out["fleet"] = {"multistep_step_ms": flag["step_ms"],
+                    "spread": flag["spread"],
+                    "tenants": flag_n}
+    if 1 in stages:
+        t1, tn = stages[1]["step_ms"], flag["step_ms"]
+        # per-dispatch slope ratio: honest but partial — the slope
+        # cancels exactly the dispatch + build + compile costs a
+        # sequential fleet pays per run, so it bounds the fused win
+        # from below on a compute-bound host
+        out["steady_state"] = {
+            "single_tenant_step_ms": t1,
+            "fleet_step_ms": tn,
+            "multiple": round(flag_n * t1 / tn, 1) if tn else None,
+        }
+    # sequential-equivalent RUN accounting: a production sweep trains
+    # each segment for a run of K steps.  flag_n sequential runs re-pay
+    # model build + XLA compile + per-dispatch overhead K times each;
+    # the fused fleet run pays ONE build + compile for all tenants.
+    # Both sides measured as fresh subprocesses (cold jit caches).
+    for name, n in (("fleet_run_single", 0),
+                    (f"fleet_run_t{flag_n}", flag_n)):
+        _run_fleet_stage(
+            name, ["-m", "gan_deeplearning4j_tpu.bench",
+                   "--fleet-run-wall", str(n),
+                   "--fleet-run-steps", str(run_steps),
+                   "--fleet-batch", str(batch)],
+            stage_timeout_s, out_dir, summary)
+    single = summary["fleet_run_single"]
+    fleet_run = summary[f"fleet_run_t{flag_n}"]
+    if single["ok"] and fleet_run["ok"]:
+        t_seq = single["result"]["run_wall_s"]
+        t_fleet = fleet_run["result"]["run_wall_s"]
+        out["sequential_equivalent"] = {
+            "steps_per_run": run_steps,
+            "single_run_wall_s": t_seq,
+            "sequential_runs_wall_s": round(flag_n * t_seq, 1),
+            "fleet_run_wall_s": t_fleet,
+            "multiple": round(flag_n * t_seq / t_fleet, 1)
+            if t_fleet else None,
+            "note": ("run-wall accounting: each of the "
+                     f"{flag_n} sequential runs re-pays model build + "
+                     "XLA compile; the fused fleet run pays one"),
+        }
+    # scaling knee: the first sweep point whose tenants*steps/sec gain
+    # falls under 75% of the ideal (linear) gain over the previous point
+    ns = sorted(stages)
+    knee_n, knee_eff = None, None
+    for a, b in zip(ns, ns[1:]):
+        gain = (stages[b]["tenants_steps_per_sec"]
+                / max(stages[a]["tenants_steps_per_sec"], 1e-9))
+        eff = gain / (b / a)
+        if knee_n is None and eff < 0.75:
+            knee_n, knee_eff = b, round(eff, 3)
+    if knee_n is None and len(ns) >= 2:     # no knee inside the sweep
+        knee_n, knee_eff = ns[-1], round(
+            (stages[ns[-1]]["tenants_steps_per_sec"]
+             / stages[ns[-2]]["tenants_steps_per_sec"])
+            / (ns[-1] / ns[-2]), 3)
+    if knee_n is not None:
+        knee = {"tenants": knee_n, "scaling_efficiency": knee_eff}
+        # attribute it: the roofline decomposition of the knee point's
+        # OWN compiled program (in-process; the sweep subprocesses have
+        # exited, so this is the only program this process compiles)
+        try:
+            knee["hlo_cost"] = fleet_stage_time(
+                knee_n, batch=batch, repeats=1, target_s=0.2,
+                want_hlo=True).get("hlo_cost")
+        except Exception as e:   # attribution is best-effort diagnosis
+            knee["hlo_cost_error"] = str(e)[:200]
+        out["knee"] = knee
+
+    from gan_deeplearning4j_tpu import bench_gate
+
+    out["regression_gate"] = bench_gate.check_against_lastgood(
+        out, os.path.join(os.path.dirname(BASELINE_PATH),
+                          "BENCH_LASTGOOD.json"))
+    with open(os.path.join(out_dir, "summary.json"), "w") as f:
+        json.dump({"stages": summary, "capture": out}, f, indent=1)
+    return out
+
+
 def checkpoint_dryrun() -> dict:
     """Async-vs-sync checkpoint A/B on the real four-graph model set:
     the training-thread BLOCKING time of an ``AsyncCheckpointer.save``
@@ -852,6 +1219,54 @@ def dryrun(telemetry: bool = True,
                 # inversions; feeds gan4j_lock_* into the scrape below
                 with events_mod.span("bench.race"):
                     race = race_dryrun(registry=registry)
+                # the multi-tenant fleet (train/fleet.py): one FUSED
+                # fleet dispatch — every tenant advances one protocol
+                # step in one XLA program — under an armed recompile
+                # sentinel, its stats fed to the exporter so the scrape
+                # below must carry the gan4j_fleet_* series and the
+                # "fleet" bench series
+                from gan_deeplearning4j_tpu.analysis import (
+                    RecompileSentinel,
+                )
+
+                with events_mod.span("bench.fleet"):
+                    fleet_n = 8
+                    fstep, fstate, freal, flabels, finv = \
+                        _build_fleet_step_and_args(
+                            device, fleet_n, DRYRUN_BATCH)
+                    fsentinel = RecompileSentinel(registry=registry)
+                    with fsentinel:
+                        for _ in range(2):  # warmup: the one compile
+                            fstate, flosses = fstep(
+                                fstate, freal, flabels, *finv)
+                        _fence(flosses)
+                        fsentinel.arm()
+                        t0 = time.perf_counter()
+                        fstate, flosses = fstep(
+                            fstate, freal, flabels, *finv)
+                        _fence(flosses)
+                        f_ms = (time.perf_counter() - t0) * 1e3
+                    d_losses = flosses[0]
+                    fleet_rec = {
+                        "tenants": fleet_n,
+                        "dispatch_ms": round(f_ms, 3),
+                        "steps_per_sec": round(1e3 / f_ms, 3)
+                        if f_ms else 0.0,
+                        "post_warmup_recompiles":
+                            len(fsentinel.recompiles),
+                        "losses_shape": list(d_losses.shape),
+                    }
+                    fleet_feed = {**fleet_rec, "ok": True}
+                    registry.observe_fleet(lambda: fleet_feed)
+                    publish_bench_series(
+                        registry,
+                        {"fleet": {"multistep_step_ms": round(f_ms, 4),
+                                   "spread": {"median_ms": round(f_ms, 4),
+                                              "iqr_ms": 0.0}}})
+                    fleet_losses_ok = (
+                        d_losses.shape == (fleet_n,)
+                        and all(math.isfinite(float(v))
+                                for v in d_losses))
                 # one record through the registry feed, then a REAL
                 # scrape over the socket: the CI assertion that the
                 # exporter answers with the step/goodput/NaN series
@@ -916,6 +1331,23 @@ def dryrun(telemetry: bool = True,
                     and "gan4j_data_last_error_age_seconds " in m_body
                     and isinstance(data_block, dict)
                     and data_block.get("ok") is True)
+                # fleet surface: zero post-warmup recompiles on the
+                # fused fleet dispatch, per-tenant losses finite, the
+                # gan4j_fleet_* series live in the scrape (fed, not
+                # just pre-created: /healthz must report the real
+                # tenant count), and the "fleet" bench series present
+                fleet_block = health.get("fleet")
+                fleet_ok = (
+                    fleet_losses_ok
+                    and fleet_rec["post_warmup_recompiles"] == 0
+                    and len(fsentinel.compiles) >= 1
+                    and "gan4j_fleet_tenants " in m_body
+                    and "gan4j_fleet_steps_per_sec " in m_body
+                    and "gan4j_fleet_dispatch_ms " in m_body
+                    and 'gan4j_bench_step_ms{series="fleet"}' in m_body
+                    and isinstance(fleet_block, dict)
+                    and fleet_block.get("tenants") == fleet_n
+                    and fleet_block.get("ok") is True)
                 recorder.flush()
                 try:
                     events_ok = len(events_mod.read_events(
@@ -933,7 +1365,7 @@ def dryrun(telemetry: bool = True,
                            and watchdog_ok and data_ok
                            and lint["ok"] and sanitizer["ok"]
                            and prove["ok"] and race_ok
-                           and bench_stable_ok),
+                           and bench_stable_ok and fleet_ok),
                 "platform": device.platform,
                 "telemetry": telemetry,
                 "checkpoint": ckpt,
@@ -949,6 +1381,8 @@ def dryrun(telemetry: bool = True,
                 "prove": prove,
                 "race_ok": bool(race_ok),
                 "race": race,
+                "fleet_ok": bool(fleet_ok),
+                "fleet": fleet_rec,
                 "bench_stable_ok": bool(bench_stable_ok),
                 "bench_spread": spread,
                 "watchdog_beat_us": round(beat_us, 3)}
@@ -988,6 +1422,40 @@ def main(argv=None) -> None:
                    help="serve /metrics + /healthz during the e2e "
                         "trainer run (and the --dryrun smoke's "
                         "self-scrape); 0 = ephemeral")
+    p.add_argument("--fleet", action="store_true",
+                   help="multi-tenant fleet bench of record "
+                        "(train/fleet.py): sweep tenant counts as "
+                        "bounded subprocess stages and print one JSON "
+                        "line — flagship tenants*steps/sec with the v7 "
+                        "spread block, the multiple over the "
+                        "sequential single-model equivalent, and the "
+                        "hlo_cost.py attribution of the scaling knee")
+    p.add_argument("--fleet-stage", type=int, default=None, metavar="N",
+                   help="(internal sweep unit) measure ONE tenant "
+                        "count in this process and print one JSON line")
+    p.add_argument("--fleet-sweep", default=",".join(
+                       str(n) for n in FLEET_SWEEP), metavar="N,N,...",
+                   help="tenant counts for the --fleet sweep")
+    p.add_argument("--fleet-flagship", type=int, default=FLEET_FLAGSHIP,
+                   help="the tenant count the headline number and the "
+                        "regression-gated 'fleet' series report")
+    p.add_argument("--fleet-batch", type=int, default=FLEET_BATCH,
+                   help="per-tenant batch (default: FleetConfig's 16)")
+    p.add_argument("--fleet-run-wall", type=int, default=None,
+                   metavar="N",
+                   help="(internal sweep unit) wall seconds of one "
+                        "complete RUN — build + compile + "
+                        "--fleet-run-steps steps — at N tenants (0 = "
+                        "the plain single-model program); one JSON line")
+    p.add_argument("--fleet-run-steps", type=int,
+                   default=FLEET_RUN_STEPS, metavar="K",
+                   help="steps per run for the sequential-equivalent "
+                        "accounting (default: FleetConfig's 100)")
+    p.add_argument("--fleet-stage-timeout", type=float, default=900.0,
+                   metavar="S",
+                   help="per-stage subprocess budget; a stage killed at "
+                        "the deadline records a structured failure and "
+                        "the sweep continues")
     p.add_argument("--batch", type=int, default=DEFAULT_BATCH,
                    help="global batch (default: the reference's 200; the "
                         "CPU-baseline ratio is only reported at 200, "
@@ -1066,6 +1534,23 @@ def main(argv=None) -> None:
     if args.dryrun:
         print(json.dumps(dryrun(telemetry=args.telemetry,
                                 metrics_port=args.metrics_port)))
+        return
+    if args.fleet_stage is not None:
+        print(json.dumps(fleet_stage_time(
+            args.fleet_stage, batch=args.fleet_batch, want_flops=True)))
+        return
+    if args.fleet_run_wall is not None:
+        print(json.dumps(fleet_run_wall(
+            args.fleet_run_wall, args.fleet_run_steps,
+            batch=args.fleet_batch)))
+        return
+    if args.fleet:
+        sweep = tuple(int(n) for n in args.fleet_sweep.split(",") if n)
+        print(json.dumps(fleet_bench(
+            sweep=sweep, flagship=args.fleet_flagship,
+            batch=args.fleet_batch,
+            stage_timeout_s=args.fleet_stage_timeout,
+            run_steps=args.fleet_run_steps)))
         return
 
     # idempotent (not latch-on): repeated in-process main() calls — the

@@ -34,6 +34,7 @@ SERIES: Tuple[Tuple[str, Tuple[str, ...]], ...] = (
     ("fast_mode", ("fast_mode",)),
     ("celeba", ("celeba",)),
     ("celeba_fast", ("celeba_fast",)),
+    ("fleet", ("fleet",)),
 )
 
 # Tolerance floor: 5% — the day-to-day jitter of a healthy capture on
@@ -64,6 +65,10 @@ def _median_iqr(block: dict) -> Tuple[Optional[float], float]:
         iqr = spread.get("iqr_ms", 0.0)
         if isinstance(med, (int, float)):
             return float(med), float(iqr or 0.0)
+    # the per-series LASTGOOD record form: the stats live flat
+    med = block.get("median_ms")
+    if isinstance(med, (int, float)):
+        return float(med), float(block.get("iqr_ms") or 0.0)
     med = block.get("multistep_step_ms", block.get("step_ms"))
     if isinstance(med, (int, float)):
         return float(med), 0.0
@@ -85,6 +90,20 @@ def series_stats(capture: dict) -> List[Tuple[str, float, float]]:
     return out
 
 
+def _lastgood_block(lastgood: dict, label: str,
+                    path: Tuple[str, ...]) -> Optional[dict]:
+    """The last-good side of one series.  A PER-SERIES-KEYED record
+    (``{"series": {label: {median_ms, iqr_ms}}}``, written by
+    ``update_lastgood``) wins over the legacy whole-capture form: the
+    fleet bench and the main bench are separate invocations, so a
+    single-capture LASTGOOD can never hold both and whichever ran last
+    would silently un-gate the other."""
+    series = lastgood.get("series")
+    if isinstance(series, dict) and isinstance(series.get(label), dict):
+        return series[label]
+    return _dig(lastgood, path)
+
+
 def check_capture(capture: dict, lastgood: dict,
                   rel_floor: float = REL_FLOOR,
                   iqr_mult: float = IQR_MULT) -> dict:
@@ -98,7 +117,7 @@ def check_capture(capture: dict, lastgood: dict,
     skipped: List[str] = []
     for label, path in SERIES:
         new_block = _dig(capture, path)
-        old_block = _dig(lastgood, path)
+        old_block = _lastgood_block(lastgood, label, path)
         if new_block is None or old_block is None:
             skipped.append(label)
             continue
@@ -119,7 +138,7 @@ def check_capture(capture: dict, lastgood: dict,
             "slower_by_ms": round(slower_by, 4),
             "regressed": bool(slower_by > allowed),
         })
-    return {
+    verdict = {
         "ok": bool(checks) and not any(c["regressed"] for c in checks),
         "compared": len(checks),
         "checks": checks,
@@ -127,6 +146,54 @@ def check_capture(capture: dict, lastgood: dict,
         "rel_floor": rel_floor,
         "iqr_mult": iqr_mult,
     }
+    if not checks and series_stats(capture):
+        # The capture carries measurable series but NONE overlap the
+        # lastgood record (e.g. a first fleet run against a legacy
+        # main-only baseline): that is the documented "new series must
+        # not fail retroactively" case, so the verdict is a vacuous
+        # pass with a reason — promote via update_lastgood to arm the
+        # gate.  A capture with no series at all stays not-ok.
+        verdict["ok"] = True
+        verdict["reason"] = ("no overlapping series with lastgood; "
+                             "vacuous pass — promote with update_lastgood")
+    return verdict
+
+
+def update_lastgood(lastgood_path: str, capture: dict) -> dict:
+    """Merge a capture the operator accepts as good into the per-series
+    LASTGOOD record: only the series THIS capture carries are updated,
+    so a fleet run and a main bench run maintain their own baselines in
+    one file.  A legacy whole-capture record is converted on first
+    merge.  Returns the record written.  (Deliberately not called by
+    the bench itself — auto-accepting every run would turn regressions
+    into baselines; the driver promotes a run after reading the gate.)"""
+    try:
+        with open(lastgood_path) as f:
+            prior = json.load(f)
+    except (OSError, ValueError):
+        prior = {}
+    series = dict(prior.get("series") or {})
+    for label, path in SERIES:   # convert a legacy record once
+        if label not in series:
+            block = _dig(prior, path)
+            if block is not None:
+                med, iqr = _median_iqr(block)
+                if med is not None:
+                    series[label] = {"median_ms": med, "iqr_ms": iqr}
+    for label, med, iqr in series_stats(capture):
+        series[label] = {"median_ms": med, "iqr_ms": iqr}
+    # prior top-level keys survive the merge: the headline capture the
+    # bench shim cites on skipped rounds ("cached") must not be eaten
+    # by a fleet promotion that only knows its own series
+    record = dict(prior)
+    record["series"] = series
+    record["methodology_version"] = (
+        capture.get("methodology_version")
+        or prior.get("methodology_version"))
+    with open(lastgood_path, "w") as f:
+        json.dump(record, f, indent=1)
+        f.write("\n")
+    return record
 
 
 def check_against_lastgood(capture: dict, lastgood_path: str) -> dict:

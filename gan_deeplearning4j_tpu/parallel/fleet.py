@@ -1,0 +1,133 @@
+"""Tenant-axis sharding for the GAN fleet — multi-chip fleet scaling.
+
+``train/fleet.py`` turns N tenants into one vmapped program on one
+chip; this module spreads the TENANT axis across a mesh: each device
+holds ``N / world`` tenants and runs the identical vmapped block, with
+**zero collectives** — tenants are independent by construction, so
+nothing crosses the ICI (the ``fleet_step`` gan4j-prove contract pins
+the collective budget at zero, which is the whole point: fleet scaling
+is embarrassingly parallel, unlike the data-parallel protocol's
+pmean-per-step).
+
+Elasticity reuses ``parallel/elastic.py`` verbatim: a fleet checkpoint
+stores the stacked state as HOST arrays plus a :class:`~gan_deeplearning4j_tpu.parallel.elastic.MeshSpec`
+of the writing topology; restoring onto a different world size is one
+:func:`~gan_deeplearning4j_tpu.parallel.elastic.reshard` call — gather
+to host (already there), ``device_put`` under the new tenant
+``NamedSharding``.  Bytes move, values never round, so per-tenant state
+is bit-equal across any 8→4→16 world-size change
+(tests/test_fleet.py + tests/test_elastic.py fleet matrix case).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from gan_deeplearning4j_tpu.compat.jaxver import shard_map
+from gan_deeplearning4j_tpu.parallel import elastic
+from gan_deeplearning4j_tpu.telemetry import events as telemetry_events
+from gan_deeplearning4j_tpu.train import fleet as fleet_lib
+
+# the one mesh axis fleet programs shard over
+AXIS = "tenant"
+
+
+def tenant_mesh(n_devices: Optional[int] = None) -> Mesh:
+    """A 1-D ``("tenant",)`` mesh over the first ``n_devices`` devices
+    (all of them by default)."""
+    devices = jax.devices()
+    if n_devices is not None:
+        devices = devices[:n_devices]
+    return Mesh(np.array(devices), (AXIS,))
+
+
+def fleet_sharding(mesh: Mesh) -> NamedSharding:
+    """Leading-dim tenant sharding — one spec for every state leaf
+    (``it`` included: it is an ``(N,)`` per-tenant counter vector)."""
+    return NamedSharding(mesh, P(AXIS))
+
+
+def fleet_mesh_spec(mesh: Optional[Mesh]) -> elastic.MeshSpec:
+    """The fleet's :class:`MeshSpec` for checkpoint manifests.  Unlike
+    the data-parallel protocol (params replicated, batch sharded), a
+    fleet shards EVERY state role over the tenant axis."""
+    sharding = {elastic.ROLE_PARAMS: AXIS, elastic.ROLE_OPT_STATE: AXIS,
+                elastic.ROLE_BATCH: AXIS}
+    if mesh is None:
+        return elastic.MeshSpec(axes={AXIS: 1}, device_count=1,
+                                process_count=jax.process_count(),
+                                sharding=sharding)
+    return elastic.MeshSpec(
+        axes={str(k): int(v) for k, v in dict(mesh.shape).items()},
+        device_count=int(mesh.devices.size),
+        process_count=jax.process_count(), sharding=sharding)
+
+
+def check_divisible(num_tenants: int, mesh: Mesh) -> None:
+    world = int(mesh.devices.size)
+    if num_tenants % world:
+        raise ValueError(
+            f"fleet of {num_tenants} tenants does not divide the "
+            f"{world}-device tenant mesh — pad the fleet or shrink the "
+            "mesh (every device carries num_tenants/world tenants)")
+
+
+def shard_fleet_state(state, mesh: Mesh):
+    """Place a stacked fleet state under the tenant sharding via the
+    elastic reshard (gather-to-host → device_put: bit-equal, works the
+    same for a fresh stack, a live state, or a restored checkpoint —
+    including one written under a DIFFERENT world size)."""
+    check_divisible(fleet_lib.fleet_size(state), mesh)
+    return elastic.reshard(state, fleet_sharding(mesh))
+
+
+def make_sharded_fleet_step(
+    dis, gen, gan, classifier,
+    dis_to_gan, gan_to_gen, dis_to_classifier,
+    z_size: int,
+    num_features: int,
+    mesh: Mesh,
+    per_tenant_data: bool = False,
+    donate: bool = True,
+    data_on_device: bool = False,
+    steps_per_call: int = 1,
+    ema_decay: float = 0.0,
+    carry_dedup: bool = True,
+):
+    """The fleet step shard_mapped over the tenant axis: same signature
+    and same per-tenant math as ``train/fleet.make_fleet_step`` (each
+    shard runs the identical vmapped block on its tenant slice), with
+    state and key vectors tenant-sharded and the loop invariants
+    replicated.  ``per_tenant_data`` shards the data tables over
+    tenants too; otherwise every device holds the shared table."""
+    vstep = fleet_lib.make_fleet_step(
+        dis, gen, gan, classifier,
+        dis_to_gan, gan_to_gen, dis_to_classifier,
+        z_size=z_size, num_features=num_features,
+        per_tenant_data=per_tenant_data, data_on_device=data_on_device,
+        steps_per_call=steps_per_call, ema_decay=ema_decay,
+        carry_dedup=carry_dedup, jit=False)
+    data_spec = P(AXIS) if per_tenant_data else P()
+    sharded = shard_map(
+        vstep,
+        mesh=mesh,
+        # state + per-tenant key vectors sharded over the tenant axis;
+        # y_real/y_fake/ones replicated (shared across tenants by the
+        # fleet-step convention)
+        in_specs=(P(AXIS), data_spec, data_spec, P(AXIS), P(AXIS),
+                  P(), P(), P()),
+        out_specs=(P(AXIS), P(AXIS)),
+        check_vma=False,
+    )
+    if steps_per_call > 1 and donate:
+        # the repo-wide scan-donation exemption, announced as always
+        telemetry_events.instant(
+            "donation.disabled", reason="scan-donation",
+            steps_per_call=steps_per_call)
+        donate = False
+    return jax.jit(sharded, donate_argnums=(0,) if donate else ())

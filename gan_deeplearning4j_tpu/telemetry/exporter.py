@@ -124,6 +124,13 @@ class MetricsRegistry:
             # the feed (observe_mesh) raises it to the live count
             ("gan4j_mesh_devices", ()): 0.0,
             ("gan4j_reshard_seconds", ()): 0.0,
+            # multi-tenant fleet surface (train/fleet.py): 0 tenants =
+            # "no fleet running"; the feed (observe_fleet) raises them —
+            # pre-created like everything above so dashboards and alert
+            # rules see the series from the first scrape
+            ("gan4j_fleet_tenants", ()): 0.0,
+            ("gan4j_fleet_steps_per_sec", ()): 0.0,
+            ("gan4j_fleet_dispatch_ms", ()): 0.0,
         }
         self._callbacks: List[Callable[["MetricsRegistry"], None]] = []
         self.run_id: Optional[str] = None
@@ -140,6 +147,9 @@ class MetricsRegistry:
         # gan4j_mesh_devices / gan4j_reshard_* series and the /healthz
         # "mesh" block (ok:false while mesh formation is quorum-blocked)
         self._mesh_fn: Optional[Callable[[], Optional[Dict]]] = None
+        # fleet feed (train/fleet.FleetTrainer._fleet_report): drives
+        # the gan4j_fleet_* series and the /healthz "fleet" block
+        self._fleet_fn: Optional[Callable[[], Optional[Dict]]] = None
 
     @staticmethod
     def _key(name: str, labels: Optional[Dict]) -> Tuple[str, tuple]:
@@ -292,6 +302,31 @@ class MetricsRegistry:
 
         self.add_callback(cb)
 
+    def observe_fleet(self, report_fn: Callable[[], Optional[Dict]]) -> None:
+        """Register the fleet feed: ``report_fn`` returns a
+        ``FleetTrainer._fleet_report`` dict (tenant count, fused
+        throughput, dispatch latency).  Scrapes mirror it into the
+        ``gan4j_fleet_*`` series and ``/healthz`` carries it as the
+        ``"fleet"`` block — the bench-of-record headline
+        (tenants·steps/sec) is ``tenants * steps_per_sec`` of exactly
+        these two gauges."""
+        with self._lock:
+            self._fleet_fn = report_fn
+
+        def cb(reg: "MetricsRegistry") -> None:
+            rep = report_fn()
+            if not rep:
+                return
+            for key, series in (("tenants", "gan4j_fleet_tenants"),
+                                ("steps_per_sec",
+                                 "gan4j_fleet_steps_per_sec"),
+                                ("dispatch_ms", "gan4j_fleet_dispatch_ms")):
+                v = rep.get(key)
+                if isinstance(v, (int, float)):
+                    reg.set(series, float(v))
+
+        self.add_callback(cb)
+
     # -- render ---------------------------------------------------------------
 
     def render(self) -> str:
@@ -363,6 +398,20 @@ class MetricsRegistry:
                         "ok": bool(rep.get("ok", True))}
             except Exception:  # gan4j-lint: disable=swallowed-exception — a broken feed must not take down the probe
                 pass
+        # the fleet block: live feed when a fleet is running, else the
+        # pre-created gauges — ALWAYS present, like data/mesh above
+        fleet = None
+        ffn = self._fleet_fn
+        if ffn is not None:
+            try:
+                rep = ffn() or {}
+                fleet = {"tenants": int(rep.get("tenants", 0)),
+                         "steps_per_sec": float(
+                             rep.get("steps_per_sec", 0.0)),
+                         "dispatch_ms": float(rep.get("dispatch_ms", 0.0)),
+                         "ok": bool(rep.get("ok", True))}
+            except Exception:  # gan4j-lint: disable=swallowed-exception — a broken feed must not take down the probe
+                pass
         with self._lock:
             if data is None:
                 data = {"retries_total": int(self._counters.get(
@@ -376,12 +425,20 @@ class MetricsRegistry:
                         "reshard_total": int(self._counters.get(
                             ("gan4j_reshard_total", ()), 0.0)),
                         "forming": False, "ok": True}
+            if fleet is None:
+                fleet = {"tenants": int(self._gauges.get(
+                             ("gan4j_fleet_tenants", ()), 0.0)),
+                         "steps_per_sec": float(self._gauges.get(
+                             ("gan4j_fleet_steps_per_sec", ()), 0.0)),
+                         "dispatch_ms": float(self._gauges.get(
+                             ("gan4j_fleet_dispatch_ms", ()), 0.0)),
+                         "ok": True}
             age = (None if self._last_record_wall is None
                    else round(time.time() - self._last_record_wall, 3))
             doc = {"status": "stalled" if stalled else "ok",
                    "stalled": stalled, "run_id": self.run_id,
                    "last_record_age_s": age, "data": data,
-                   "mesh": mesh}
+                   "mesh": mesh, "fleet": fleet}
             if beat_age is not None:
                 doc["last_beat_age_s"] = round(float(beat_age), 3)
             return doc

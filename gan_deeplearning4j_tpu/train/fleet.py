@@ -1,0 +1,593 @@
+"""Fleet execution layer — thousands of per-segment GANs in ONE dispatch.
+
+The reference application is per-customer-segment feature engineering
+(SURVEY §0): at production scale that is one small MLP-GAN *per
+insurance segment*, i.e. a fleet of thousands of independent models.
+Run one at a time, each 4x3-lattice program leaves the MXU almost idle
+and the dominant cost is per-model dispatch overhead.  This module
+stacks N tenant parameter trees along a leading tenant axis and vmaps
+the existing fused three-graph step (train/fused_step.py) over it, so
+the whole fleet advances in one donated XLA dispatch — dense batched
+compute instead of N tiny dispatches.
+
+Semantics (docs/FLEET.md):
+
+  - **Stacking**: every ``ProtocolState`` leaf gains a leading tenant
+    dim via ``jax.tree.map``; ``state.it`` becomes an ``(N,)`` vector of
+    per-tenant device step counters.
+  - **PRNG independence**: tenant ``i`` draws from
+    ``fold_in(base_key, i)`` — the SAME folding a single-tenant control
+    run uses, so a fleet tenant's d/g-loss timeline is bitwise-equal
+    (f32) to an independently-run single-tenant control with the same
+    folded seed: the vmap changes the schedule, not the math
+    (tests/test_fleet.py::test_fleet_matches_single_tenant_controls).
+  - **Per-tenant semantics preserved**: the vmapped program contains the
+    unmodified fused step — carry-dedup, the RmsProp updater, the three
+    cross-graph syncs — applied per tenant with no cross-tenant
+    communication of any kind (the ``fleet_step`` program contract pins
+    the collective budget at zero).
+
+The multi-chip tenant-axis shard_map lives in ``parallel/fleet.py``;
+the supervised training payload (``FleetTrainer``) composes the shared
+supervision shell from ``train/shell.py``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import time
+from typing import Callable, Dict, Optional, Sequence
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from gan_deeplearning4j_tpu.data import resilient
+from gan_deeplearning4j_tpu.runtime import prng
+from gan_deeplearning4j_tpu.utils import device_fence
+from gan_deeplearning4j_tpu.telemetry import events as telemetry_events
+from gan_deeplearning4j_tpu.train import fused_step as fused_lib
+from gan_deeplearning4j_tpu.train.fused_step import ProtocolState
+
+# ProtocolState fields in checkpoint-tree order (``it`` and the optional
+# ``ema_gen`` are keyed explicitly; see state_to_tree)
+_STATE_FIELDS = ("dis_params", "dis_opt", "gan_params", "gan_opt",
+                 "clf_params", "clf_opt", "gen_params")
+
+
+# ---------------------------------------------------------------------------
+# per-tenant PRNG streams
+
+def tenant_keys(base_key: jax.Array, num_tenants: int) -> jax.Array:
+    """``(N,)`` key vector: tenant ``i`` gets ``fold_in(base_key, i)``.
+
+    This folding IS the fleet/control equivalence: a single-tenant run
+    seeded with ``fold_in(base, i)`` and fleet row ``i`` draw the same
+    z/dropout streams, so their timelines match bitwise."""
+    return jax.vmap(lambda i: jax.random.fold_in(base_key, i))(
+        jnp.arange(num_tenants))
+
+
+# ---------------------------------------------------------------------------
+# stacking / slicing
+
+def replicate_state(state: ProtocolState, num_tenants: int) -> ProtocolState:
+    """Broadcast ONE template init to an N-tenant fleet state.
+
+    All tenants start from the same weights (the builders are
+    deterministic in their seed); trajectories decorrelate through the
+    per-tenant PRNG streams.  For per-tenant *inits* stack distinct
+    states with :func:`stack_states` instead."""
+    return jax.tree.map(
+        lambda x: jnp.broadcast_to(x[None], (num_tenants,) + x.shape),
+        state)
+
+
+def stack_states(states: Sequence[ProtocolState]) -> ProtocolState:
+    """Stack N per-tenant states along a new leading tenant axis."""
+    return jax.tree.map(lambda *xs: jnp.stack(xs), *states)
+
+
+def fleet_size(state: ProtocolState) -> int:
+    return int(state.it.shape[0])
+
+
+def slice_tenant(state: ProtocolState, tenant: int) -> ProtocolState:
+    """Tenant ``tenant``'s state as a plain single-model ProtocolState."""
+    return jax.tree.map(lambda x: x[tenant], state)
+
+
+def subset_state(state: ProtocolState,
+                 tenants: Sequence[int]) -> ProtocolState:
+    """A smaller fleet holding only ``tenants`` (order preserved)."""
+    ids = jnp.asarray(list(tenants), jnp.int32)
+    return jax.tree.map(lambda x: jnp.take(x, ids, axis=0), state)
+
+
+# ---------------------------------------------------------------------------
+# checkpoint tree form (graph/serialization flattens nested DICTS only)
+
+# the flat '/'-key serialization cannot represent an EMPTY dict (no
+# leaves, no keys) — but param trees legitimately hold them (a Dropout
+# layer owns no params), and a restored state missing those layer keys
+# is unsteppable.  The tree form carries an explicit zero-scalar marker
+# per empty dict; state_from_tree strips it, so state values stay
+# bit-identical through the round trip.
+_EMPTY_MARKER = "__fleet_empty__"
+
+
+def _mark_empty(tree):
+    if isinstance(tree, dict):
+        if not tree:
+            return {_EMPTY_MARKER: jnp.zeros((), jnp.int32)}
+        return {k: _mark_empty(v) for k, v in tree.items()}
+    return tree
+
+
+def _unmark_empty(tree):
+    if isinstance(tree, dict):
+        return {k: _unmark_empty(v) for k, v in tree.items()
+                if k != _EMPTY_MARKER}
+    return tree
+
+
+def state_to_tree(state: ProtocolState) -> Dict:
+    """ProtocolState -> nested dict, the checkpoint-extras pytree form."""
+    tree = {f: _mark_empty(getattr(state, f)) for f in _STATE_FIELDS}
+    tree["it"] = state.it
+    if state.ema_gen is not None:
+        tree["ema_gen"] = _mark_empty(state.ema_gen)
+    return tree
+
+
+def state_from_tree(tree: Dict) -> ProtocolState:
+    ema = tree.get("ema_gen")
+    return ProtocolState(
+        *(_unmark_empty(tree[f]) for f in _STATE_FIELDS),
+        jnp.asarray(tree["it"], jnp.int32),
+        None if ema is None else _unmark_empty(ema))
+
+
+# ---------------------------------------------------------------------------
+# the fleet step
+
+def make_fleet_step(
+    dis, gen, gan, classifier,
+    dis_to_gan, gan_to_gen, dis_to_classifier,
+    z_size: int,
+    num_features: int,
+    per_tenant_data: bool = False,
+    donate: bool = True,
+    data_on_device: bool = False,
+    steps_per_call: int = 1,
+    ema_decay: float = 0.0,
+    carry_dedup: bool = True,
+    jit: bool = True,
+):
+    """Build the fleet step:
+    ``(state, real, labels, z_keys, rng_keys, y_real, y_fake, ones) ->
+    (state', (d_loss, g_loss, clf_loss))`` with every state leaf, both
+    key vectors and (vmapped) every loss carrying a leading tenant dim.
+
+    ``per_tenant_data``: ``real``/``labels`` are ``(N, ...)`` per-tenant
+    tables (the TenantRouter's output) mapped over axis 0; off = one
+    shared batch/table broadcast to every tenant (the bench's resident
+    mode — segment routing is a data concern, not a program one).
+
+    The inner program is the UNMODIFIED fused step built by
+    ``make_protocol_step(mesh=None)`` — vmap supplies the tenant axis,
+    so carry-dedup/scan/updater semantics hold per tenant by
+    construction.  Donation: the single-step fleet program donates the
+    stacked state (verified from the lowering by the ``fleet_step``
+    gan4j-prove contract); the scan path inherits the repo-wide
+    scan-donation exemption and announces the flip like fused_step does.
+
+    ``jit=False`` returns the raw vmapped callable — the form
+    ``parallel/fleet.py`` wraps in a tenant-axis shard_map."""
+    single = fused_lib.make_protocol_step(
+        dis, gen, gan, classifier,
+        dis_to_gan, gan_to_gen, dis_to_classifier,
+        z_size=z_size, num_features=num_features,
+        mesh=None, donate=False, data_on_device=data_on_device,
+        steps_per_call=steps_per_call, ema_decay=ema_decay,
+        carry_dedup=carry_dedup)
+    data_ax = 0 if per_tenant_data else None
+    vstep = jax.vmap(
+        single,
+        in_axes=(0, data_ax, data_ax, 0, 0, None, None, None),
+        out_axes=(0, 0))
+    if not jit:
+        return vstep
+    if steps_per_call > 1 and donate:
+        # same exemption as the single-model scan program — owned by the
+        # fleet_step/fused_multi contracts, never flipped silently
+        telemetry_events.instant(
+            "donation.disabled", reason="scan-donation",
+            steps_per_call=steps_per_call)
+        donate = False
+    return jax.jit(vstep, donate_argnums=(0,) if donate else ())
+
+
+# ---------------------------------------------------------------------------
+# per-tenant data routing
+
+class TenantRouter:
+    """Route a row stream to tenants with PER-TENANT quarantine budgets.
+
+    Row ``r`` belongs to segment/tenant ``r % num_tenants`` (the
+    production analog keys on a segment column; the modulo is the
+    deterministic stand-in the bench and tests share).  Each tenant
+    owns its own ``data/resilient.RecordQuarantine``
+    (``quarantine_tenant{i}.jsonl``, budget ``budget`` EACH): one
+    segment's poisoned feed burns only that segment's budget and
+    raises only that tenant's ``DataQuarantineError`` — a fleet must
+    not lose 4095 healthy tenants to one bad one.  All charges also
+    feed the shared :class:`~gan_deeplearning4j_tpu.data.resilient.DataHealth`
+    (the ``gan4j_data_*`` scrape series aggregate fleet-wide).
+
+    :meth:`route` validates rows (finite features/labels), quarantines
+    offenders, and returns rectangular per-tenant tables
+    ``(N, rows_per_tenant, ...)`` — the fleet step's
+    ``per_tenant_data`` form — truncated to the minimum surviving
+    per-tenant row count so every tenant sees the same step schedule."""
+
+    def __init__(self, res_path: str, num_tenants: int, budget: int,
+                 health: Optional[resilient.DataHealth] = None):
+        if num_tenants < 1:
+            raise ValueError(f"num_tenants must be >= 1, got {num_tenants}")
+        self.res_path = res_path
+        self.num_tenants = num_tenants
+        self.budget = budget
+        self.health = health
+        # lazily created — a 4096-tenant fleet with clean data should
+        # not stat 4096 quarantine files up front
+        self._quarantines: Dict[int, resilient.RecordQuarantine] = {}
+
+    def quarantine_for(self, tenant: int) -> resilient.RecordQuarantine:
+        q = self._quarantines.get(tenant)
+        if q is None:
+            q = resilient.RecordQuarantine(
+                os.path.join(self.res_path,
+                             f"quarantine_tenant{tenant}.jsonl"),
+                self.budget, health=self.health)
+            self._quarantines[tenant] = q
+        return q
+
+    def quarantined_total(self) -> int:
+        return sum(q.count for q in self._quarantines.values())
+
+    def route(self, features, labels, source: str = "<memory>"):
+        """``(rows, F), (rows, L)`` -> ``(N, m, F), (N, m, L)`` stacked
+        per-tenant tables (f32), bad rows quarantined per tenant."""
+        feats = np.asarray(features, np.float32)
+        labs = np.asarray(labels, np.float32)
+        if labs.ndim == 1:
+            labs = labs[:, None]
+        if feats.shape[0] != labs.shape[0]:
+            raise ValueError(
+                f"features/labels row counts differ: {feats.shape[0]} "
+                f"vs {labs.shape[0]}")
+        per_feat: Dict[int, list] = {t: [] for t in range(self.num_tenants)}
+        per_lab: Dict[int, list] = {t: [] for t in range(self.num_tenants)}
+        bad = ~(np.isfinite(feats).all(axis=1)
+                & np.isfinite(labs).all(axis=1))
+        for r in range(feats.shape[0]):
+            t = r % self.num_tenants
+            if bad[r]:
+                # raises this tenant's DataQuarantineError past budget
+                self.quarantine_for(t).charge(
+                    source, row=r, reason="non-finite row",
+                    raw=f"tenant={t}")
+                continue
+            per_feat[t].append(feats[r])
+            per_lab[t].append(labs[r])
+        m = min(len(v) for v in per_feat.values())
+        if m == 0:
+            raise ValueError(
+                "tenant routing left at least one tenant with zero "
+                f"rows ({feats.shape[0]} rows over {self.num_tenants} "
+                "tenants)")
+        out_f = np.stack([np.stack(per_feat[t][:m])
+                          for t in range(self.num_tenants)])
+        out_l = np.stack([np.stack(per_lab[t][:m])
+                          for t in range(self.num_tenants)])
+        return jnp.asarray(out_f), jnp.asarray(out_l)
+
+
+# ---------------------------------------------------------------------------
+# fleet checkpoints: save once, restore any tenant subset
+
+class FleetCheckpointer:
+    """Stacked-fleet checkpoints over ``checkpoint/TrainCheckpointer``.
+
+    The stacked state rides the checkpointer's EXTRAS pytree channel
+    (nested-dict form, ``state_to_tree``) with an empty graph set — so
+    manifest hashing, torn-write fallback, keep-rotation and the
+    elastic mesh_spec/reshard accounting all come from the one
+    checkpointer the repo already trusts.  On disk each leaf is the
+    full ``(N, ...)`` array: **save once, restore any tenant subset**
+    — slicing happens at restore (``tenants=``), not at save, so one
+    fleet checkpoint serves single-tenant forensics, subset fleets and
+    full-fleet resume alike, bit-equal to the stacked slices."""
+
+    EXTRA_KEY = "fleet"
+
+    def __init__(self, directory: str, keep: int = 3):
+        from gan_deeplearning4j_tpu.checkpoint.checkpointer import (
+            TrainCheckpointer,
+        )
+
+        self._inner = TrainCheckpointer(directory, keep=keep)
+        self.directory = directory
+
+    def save(self, step: int, state: ProtocolState, mesh=None) -> str:
+        from gan_deeplearning4j_tpu.parallel.fleet import fleet_mesh_spec
+
+        extra = {self.EXTRA_KEY: state_to_tree(state),
+                 "fleet_tenants": fleet_size(state)}
+        return self._inner.save(
+            step, {}, extra=extra,
+            mesh_spec=fleet_mesh_spec(mesh).to_dict())
+
+    def restore(self, step: Optional[int] = None, tenants=None, **kw):
+        """Returns ``(step, state, extra)``.
+
+        ``tenants``: ``None`` = the full fleet; an ``int`` = ONE
+        tenant's state as a plain single-model ``ProtocolState``; a
+        sequence = a subset-fleet in the given order.  ``kw`` passes
+        through to ``TrainCheckpointer.restore`` (``max_step``,
+        ``target_mesh`` — the elastic path: restoring a fleet written
+        on 8 devices onto a 4-device tenant mesh reshards with the
+        usual accounting, values bit-equal post-gather)."""
+        step_out, extra = self._inner.restore({}, step=step, **kw)
+        tree = extra.get(self.EXTRA_KEY)
+        if tree is None:
+            raise ValueError(
+                f"checkpoint at step {step_out} in {self.directory} "
+                "carries no fleet state (not a fleet checkpoint)")
+        state = state_from_tree(tree)
+        if tenants is None:
+            return step_out, state, extra
+        if isinstance(tenants, (int, np.integer)):
+            return step_out, slice_tenant(state, int(tenants)), extra
+        return step_out, subset_state(state, tenants), extra
+
+
+# ---------------------------------------------------------------------------
+# the fleet payload behind the shared supervision shell
+
+@dataclasses.dataclass
+class FleetConfig:
+    """Knobs for a supervised fleet run (insurance-protocol tenants)."""
+
+    num_tenants: int = 64
+    num_iterations: int = 100
+    batch_size: int = 16
+    seed: int = prng.NUMBER_OF_THE_BEAST
+    res_path: str = "outputs/fleet"
+    # True: TenantRouter tables, one segment per tenant; False: one
+    # shared resident table every tenant slices identically
+    per_tenant_data: bool = True
+    steps_per_call: int = 1
+    print_every: int = 100
+    checkpoint_every: int = 0
+    keep_checkpoints: int = 3
+    quarantine_budget: int = 100  # PER TENANT (TenantRouter)
+    n_devices: Optional[int] = None  # tenant-mesh size; None = one device
+    metrics_port: Optional[int] = None
+    events: bool = True
+    resume: bool = False
+    watchdog: bool = False
+    sanitize: bool = False
+
+
+class FleetTrainer:
+    """The fleet as a SECOND PAYLOAD behind the one supervision shell
+    (train/shell.py) — not a second trainer.  GANTrainer and this class
+    share the shell's install/teardown bracket verbatim; what differs
+    is only the stepped payload: here, one donated vmapped dispatch
+    advances every tenant (train/fleet.make_fleet_step; the tenant-axis
+    shard_map when ``n_devices`` forms a mesh).
+
+    Ops integration: ``gan4j_fleet_*`` scrape series + the ``/healthz``
+    fleet block (telemetry/exporter.observe_fleet), per-tenant data
+    routing with per-tenant quarantine budgets (TenantRouter),
+    checkpoint cadence through FleetCheckpointer (save once, restore
+    any subset), and the shared watchdog/sentinel/event machinery."""
+
+    def __init__(self, config: FleetConfig):
+        from gan_deeplearning4j_tpu.models import mlpgan_insurance as M
+        from gan_deeplearning4j_tpu.telemetry.exporter import (
+            MetricsRegistry,
+        )
+
+        self.c = config
+        os.makedirs(config.res_path, exist_ok=True)
+        cfg = M.InsuranceConfig(seed=config.seed)
+        self.model_cfg = cfg
+        dis = M.build_discriminator(cfg)
+        self.graphs = (dis, M.build_generator(cfg), M.build_gan(cfg),
+                       M.build_classifier(dis, cfg))
+        self.maps = (M.DIS_TO_GAN, M.GAN_TO_GEN, M.DIS_TO_CLASSIFIER)
+        self.registry = MetricsRegistry()
+        self.health = resilient.DataHealth()
+        self.registry.observe_data(self.health.report)
+        self.registry.observe_fleet(self._fleet_report)
+        self.router = TenantRouter(config.res_path, config.num_tenants,
+                                   budget=config.quarantine_budget,
+                                   health=self.health)
+        self.checkpointer = (
+            FleetCheckpointer(os.path.join(config.res_path, "checkpoints"),
+                              keep=config.keep_checkpoints)
+            if config.checkpoint_every else None)
+        self.batch_counter = 0
+        self.state: Optional[ProtocolState] = None
+        self.last_losses = None
+        self.metrics_port: Optional[int] = None
+        self._steps_per_sec = 0.0
+        self._dispatch_ms = 0.0
+
+    def _fleet_report(self) -> Dict:
+        return {"tenants": self.c.num_tenants,
+                "steps_per_sec": self._steps_per_sec,
+                "dispatch_ms": self._dispatch_ms,
+                "ok": self.health.report().get("ok", True)}
+
+    def train(self, features, labels,
+              log: Callable[[str], None] = print) -> Dict:
+        """Train the fleet on ``(rows, F)`` features / ``(rows,)`` or
+        ``(rows, 1)`` labels, supervised by the shared shell."""
+        from gan_deeplearning4j_tpu.train.shell import SupervisionShell
+
+        c = self.c
+        shell = SupervisionShell(
+            self.registry, c.res_path,
+            events_enabled=c.events, events_append=c.resume,
+            watchdog=c.watchdog, sanitize=c.sanitize,
+            step_fn=lambda: self.batch_counter,
+            metrics_port=c.metrics_port, log=log)
+
+        def _payload():
+            self.metrics_port = shell.metrics_port
+            return self._train_impl(features, labels, shell, log)
+
+        return shell.run(_payload)
+
+    # -- payload ------------------------------------------------------------
+
+    def _log_window(self, log, losses) -> None:
+        """Print-cadence progress line (called every ``print_every``
+        steps, after the window's fence — the readback here is the
+        cadence's, not a per-iteration sync)."""
+        d = np.asarray(jax.tree.leaves(losses)[0])
+        log(f"[fleet] step {self.batch_counter}: "
+            f"{self.c.num_tenants} tenants, "
+            f"{self._steps_per_sec:.1f} steps/s "
+            f"(d_loss mean {float(d.mean()):.4f})")
+
+    def _train_impl(self, features, labels, shell, log) -> Dict:
+        c = self.c
+        mesh = None
+        if c.n_devices is not None:
+            from gan_deeplearning4j_tpu.parallel import fleet as pfleet
+
+            mesh = pfleet.tenant_mesh(c.n_devices)
+        if c.per_tenant_data:
+            feats, labs = self.router.route(features, labels)
+        else:
+            feats = jnp.asarray(np.asarray(features, np.float32))
+            labs = np.asarray(labels, np.float32)
+            labs = jnp.asarray(labs[:, None] if labs.ndim == 1 else labs)
+        rows = int(feats.shape[1] if c.per_tenant_data else feats.shape[0])
+        if rows // c.batch_size == 0:
+            raise ValueError(
+                f"{rows} rows per tenant cannot fill one batch of "
+                f"{c.batch_size}")
+        k = max(1, int(c.steps_per_call))
+        step_kw = dict(z_size=self.model_cfg.z_size,
+                       num_features=self.model_cfg.num_features,
+                       per_tenant_data=c.per_tenant_data,
+                       data_on_device=True, steps_per_call=k)
+        if mesh is None:
+            step = make_fleet_step(*self.graphs, *self.maps, **step_kw)
+        else:
+            from gan_deeplearning4j_tpu.parallel import fleet as pfleet
+
+            step = pfleet.make_sharded_fleet_step(
+                *self.graphs, *self.maps, mesh=mesh, **step_kw)
+
+        root = prng.root_key(c.seed)
+        zks = tenant_keys(prng.stream(root, "fleet-z"), c.num_tenants)
+        rks = tenant_keys(prng.stream(root, "fleet-rng"), c.num_tenants)
+        B = c.batch_size
+        ones = jnp.ones((B, 1), jnp.float32)
+        # the reference's label softening, sampled once (gan_trainer)
+        y_real = ones + 0.05 * jax.random.normal(
+            prng.stream(root, "soften-real"), (B, 1), dtype=jnp.float32)
+        y_fake = 0.05 * jax.random.normal(
+            prng.stream(root, "soften-fake"), (B, 1), dtype=jnp.float32)
+
+        start_step = 0
+        state = None
+        if self.checkpointer is not None and c.resume:
+            from gan_deeplearning4j_tpu.checkpoint.checkpointer import (
+                NoVerifiedCheckpointError,
+            )
+
+            try:
+                # no mesh = the plain single-device load; a live tenant
+                # mesh engages the elastic reshard-on-restore path
+                restore_kw = {} if mesh is None else {"target_mesh": mesh}
+                start_step, state, _ = self.checkpointer.restore(
+                    **restore_kw)
+                log(f"[fleet] resumed {fleet_size(state)} tenants at "
+                    f"step {start_step}")
+            except (NoVerifiedCheckpointError, FileNotFoundError):
+                state = None
+        if state is None:
+            state = replicate_state(
+                fused_lib.state_from_graphs(*self.graphs), c.num_tenants)
+        if mesh is not None:
+            from gan_deeplearning4j_tpu.parallel import fleet as pfleet
+
+            state = pfleet.shard_fleet_state(state, mesh)
+            sh = pfleet.fleet_sharding(mesh)
+            zks, rks = jax.device_put(zks, sh), jax.device_put(rks, sh)
+        self.batch_counter = start_step
+
+        telemetry_events.instant(
+            "fleet.start", tenants=c.num_tenants, steps_per_call=k,
+            devices=(1 if mesh is None else int(mesh.devices.size)))
+        losses = None
+        window_t0 = time.perf_counter()
+        window_steps = 0
+        t_start = window_t0
+        while self.batch_counter < c.num_iterations:
+            state, losses = step(state, feats, labs, zks, rks,
+                                 y_real, y_fake, ones)
+            self.batch_counter += k
+            window_steps += k
+            if shell.watchdog is not None:
+                shell.watchdog.beat(self.batch_counter)
+            at_print = (c.print_every
+                        and self.batch_counter % c.print_every < k)
+            at_ckpt = (self.checkpointer is not None
+                       and self.batch_counter % c.checkpoint_every < k)
+            if at_print or at_ckpt:
+                # print/checkpoint cadence, NOT per iteration: the fence
+                # is the one deliberate readback of the window
+                device_fence(losses)
+                dt = time.perf_counter() - window_t0
+                if dt > 0 and window_steps:
+                    self._steps_per_sec = window_steps / dt
+                    self._dispatch_ms = (dt / window_steps) * k * 1e3
+                self.registry.inc("gan4j_steps_total", window_steps)
+                self.registry.set("gan4j_step", self.batch_counter)
+                if at_print:
+                    self._log_window(log, losses)
+                if at_ckpt:
+                    self.checkpointer.save(self.batch_counter, state,
+                                           mesh=mesh)
+                window_t0 = time.perf_counter()
+                window_steps = 0
+        device_fence(state)
+        wall = time.perf_counter() - t_start
+        steps_done = self.batch_counter - start_step
+        if wall > 0 and steps_done:
+            self._steps_per_sec = steps_done / wall
+            self._dispatch_ms = (wall / steps_done) * k * 1e3
+        self.state = state
+        self.last_losses = (None if losses is None
+                            else jax.tree.map(np.asarray, losses))
+        if self.checkpointer is not None:
+            self.checkpointer.save(self.batch_counter, state, mesh=mesh)
+        telemetry_events.instant(
+            "fleet.done", tenants=c.num_tenants, steps=self.batch_counter)
+        return {"tenants": c.num_tenants, "steps": self.batch_counter,
+                "steps_per_sec": self._steps_per_sec,
+                "dispatch_ms": self._dispatch_ms,
+                "tenants_steps_per_sec": (c.num_tenants
+                                          * self._steps_per_sec),
+                "quarantined": self.router.quarantined_total()}

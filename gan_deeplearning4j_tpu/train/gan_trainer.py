@@ -1410,110 +1410,46 @@ class GANTrainer:
         this run's file; with ``metrics_port`` set, the /metrics +
         /healthz exporter serves the scrape registry for the same
         window."""
-        guard = None
-        if self._preempt_signal_nums:
-            from gan_deeplearning4j_tpu.train.preemption import (
-                PreemptionGuard,
-            )
+        from gan_deeplearning4j_tpu.train.shell import SupervisionShell
 
-            guard = PreemptionGuard(self._preempt_signal_nums)
-            try:
-                guard.install()
-            except ValueError:
-                # signal handlers are a main-thread privilege; a trainer
-                # driven from a worker thread trains unguarded, loudly
-                import logging
-
-                logging.getLogger(__name__).warning(
-                    "preempt_signals configured but not on the main "
-                    "thread; preemption guard NOT armed")
-                guard = None
-        self._preempt_guard = guard
         c = self.c
-        # setup failures (EADDRINUSE on the exporter port, an unwritable
-        # events file) must still tear down whatever was already
-        # installed — hence everything after the guard lives in the try
-        recorder = None
-        prev_recorder = None
-        stop_exporter = None
-        try:
-            # a resumed run APPENDS to its own event history (same
-            # discipline as the metrics JSONL): the pre-crash timeline
-            # is exactly what a post-mortem overlay wants to keep
-            recorder = events.EventRecorder(
-                path=(os.path.join(c.res_path, events.EVENTS_NAME)
-                      if c.events else None),
-                enabled=c.events, append=c.resume)
-            self._events = recorder
-            prev_recorder = events.install(recorder)
-            if c.watchdog:
-                # armed AFTER the recorder install so the timeout event
-                # and flight record land in this run's timeline; beats
-                # come from the goodput-phase wrapper (_phase) and the
-                # step/chunk bookkeeping
-                from gan_deeplearning4j_tpu.train.watchdog import (
-                    HeartbeatWatchdog,
-                )
+        # the install/teardown bracket lives in train/shell.py now —
+        # single-model runs and fleets share ONE shell; this trainer is
+        # just one payload behind it (ROADMAP item 3 refactor)
+        shell = SupervisionShell(
+            self.registry, c.res_path,
+            events_enabled=c.events, events_append=c.resume,
+            watchdog=c.watchdog,
+            watchdog_deadline_s=c.watchdog_deadline_s,
+            watchdog_warmup_s=c.watchdog_warmup_s,
+            watchdog_scale=c.watchdog_scale,
+            watchdog_min_deadline_s=c.watchdog_min_deadline_s,
+            watchdog_on_timeout=self._watchdog_emergency,
+            sanitize=c.sanitize,
+            step_fn=lambda: self.batch_counter,
+            metrics_port=c.metrics_port,
+            preempt_signal_nums=self._preempt_signal_nums,
+            log=log)
 
-                self._watchdog = HeartbeatWatchdog(
-                    deadline_s=c.watchdog_deadline_s,
-                    warmup_s=c.watchdog_warmup_s,
-                    scale=c.watchdog_scale,
-                    min_deadline_s=c.watchdog_min_deadline_s,
-                    on_timeout=self._watchdog_emergency,
-                    res_path=c.res_path)
-                self._watchdog.start()
-                self.registry.observe_watchdog(self._watchdog.report)
-            if c.sanitize:
-                # armed AFTER the recorder install (compile.recompile
-                # events must land in this run's timeline); the sentinel
-                # itself is passive until _mark_steady arms it past the
-                # legitimate first-compile window
-                import logging as _logging
-
-                from gan_deeplearning4j_tpu.analysis.sanitizers import (
-                    RecompileSentinel,
-                )
-
-                self._sanitizer = RecompileSentinel(
-                    registry=self.registry,
-                    step_fn=lambda: self.batch_counter,
-                    on_recompile=lambda name: _logging.getLogger(
-                        __name__).warning(
-                        "sanitizer: post-warmup XLA recompile of %r at "
-                        "step %d — the hot path lost its cached program "
-                        "(see docs/STATIC_ANALYSIS.md)",
-                        name, self.batch_counter))
-                self._sanitizer.start()
-            if c.metrics_port is not None:
-                from gan_deeplearning4j_tpu.telemetry import serve_exporter
-
-                stop_exporter = serve_exporter(self.registry,
-                                               c.metrics_port)
-                self.metrics_port = stop_exporter.port
-                log(f"[metrics] serving /metrics + /healthz on "
-                    f"http://127.0.0.1:{stop_exporter.port}")
+        def _payload():
+            # mirror the live handles the loop (and the recovery
+            # wrapper) reads off the trainer
+            self._watchdog = shell.watchdog
+            self._sanitizer = shell.sanitizer
+            self._preempt_guard = shell.guard
+            self.metrics_port = shell.metrics_port
             return self._train_impl(log)
+
+        def _expose_recorder(recorder):
+            # set as soon as the recorder installs, so the flight record
+            # of a run that fails later in SETUP is still dumpable
+            self._events = recorder
+
+        try:
+            return shell.run(_payload, on_recorder=_expose_recorder)
         finally:
-            if self._watchdog is not None:
-                # disarm FIRST: no async raise may land while the
-                # teardown below runs (stop() joins the poll thread)
-                self._watchdog.stop()
-                self._watchdog = None
-            if self._sanitizer is not None:
-                self._sanitizer.stop()
-                self._sanitizer = None
-            if stop_exporter is not None:
-                stop_exporter()
-            if prev_recorder is not None:
-                events.install(prev_recorder)
-            if recorder is not None:
-                # close the file sink only — the ring stays readable, so
-                # a recovery wrapper can still dump the flight record of
-                # a failed run from trainer._events
-                recorder.close()
-            if guard is not None:
-                guard.uninstall()
+            self._watchdog = None
+            self._sanitizer = None
             self._preempt_guard = None
 
     def _train_impl(self, log: Callable[[str], None]) -> Dict[str, float]:

@@ -1,0 +1,194 @@
+"""The supervision/ops shell, split from the stepped payload.
+
+``GANTrainer.train()`` accreted a careful install/teardown bracket
+around its loop — preemption guard, run-scoped event recorder
+(installed process-wide), heartbeat watchdog, recompile sentinel,
+/metrics + /healthz exporter — with ordering that matters (the
+recorder installs FIRST so watchdog timeouts and recompile events land
+in this run's timeline; the watchdog disarms FIRST on the way out so
+no async raise lands mid-teardown).  The fleet work (ROADMAP item 3)
+needs the same shell around a different payload, and duplicating a
+correctness-ordered bracket is how duplicates drift — so the bracket
+lives here once.
+
+:class:`SupervisionShell` is payload-agnostic: ``GANTrainer`` runs
+``_train_impl`` behind it, ``train/fleet_trainer.FleetTrainer`` runs
+the fleet loop behind it.  A payload is any zero-arg callable; the
+shell guarantees full teardown on every exit path, including setup
+failures (EADDRINUSE on the exporter port, an unwritable events file).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from typing import Callable, Optional, Sequence, TypeVar
+
+from gan_deeplearning4j_tpu.telemetry import events
+
+T = TypeVar("T")
+
+_log = logging.getLogger(__name__)
+
+
+class SupervisionShell:
+    """Install order (teardown is the exact reverse, watchdog first):
+
+    1. preemption guard (``preempt_signal_nums``) — main-thread only; a
+       worker-thread trainer runs unguarded, loudly;
+    2. event recorder → ``events.install`` (process-wide current
+       recorder for the run: checkpoint workers, prefetch threads and
+       collectives land their events in this run's file);
+    3. heartbeat watchdog (+ its ``/healthz`` registry feed);
+    4. recompile sentinel;
+    5. /metrics exporter (resolved port on ``self.metrics_port``).
+
+    After :meth:`run` installs everything it calls ``payload()`` and
+    returns its result.  The live handles (``recorder``, ``watchdog``,
+    ``sanitizer``, ``guard``, ``metrics_port``) stay readable on the
+    shell while the payload runs — and ``recorder`` stays readable
+    after exit too, so a recovery wrapper can still dump the flight
+    record of a failed run (only the file sink is closed)."""
+
+    def __init__(
+        self,
+        registry,
+        res_path: str,
+        *,
+        events_enabled: bool = True,
+        events_append: bool = False,
+        watchdog: bool = False,
+        watchdog_deadline_s: Optional[float] = None,
+        watchdog_warmup_s: float = 300.0,
+        watchdog_scale: float = 20.0,
+        watchdog_min_deadline_s: float = 5.0,
+        watchdog_on_timeout: Optional[Callable] = None,
+        sanitize: bool = False,
+        step_fn: Callable[[], int] = lambda: 0,
+        metrics_port: Optional[int] = None,
+        preempt_signal_nums: Sequence[int] = (),
+        log: Callable[[str], None] = print,
+    ):
+        self._registry = registry
+        self._res_path = res_path
+        self._events_enabled = events_enabled
+        self._events_append = events_append
+        self._watchdog_cfg = dict(
+            enabled=watchdog, deadline_s=watchdog_deadline_s,
+            warmup_s=watchdog_warmup_s, scale=watchdog_scale,
+            min_deadline_s=watchdog_min_deadline_s,
+            on_timeout=watchdog_on_timeout)
+        self._sanitize = sanitize
+        self._step_fn = step_fn
+        self._metrics_port_cfg = metrics_port
+        self._preempt_signal_nums = tuple(preempt_signal_nums or ())
+        self._log = log
+        # live handles, populated by run() for the payload's duration
+        self.recorder: Optional[events.EventRecorder] = None
+        self.watchdog = None
+        self.sanitizer = None
+        self.guard = None
+        self.metrics_port: Optional[int] = None
+
+    def run(self, payload: Callable[[], T],
+            on_recorder: Optional[Callable] = None) -> T:
+        """Bracket ``payload()`` with the full install/teardown.
+
+        ``on_recorder(recorder)`` fires right after the recorder is
+        installed (before the watchdog arms) — the hook a trainer uses
+        to expose the recorder for post-mortem flight-record dumps even
+        when a LATER setup stage (exporter port, watchdog) fails."""
+        guard = None
+        if self._preempt_signal_nums:
+            from gan_deeplearning4j_tpu.train.preemption import (
+                PreemptionGuard,
+            )
+
+            guard = PreemptionGuard(self._preempt_signal_nums)
+            try:
+                guard.install()
+            except ValueError:
+                # signal handlers are a main-thread privilege; a run
+                # driven from a worker thread trains unguarded, loudly
+                _log.warning(
+                    "preempt_signals configured but not on the main "
+                    "thread; preemption guard NOT armed")
+                guard = None
+        self.guard = guard
+        prev_recorder = None
+        stop_exporter = None
+        try:
+            # a resumed run APPENDS to its own event history (same
+            # discipline as the metrics JSONL): the pre-crash timeline
+            # is exactly what a post-mortem overlay wants to keep
+            self.recorder = events.EventRecorder(
+                path=(os.path.join(self._res_path, events.EVENTS_NAME)
+                      if self._events_enabled else None),
+                enabled=self._events_enabled, append=self._events_append)
+            prev_recorder = events.install(self.recorder)
+            if on_recorder is not None:
+                on_recorder(self.recorder)
+            wd = self._watchdog_cfg
+            if wd["enabled"]:
+                # armed AFTER the recorder install so the timeout event
+                # and flight record land in this run's timeline
+                from gan_deeplearning4j_tpu.train.watchdog import (
+                    HeartbeatWatchdog,
+                )
+
+                self.watchdog = HeartbeatWatchdog(
+                    deadline_s=wd["deadline_s"],
+                    warmup_s=wd["warmup_s"],
+                    scale=wd["scale"],
+                    min_deadline_s=wd["min_deadline_s"],
+                    on_timeout=wd["on_timeout"],
+                    res_path=self._res_path)
+                self.watchdog.start()
+                self._registry.observe_watchdog(self.watchdog.report)
+            if self._sanitize:
+                # armed AFTER the recorder install (compile.recompile
+                # events must land in this run's timeline); passive
+                # until the payload marks steady state
+                from gan_deeplearning4j_tpu.analysis.sanitizers import (
+                    RecompileSentinel,
+                )
+
+                step_fn = self._step_fn
+                self.sanitizer = RecompileSentinel(
+                    registry=self._registry,
+                    step_fn=step_fn,
+                    on_recompile=lambda name: _log.warning(
+                        "sanitizer: post-warmup XLA recompile of %r at "
+                        "step %d — the hot path lost its cached program "
+                        "(see docs/STATIC_ANALYSIS.md)",
+                        name, step_fn()))
+                self.sanitizer.start()
+            if self._metrics_port_cfg is not None:
+                from gan_deeplearning4j_tpu.telemetry import serve_exporter
+
+                stop_exporter = serve_exporter(self._registry,
+                                               self._metrics_port_cfg)
+                self.metrics_port = stop_exporter.port
+                self._log(f"[metrics] serving /metrics + /healthz on "
+                          f"http://127.0.0.1:{stop_exporter.port}")
+            return payload()
+        finally:
+            if self.watchdog is not None:
+                # disarm FIRST: no async raise may land while the
+                # teardown below runs (stop() joins the poll thread)
+                self.watchdog.stop()
+                self.watchdog = None
+            if self.sanitizer is not None:
+                self.sanitizer.stop()
+                self.sanitizer = None
+            if stop_exporter is not None:
+                stop_exporter()
+            if prev_recorder is not None:
+                events.install(prev_recorder)
+            if self.recorder is not None:
+                # close the file sink only — the ring stays readable for
+                # post-mortem flight-record dumps
+                self.recorder.close()
+            if guard is not None:
+                guard.uninstall()
+            self.guard = None

@@ -85,3 +85,66 @@ def test_lastgood_roundtrip_through_file(tmp_path):
         _capture(10.1, 0.1), str(path))["ok"]
     assert not bench_gate.check_against_lastgood(
         _capture(25.0, 0.1), str(path))["ok"]
+
+
+def test_fleet_series_is_gated():
+    # the fleet bench's capture block ("fleet" in SERIES): a 10x
+    # regression on the fleet dispatch must go red like any other series
+    old = _capture(10.0, 0.1, fleet=(4.0, 0.05))
+    new = _capture(10.0, 0.1, fleet=(40.0, 0.05))
+    verdict = bench_gate.check_capture(new, old)
+    assert not verdict["ok"]
+    assert [c["series"] for c in verdict["checks"]
+            if c["regressed"]] == ["fleet"]
+    assert ("fleet", 4.0, 0.05) in bench_gate.series_stats(old)
+
+
+def test_per_series_lastgood_record_wins_over_legacy_shape(tmp_path):
+    # the per-series-keyed record form: a fleet capture gates against
+    # ITS series even though no whole-capture lastgood ever carried one
+    path = tmp_path / "BENCH_LASTGOOD.json"
+    path.write_text(json.dumps(
+        {"series": {"fleet": {"median_ms": 4.0, "iqr_ms": 0.05}}}))
+    fleet_cap = {"fleet": {"multistep_step_ms": 4.1,
+                           "spread": {"median_ms": 4.1, "iqr_ms": 0.05}}}
+    verdict = bench_gate.check_against_lastgood(fleet_cap, str(path))
+    assert verdict["ok"] and verdict["compared"] == 1
+    slow = {"fleet": {"multistep_step_ms": 40.0,
+                      "spread": {"median_ms": 40.0, "iqr_ms": 0.05}}}
+    assert not bench_gate.check_against_lastgood(slow, str(path))["ok"]
+
+
+def test_update_lastgood_merges_per_series(tmp_path):
+    path = tmp_path / "BENCH_LASTGOOD.json"
+    # a legacy whole-capture record converts on first merge...
+    path.write_text(json.dumps(_capture(10.0, 0.1)))
+    rec = bench_gate.update_lastgood(
+        str(path), {"fleet": {"multistep_step_ms": 4.0,
+                              "spread": {"median_ms": 4.0,
+                                         "iqr_ms": 0.05}}})
+    # ...and the fleet merge did NOT clobber the multistep baseline
+    assert rec["series"]["multistep"] == {"median_ms": 10.0,
+                                          "iqr_ms": 0.1}
+    assert rec["series"]["fleet"] == {"median_ms": 4.0, "iqr_ms": 0.05}
+    # both invocations now gate individually against the one file
+    assert bench_gate.check_against_lastgood(
+        _capture(10.1, 0.1), str(path))["ok"]
+    assert not bench_gate.check_against_lastgood(
+        {"fleet": {"multistep_step_ms": 40.0,
+                   "spread": {"median_ms": 40.0, "iqr_ms": 0.0}}},
+        str(path))["ok"]
+
+
+def test_no_overlap_is_vacuous_pass_but_empty_capture_is_not():
+    # a fleet-only capture against a legacy main-only lastgood shares
+    # zero series: the documented "new series must not fail
+    # retroactively" case — vacuous pass with a reason, promotable via
+    # update_lastgood.  A capture with no series at all stays not-ok.
+    main_only = _capture(10.0, 0.1)
+    fleet_only = {"fleet": {"multistep_step_ms": 4.0,
+                            "spread": {"median_ms": 4.0,
+                                       "iqr_ms": 0.05}}}
+    verdict = bench_gate.check_capture(fleet_only, main_only)
+    assert verdict["ok"] and verdict["compared"] == 0
+    assert "vacuous" in verdict["reason"]
+    assert not bench_gate.check_capture({}, main_only)["ok"]

@@ -207,6 +207,80 @@ def test_reshard_roundtrip_matrix(tmp_path, save_n):
                               np.asarray(ref_extra["soften_real"]))
 
 
+# -- fleet checkpoints across world sizes (train/fleet.py) --------------------
+
+
+def test_fleet_checkpoint_reshards_8_to_4(tmp_path, cpu_devices):
+    """Save a stacked tenant fleet on the 8-device tenant mesh, restore
+    onto 4: per-tenant state bit-equal post-gather, reshard accounting
+    present, and the restored fleet steps on the smaller mesh
+    (ISSUE 13 satellite — the elastic matrix case for fleets)."""
+    from gan_deeplearning4j_tpu.models import mlpgan_insurance as M
+    from gan_deeplearning4j_tpu.parallel import fleet as pfleet
+    from gan_deeplearning4j_tpu.runtime import prng
+    from gan_deeplearning4j_tpu.train import fleet as fleet_lib
+    from gan_deeplearning4j_tpu.train import fused_step as fused_lib
+
+    num_tenants = 16
+    cfg = M.InsuranceConfig()
+    dis = M.build_discriminator(cfg)
+    graphs = (dis, M.build_generator(cfg), M.build_gan(cfg),
+              M.build_classifier(dis, cfg))
+    maps = (M.DIS_TO_GAN, M.GAN_TO_GEN, M.DIS_TO_CLASSIFIER)
+    root = prng.root_key()
+    zks = fleet_lib.tenant_keys(prng.stream(root, "z"), num_tenants)
+    rks = fleet_lib.tenant_keys(prng.stream(root, "rng"), num_tenants)
+    feats = jax.random.uniform(prng.stream(root, "data"), (8, 12))
+    labels = np.ones((8, 1), np.float32)
+    ones = np.ones((8, 1), np.float32)
+
+    mesh8 = pfleet.tenant_mesh(8)
+    step8 = pfleet.make_sharded_fleet_step(
+        *graphs, *maps, mesh=mesh8, z_size=cfg.z_size,
+        num_features=cfg.num_features, donate=False)
+    state = pfleet.shard_fleet_state(
+        fleet_lib.replicate_state(fused_lib.state_from_graphs(*graphs),
+                                  num_tenants), mesh8)
+    sh8 = pfleet.fleet_sharding(mesh8)
+    state, _ = step8(state, feats, labels,
+                     jax.device_put(zks, sh8), jax.device_put(rks, sh8),
+                     ones, 0.0 * ones, ones)
+
+    ck = fleet_lib.FleetCheckpointer(str(tmp_path / "fleet_ckpts"))
+    ck.save(1, state, mesh=mesh8)
+    spec = ck._inner.mesh_spec(1)
+    assert spec["axes"] == {"tenant": 8} and spec["device_count"] == 8
+
+    mesh4 = pfleet.tenant_mesh(4)
+    step_r, restored, extra = ck.restore(target_mesh=mesh4)
+    assert step_r == 1
+    info = extra["__reshard__"]
+    assert info["from"]["device_count"] == 8
+    assert info["to"]["device_count"] == 4
+    # bit-equal per tenant against the live 8-device state
+    _assert_tree_bitequal(restored, state, "fleet 8->4")
+    for t in (0, 7, 15):
+        _assert_tree_bitequal(
+            fleet_lib.slice_tenant(restored, t),
+            fleet_lib.slice_tenant(state, t), f"tenant {t} 8->4")
+
+    # the restored fleet trains on the 4-device mesh, matching the
+    # 8-device continuation bitwise (the world size is layout, not math)
+    restored4 = pfleet.shard_fleet_state(restored, mesh4)
+    step4 = pfleet.make_sharded_fleet_step(
+        *graphs, *maps, mesh=mesh4, z_size=cfg.z_size,
+        num_features=cfg.num_features, donate=False)
+    sh4 = pfleet.fleet_sharding(mesh4)
+    next4, l4 = step4(restored4, feats, labels,
+                      jax.device_put(zks, sh4), jax.device_put(rks, sh4),
+                      ones, 0.0 * ones, ones)
+    next8, l8 = step8(state, feats, labels,
+                      jax.device_put(zks, sh8), jax.device_put(rks, sh8),
+                      ones, 0.0 * ones, ones)
+    _assert_tree_bitequal(l4, l8, "losses 4-mesh vs 8-mesh")
+    _assert_tree_bitequal(next4, next8, "stepped state 4-mesh vs 8-mesh")
+
+
 # -- the mismatch bugfix ------------------------------------------------------
 
 

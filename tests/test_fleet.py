@@ -1,0 +1,343 @@
+"""Fleet execution layer (train/fleet.py + parallel/fleet.py).
+
+The load-bearing property (ISSUE 13 acceptance): a fleet tenant's
+training is the SAME math as a single-tenant run with the same folded
+seed — vmap/shard_map change the schedule, not the numbers.  Everything
+else (checkpoint slicing, elastic restore, routing, ops integration)
+builds on that bit-equality.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from gan_deeplearning4j_tpu.models import mlpgan_insurance as M
+from gan_deeplearning4j_tpu.runtime import prng
+from gan_deeplearning4j_tpu.train import fleet as fleet_lib
+from gan_deeplearning4j_tpu.train import fused_step as fused_lib
+
+BATCH = 16
+
+
+def _graphs(seed: int = prng.NUMBER_OF_THE_BEAST):
+    cfg = M.InsuranceConfig(seed=seed)
+    dis = M.build_discriminator(cfg)
+    return cfg, (dis, M.build_generator(cfg), M.build_gan(cfg),
+                 M.build_classifier(dis, cfg))
+
+
+def _maps():
+    return (M.DIS_TO_GAN, M.GAN_TO_GEN, M.DIS_TO_CLASSIFIER)
+
+
+def _data(batch: int = BATCH, seed: int = 7):
+    k = jax.random.key(seed)
+    feats = jax.random.uniform(jax.random.fold_in(k, 0), (batch, 12),
+                               dtype=jnp.float32)
+    labels = (jax.random.uniform(jax.random.fold_in(k, 1), (batch, 1))
+              < 0.5).astype(jnp.float32)
+    return feats, labels
+
+
+def _invariants(batch: int = BATCH):
+    ones = jnp.ones((batch, 1), jnp.float32)
+    return ones, jnp.zeros((batch, 1), jnp.float32), ones  # y_real, y_fake, ones
+
+
+def _assert_tree_bitequal(a, b, label: str):
+    la, lb = jax.tree.leaves(a), jax.tree.leaves(b)
+    assert len(la) == len(lb), label
+    for i, (x, y) in enumerate(zip(la, lb)):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y),
+                                      err_msg=f"{label} leaf {i}")
+
+
+def test_fleet_matches_single_tenant_controls():
+    """Per-tenant d/g/clf-loss timelines and final params of a fleet are
+    bitwise-equal (f32) to independently-run single-tenant controls with
+    the same folded seeds (ISSUE 13 acceptance)."""
+    num_tenants, steps = 8, 5
+    sampled = (0, 3, 5, 7)  # >= 4 sampled tenants
+    cfg, graphs = _graphs()
+    feats, labels = _data()
+    y_real, y_fake, ones = _invariants()
+    root = prng.root_key()
+    z_base = prng.stream(root, "fleet-z")
+    rng_base = prng.stream(root, "fleet-rng")
+    template = fused_lib.state_from_graphs(*graphs)
+
+    # fleet: one vmapped dispatch per step over all tenants
+    fstep = fleet_lib.make_fleet_step(
+        *graphs, *_maps(), z_size=cfg.z_size,
+        num_features=cfg.num_features, donate=False)
+    fstate = fleet_lib.replicate_state(template, num_tenants)
+    zks = fleet_lib.tenant_keys(z_base, num_tenants)
+    rks = fleet_lib.tenant_keys(rng_base, num_tenants)
+    fleet_losses = []
+    for _ in range(steps):
+        fstate, losses = fstep(fstate, feats, labels, zks, rks,
+                               y_real, y_fake, ones)
+        fleet_losses.append(jax.tree.map(np.asarray, losses))
+
+    # controls: the pre-fleet single-model program, one tenant at a time
+    sstep = fused_lib.make_protocol_step(
+        *graphs, *_maps(), z_size=cfg.z_size,
+        num_features=cfg.num_features, donate=False)
+    for t in sampled:
+        state = template
+        zk = jax.random.fold_in(z_base, t)
+        rk = jax.random.fold_in(rng_base, t)
+        for s in range(steps):
+            state, (d, g, c) = sstep(state, feats, labels, zk, rk,
+                                     y_real, y_fake, ones)
+            fd, fg, fc = fleet_losses[s]
+            np.testing.assert_array_equal(np.asarray(d), fd[t],
+                                          err_msg=f"d_loss t{t} s{s}")
+            np.testing.assert_array_equal(np.asarray(g), fg[t],
+                                          err_msg=f"g_loss t{t} s{s}")
+            np.testing.assert_array_equal(np.asarray(c), fc[t],
+                                          err_msg=f"clf_loss t{t} s{s}")
+        _assert_tree_bitequal(state, fleet_lib.slice_tenant(fstate, t),
+                              f"final state t{t}")
+
+    # and the tenants really are independent runs, not N copies of one
+    d0 = np.asarray(fleet_losses[-1][0])
+    assert len(np.unique(d0)) > 1, "tenant timelines should decorrelate"
+
+
+def test_sharded_fleet_matches_vmap(cpu_devices):
+    """shard_map over the 8-device tenant mesh == plain vmap, bitwise —
+    the tenant axis is embarrassingly parallel (zero collectives)."""
+    from gan_deeplearning4j_tpu.parallel import fleet as pfleet
+
+    num_tenants, steps = 16, 3
+    cfg, graphs = _graphs()
+    feats, labels = _data()
+    y_real, y_fake, ones = _invariants()
+    root = prng.root_key()
+    zks = fleet_lib.tenant_keys(prng.stream(root, "fleet-z"), num_tenants)
+    rks = fleet_lib.tenant_keys(prng.stream(root, "fleet-rng"), num_tenants)
+    template = fused_lib.state_from_graphs(*graphs)
+    kw = dict(z_size=cfg.z_size, num_features=cfg.num_features)
+
+    vstep = fleet_lib.make_fleet_step(*graphs, *_maps(), donate=False, **kw)
+    vstate = fleet_lib.replicate_state(template, num_tenants)
+
+    mesh = pfleet.tenant_mesh(8)
+    sstep = pfleet.make_sharded_fleet_step(*graphs, *_maps(), mesh=mesh,
+                                           donate=False, **kw)
+    sstate = pfleet.shard_fleet_state(
+        fleet_lib.replicate_state(template, num_tenants), mesh)
+    sh = pfleet.fleet_sharding(mesh)
+    szks, srks = jax.device_put(zks, sh), jax.device_put(rks, sh)
+
+    for s in range(steps):
+        vstate, vl = vstep(vstate, feats, labels, zks, rks,
+                           y_real, y_fake, ones)
+        sstate, sl = sstep(sstate, feats, labels, szks, srks,
+                           y_real, y_fake, ones)
+        _assert_tree_bitequal(vl, sl, f"losses step {s}")
+    _assert_tree_bitequal(vstate, sstate, "final fleet state")
+
+
+def test_sharded_fleet_requires_divisible_tenants(cpu_devices):
+    from gan_deeplearning4j_tpu.parallel import fleet as pfleet
+
+    _, graphs = _graphs()
+    mesh = pfleet.tenant_mesh(8)
+    state = fleet_lib.replicate_state(fused_lib.state_from_graphs(*graphs),
+                                      12)
+    with pytest.raises(ValueError, match="does not divide"):
+        pfleet.shard_fleet_state(state, mesh)
+
+
+def _diverged_fleet(num_tenants: int, steps: int = 2):
+    """A fleet whose tenants have actually decorrelated (stepped with
+    per-tenant streams) — slicing tests on a replicated state would
+    pass vacuously."""
+    cfg, graphs = _graphs()
+    feats, labels = _data()
+    y_real, y_fake, ones = _invariants()
+    root = prng.root_key()
+    step = fleet_lib.make_fleet_step(
+        *graphs, *_maps(), z_size=cfg.z_size,
+        num_features=cfg.num_features, donate=False)
+    state = fleet_lib.replicate_state(
+        fused_lib.state_from_graphs(*graphs), num_tenants)
+    zks = fleet_lib.tenant_keys(prng.stream(root, "fleet-z"), num_tenants)
+    rks = fleet_lib.tenant_keys(prng.stream(root, "fleet-rng"), num_tenants)
+    for _ in range(steps):
+        state, _losses = step(state, feats, labels, zks, rks,
+                              y_real, y_fake, ones)
+    return state
+
+
+def test_fleet_checkpoint_slicing(tmp_path):
+    """Save a 64-tenant fleet ONCE; restore tenants {0, 17, 63}
+    individually and as a subset-fleet — bit-equal against the stacked
+    slices (ISSUE 13 satellite)."""
+    state = _diverged_fleet(64)
+    ck = fleet_lib.FleetCheckpointer(str(tmp_path / "ckpts"), keep=2)
+    ck.save(2, state)
+
+    # full-fleet round trip
+    step, restored, extra = ck.restore()
+    assert step == 2 and extra["fleet_tenants"] == 64
+    _assert_tree_bitequal(restored, state, "full fleet")
+
+    # single tenants: plain single-model ProtocolState each
+    for t in (0, 17, 63):
+        _, one, _ = ck.restore(tenants=t)
+        assert one.it.ndim == 0
+        _assert_tree_bitequal(one, fleet_lib.slice_tenant(state, t),
+                              f"tenant {t}")
+
+    # subset-fleet, order preserved
+    _, sub, _ = ck.restore(tenants=(0, 17, 63))
+    assert fleet_lib.fleet_size(sub) == 3
+    _assert_tree_bitequal(sub, fleet_lib.subset_state(state, (0, 17, 63)),
+                          "subset fleet")
+
+
+def test_fleet_checkpoint_state_roundtrip_tree():
+    state = _diverged_fleet(4, steps=1)
+    tree = fleet_lib.state_to_tree(state)
+    back = fleet_lib.state_from_tree(tree)
+    _assert_tree_bitequal(back, state, "tree round trip")
+    # structure, not just leaves: empty layer dicts (Dropout) must survive
+    # the round trip or the restored state is unsteppable.
+    assert jax.tree.structure(back) == jax.tree.structure(state)
+    # and through the on-disk flat-key form, which drops empty dicts
+    # unless the tree form carries markers for them.
+    from gan_deeplearning4j_tpu.graph import serialization as ser
+    flat = ser._flatten(tree)
+    rebuilt = fleet_lib.state_from_tree(ser._unflatten(flat))
+    assert jax.tree.structure(rebuilt) == jax.tree.structure(state)
+    _assert_tree_bitequal(rebuilt, state, "flat round trip")
+
+
+def test_tenant_router_routes_and_quarantines(tmp_path):
+    from gan_deeplearning4j_tpu.data.resilient import DataQuarantineError
+
+    rows, nt = 40, 4
+    feats = np.arange(rows * 12, dtype=np.float32).reshape(rows, 12)
+    labels = np.ones((rows,), np.float32)
+    feats[5, 3] = np.nan   # tenant 1
+    feats[9, 0] = np.inf   # tenant 1 again
+    router = fleet_lib.TenantRouter(str(tmp_path), nt, budget=2)
+    f, l = router.route(feats, labels, source="t.csv")
+    # tenant 1 lost 2 of its 10 rows; everyone truncates to 8
+    assert f.shape == (nt, 8, 12) and l.shape == (nt, 8, 1)
+    assert router.quarantined_total() == 2
+    # surviving rows routed by r % nt, in order, bit-equal
+    np.testing.assert_array_equal(np.asarray(f[0, 0]), feats[0])
+    np.testing.assert_array_equal(np.asarray(f[1, 0]), feats[1])
+    # the quarantine file is per tenant
+    assert (tmp_path / "quarantine_tenant1.jsonl").exists()
+    assert not (tmp_path / "quarantine_tenant0.jsonl").exists()
+
+    # budgets are PER TENANT: poisoning tenant 2 past ITS budget raises,
+    # after tenant 1's earlier charges — budgets don't pool fleet-wide
+    feats2 = feats.copy()
+    feats2[5, 3] = 0.0
+    feats2[9, 0] = 0.0
+    for r in (2, 6, 10):  # all tenant 2 (r % 4 == 2)
+        feats2[r, 0] = np.nan
+    with pytest.raises(DataQuarantineError):
+        router.route(feats2, labels, source="t2.csv")
+
+
+def test_fleet_exporter_series_and_health():
+    from gan_deeplearning4j_tpu.telemetry.exporter import MetricsRegistry
+
+    reg = MetricsRegistry()
+    # pre-created at 0 before any fleet feed registers
+    body = reg.render()
+    for series in ("gan4j_fleet_tenants", "gan4j_fleet_steps_per_sec",
+                   "gan4j_fleet_dispatch_ms"):
+        assert f"{series} 0" in body, series
+    doc = reg.health()
+    assert doc["fleet"] == {"tenants": 0, "steps_per_sec": 0.0,
+                            "dispatch_ms": 0.0, "ok": True}
+
+    reg.observe_fleet(lambda: {"tenants": 1024, "steps_per_sec": 50.0,
+                               "dispatch_ms": 20.0, "ok": True})
+    body = reg.render()
+    assert "gan4j_fleet_tenants 1024" in body
+    assert "gan4j_fleet_steps_per_sec 50" in body
+    doc = reg.health()
+    assert doc["fleet"]["tenants"] == 1024 and doc["fleet"]["ok"] is True
+
+
+def test_fleet_trainer_smoke(tmp_path):
+    """FleetTrainer = the fleet payload behind the shared supervision
+    shell: runs, serves the fleet scrape series, checkpoints, and the
+    checkpoint slices restore bit-equal to the live state."""
+    import json
+    import urllib.request
+
+    c = fleet_lib.FleetConfig(
+        num_tenants=8, num_iterations=4, batch_size=4, res_path=str(tmp_path),
+        per_tenant_data=True, print_every=2, checkpoint_every=2,
+        quarantine_budget=4, metrics_port=0)
+    trainer = fleet_lib.FleetTrainer(c)
+    rows = 8 * 8  # 8 rows per tenant
+    feats = np.linspace(0.0, 1.0, rows * 12,
+                        dtype=np.float32).reshape(rows, 12)
+    labels = (np.arange(rows) % 2).astype(np.float32)
+
+    scrapes = {}
+
+    def _log(msg):
+        # scrape WHILE the exporter is serving (the shell tears it down)
+        if trainer.metrics_port and "m" not in scrapes:
+            base = f"http://127.0.0.1:{trainer.metrics_port}"
+            with urllib.request.urlopen(base + "/metrics") as r:
+                scrapes["m"] = r.read().decode()
+            with urllib.request.urlopen(base + "/healthz") as r:
+                scrapes["h"] = json.loads(r.read().decode())
+
+    out = trainer.train(feats, labels, log=_log)
+    assert out["steps"] == 4 and out["tenants"] == 8
+    assert out["tenants_steps_per_sec"] > 0
+    assert "gan4j_fleet_tenants 8" in scrapes["m"]
+    assert scrapes["h"]["fleet"]["tenants"] == 8
+    # events landed through the shell's run-scoped recorder
+    assert (tmp_path / "events.jsonl").exists()
+
+    # the cadence checkpoint slices bit-equal against the live state
+    _, one, _ = trainer.checkpointer.restore(tenants=3)
+    _assert_tree_bitequal(one, fleet_lib.slice_tenant(trainer.state, 3),
+                          "restored tenant 3")
+
+
+@pytest.mark.slow
+def test_1024_tenant_fleet_single_dispatch(recompile_sentinel):
+    """A >= 1024-tenant fleet advances in ONE fused dispatch per step
+    with zero post-warmup recompiles (ISSUE 13 acceptance)."""
+    num_tenants = 1024
+    cfg, graphs = _graphs()
+    feats, labels = _data(batch=8)
+    y_real, y_fake, ones = _invariants(batch=8)
+    root = prng.root_key()
+    step = fleet_lib.make_fleet_step(
+        *graphs, *_maps(), z_size=cfg.z_size,
+        num_features=cfg.num_features, donate=True)
+    state = fleet_lib.replicate_state(
+        fused_lib.state_from_graphs(*graphs), num_tenants)
+    zks = fleet_lib.tenant_keys(prng.stream(root, "fleet-z"), num_tenants)
+    rks = fleet_lib.tenant_keys(prng.stream(root, "fleet-rng"), num_tenants)
+    state, losses = step(state, feats, labels, zks, rks,
+                         y_real, y_fake, ones)  # warmup = the one compile
+    jax.block_until_ready(losses)
+    recompile_sentinel.arm()
+    for _ in range(3):
+        state, losses = step(state, feats, labels, zks, rks,
+                             y_real, y_fake, ones)
+    jax.block_until_ready(losses)
+    assert losses[0].shape == (num_tenants,)
+    assert np.isfinite(np.asarray(losses[0])).all()
