@@ -375,33 +375,37 @@ def _build_pair_multi(donate: bool = False) -> List[Built]:
 
 
 def _build_serving_infer(donate: bool = False) -> List[Built]:
-    import functools
-
     import jax
     import jax.numpy as jnp
     import numpy as np
-    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+    from jax.sharding import Mesh
 
     from gan_deeplearning4j_tpu.models import dcgan_mnist as M
     from gan_deeplearning4j_tpu.parallel.inference import (
         DEFAULT_SERVING_BUCKETS,
+        ParallelInference,
     )
 
     del donate  # inference dispatch has no state to donate
     gen = M.build_generator()
     mesh = Mesh(np.asarray(jax.devices()[:2]), ("data",))
-    rep, sh = NamedSharding(mesh, P()), NamedSharding(mesh, P("data"))
-    # ONE jit object, lowered once per declared bucket: the bucket set
-    # IS the complete set of program shapes serving may dispatch
-    jitted = jax.jit(functools.partial(gen._forward_outputs, train=False))
+    # the ACTUAL serving dispatch: ParallelInference's own jit object
+    # and shardings, the same path serve/engine.py drives.  The engine
+    # pads every batch host-side to a declared bucket before dispatch,
+    # so this bucket set IS the complete set of program shapes serving
+    # may run — if the engine could reach any other shape, the contract
+    # would miss it and the zero-recompile claim would be unproven.
+    pi = ParallelInference(gen, mesh=mesh,
+                           buckets=DEFAULT_SERVING_BUCKETS)
     params = jax.tree.map(
-        lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=rep),
+        lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype,
+                                       sharding=pi._rep),
         abstractify(gen.params))
     built = []
-    for b in DEFAULT_SERVING_BUCKETS:
+    for b in pi.buckets:
         z = {gen.input_names[0]: jax.ShapeDtypeStruct(
-            (b, 2), jnp.float32, sharding=sh)}
-        built.append(Built(f"b{b}", jitted, (params, z), 0, b,
+            (b, 2), jnp.float32, sharding=pi._batch_sh)}
+        built.append(Built(f"b{b}", pi._jit, (params, z), 0, b,
                            mesh_shape={"data": 2}))
     return built
 
@@ -524,8 +528,11 @@ register_entry(EntryPoint(
 
 register_entry(EntryPoint(
     name="serving_infer",
-    summary="sharded inference dispatch (parallel/inference.py) at "
-            "every declared serving bucket shape",
+    summary="the serving plane's compiled dispatch: ParallelInference "
+            "(parallel/inference.py) lowered at every declared bucket "
+            "shape — the complete program set serve/engine.py can "
+            "reach, since the engine pads every batch host-side to a "
+            "bucket before dispatching",
     build=_build_serving_infer,
     needs_devices=2,
     bucket_spec=_serving_bucket_spec,

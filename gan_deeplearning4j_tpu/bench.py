@@ -830,6 +830,122 @@ def fleet_bench(sweep=FLEET_SWEEP, flagship: int = FLEET_FLAGSHIP,
     return out
 
 
+def serve_bench(start_rps: float = 50.0, stage_s: float = 2.0,
+                repeats: int = 5, load_frac: float = 0.8,
+                growth: float = 1.6, max_stages: int = 12,
+                seed: int = 0) -> dict:
+    """The serving bench of record (serve/): ramp an open-loop Poisson
+    load to the engine's saturation throughput, then measure p50/p95/
+    p99 request latency over ``repeats`` stages at ``load_frac`` of
+    saturation — the SLO operating point RESULTS.md reports.  The p50
+    spread block is the regression-gated "serve" series; the whole run
+    executes under an armed RecompileSentinel, and the capture carries
+    the post-warmup compile count (the zero-recompile claim, measured
+    not asserted).
+    """
+    import statistics
+
+    import jax
+    import numpy as np
+
+    from gan_deeplearning4j_tpu import bench_gate
+    from gan_deeplearning4j_tpu.analysis import RecompileSentinel
+    from gan_deeplearning4j_tpu.models import dcgan_mnist as M
+    from gan_deeplearning4j_tpu.parallel import data_mesh
+    from gan_deeplearning4j_tpu.parallel.inference import (
+        DEFAULT_SERVING_BUCKETS,
+        ParallelInference,
+    )
+    from gan_deeplearning4j_tpu.serve import (
+        ServeEngine,
+        measure_saturation,
+        run_load,
+        z_inputs,
+    )
+    from gan_deeplearning4j_tpu.serve.loadgen import DEFAULT_SIZE_MIX
+
+    buckets = DEFAULT_SERVING_BUCKETS
+    # widest mesh the bucket set shards evenly across on this host
+    n_dev = max(n for n in (1, 2, 4, 8)
+                if n <= len(jax.devices())
+                and all(b % n == 0 for b in buckets))
+    gen = M.build_generator()
+    pi = ParallelInference(gen, mesh=data_mesh(n_dev), buckets=buckets)
+    make_inputs = z_inputs(2, seed=seed)
+    sentinel = RecompileSentinel()
+    out: dict = {
+        "metric": "gan4j_serve_saturation_rps",
+        "unit": "req/s",
+        "platform": jax.devices()[0].platform,
+        "devices": n_dev,
+        "buckets": list(buckets),
+        "size_mix": [list(p) for p in DEFAULT_SIZE_MIX],
+        "methodology_version": METHODOLOGY_VERSION,
+    }
+    with sentinel:
+        with ServeEngine(infer=pi, watchdog_deadline_s=60.0) as eng:
+            eng.warmup(np.zeros((1, 2), np.float32))
+            sentinel.arm()
+            sat = measure_saturation(
+                eng, make_inputs, start_rps=start_rps, growth=growth,
+                stage_s=stage_s, max_stages=max_stages, seed=seed)
+            out["saturation"] = sat
+            out["value"] = out["saturation_rps"] = sat["saturation_rps"]
+            if sat["saturation_rps"] <= 0:
+                out.update({"skipped": True,
+                            "reason": "no load stage was sustained — "
+                                      "see saturation.failed_stage"})
+                return out
+            # the SLO operating point: repeats stages at load_frac of
+            # saturation, p50 per stage -> the gated spread block
+            rate = load_frac * sat["saturation_rps"]
+            stages = []
+            for i in range(max(1, repeats)):
+                stages.append(run_load(
+                    eng, rate, duration_s=stage_s,
+                    make_inputs=make_inputs, seed=seed + 100 + i))
+            out["slo_load_frac"] = load_frac
+            out["slo_rate_rps"] = round(rate, 2)
+            out["slo_stages"] = stages
+            rep = eng.report()
+            out["engine"] = {k: rep[k] for k in
+                             ("requests_total", "batches_total",
+                              "shed_total", "batch_fill",
+                              "rate_rows_per_s", "timeouts_total")}
+    p50s = [s["p50_ms"] for s in stages if s["p50_ms"] is not None]
+    p99s = [s["p99_ms"] for s in stages if s["p99_ms"] is not None]
+    if p50s:
+        med = statistics.median(p50s)
+        if len(p50s) >= 2:
+            q1, _, q3 = statistics.quantiles(
+                p50s, n=4, method="inclusive")
+            iqr = q3 - q1
+        else:
+            iqr = 0.0
+        # the gate-compatible series block ("serve" in
+        # bench_gate.SERIES): request p50 at the SLO operating point
+        out["serve"] = {
+            "multistep_step_ms": round(med, 4),
+            "spread": {
+                "median_ms": round(med, 4),
+                "iqr_ms": round(iqr, 4),
+                "min_ms": round(min(p50s), 4),
+                "max_ms": round(max(p50s), 4),
+                "repeats": len(p50s),
+                "window_calls": [min(s["completed"] for s in stages),
+                                 max(s["completed"] for s in stages)],
+                "window_steps_per_call": 1,
+            },
+        }
+        out["p99_ms"] = round(statistics.median(p99s), 4) if p99s \
+            else None
+    out["post_warmup_recompiles"] = len(sentinel.recompiles)
+    out["regression_gate"] = bench_gate.check_against_lastgood(
+        out, os.path.join(os.path.dirname(BASELINE_PATH),
+                          "BENCH_LASTGOOD.json"))
+    return out
+
+
 def checkpoint_dryrun() -> dict:
     """Async-vs-sync checkpoint A/B on the real four-graph model set:
     the training-thread BLOCKING time of an ``AsyncCheckpointer.save``
@@ -1267,6 +1383,55 @@ def dryrun(telemetry: bool = True,
                         d_losses.shape == (fleet_n,)
                         and all(math.isfinite(float(v))
                                 for v in d_losses))
+                # the serving plane (serve/): a real engine — dispatch
+                # thread, admission queue, host-side bucket padding —
+                # serving a short load burst under an armed recompile
+                # sentinel, its report fed to the exporter so the
+                # scrape below must carry the gan4j_serve_* series,
+                # the "serve" bench series, and a healthy /healthz
+                # serving block
+                with events_mod.span("bench.serve"):
+                    import numpy as _np
+
+                    from gan_deeplearning4j_tpu.models import (
+                        dcgan_mnist as _dcgan,
+                    )
+                    from gan_deeplearning4j_tpu.parallel import (
+                        data_mesh,
+                    )
+                    from gan_deeplearning4j_tpu.parallel.inference \
+                        import ParallelInference
+                    from gan_deeplearning4j_tpu.serve import (
+                        ServeEngine,
+                        run_load,
+                        z_inputs,
+                    )
+                    s_pi = ParallelInference(
+                        _dcgan.build_generator(), mesh=data_mesh(1),
+                        buckets=(8, 32, 64))
+                    ssentinel = RecompileSentinel(registry=registry)
+                    with ssentinel:
+                        with ServeEngine(
+                                infer=s_pi,
+                                watchdog_deadline_s=60.0) as s_eng:
+                            s_eng.warmup(
+                                _np.zeros((1, 2), _np.float32))
+                            ssentinel.arm()
+                            s_stats = run_load(
+                                s_eng, rate_rps=100.0, n_requests=20,
+                                make_inputs=z_inputs(2, seed=1),
+                                seed=2)
+                            serve_rec = s_eng.report()
+                    serve_rec["post_warmup_recompiles"] = len(
+                        ssentinel.recompiles)
+                    registry.observe_serve(lambda: serve_rec)
+                    s_p50 = serve_rec["p50_ms"] or 0.0
+                    publish_bench_series(
+                        registry,
+                        {"serve": {
+                            "multistep_step_ms": round(s_p50, 4),
+                            "spread": {"median_ms": round(s_p50, 4),
+                                       "iqr_ms": 0.0}}})
                 # one record through the registry feed, then a REAL
                 # scrape over the socket: the CI assertion that the
                 # exporter answers with the step/goodput/NaN series
@@ -1348,6 +1513,29 @@ def dryrun(telemetry: bool = True,
                     and isinstance(fleet_block, dict)
                     and fleet_block.get("tenants") == fleet_n
                     and fleet_block.get("ok") is True)
+                # serving surface: the short load run completed with
+                # zero errors and ZERO post-warmup recompiles (the
+                # engine pads host-side, so the warmed buckets are the
+                # whole program set), the gan4j_serve_* series live in
+                # the scrape (fed: requests_total must be the real
+                # count), the "serve" bench series present, and the
+                # /healthz serving block healthy
+                serve_blk = health.get("serve")
+                serve_ok = (
+                    serve_rec["requests_total"] >= 1
+                    and s_stats["errors"] == 0
+                    and s_stats["undrained"] == 0
+                    and serve_rec["post_warmup_recompiles"] == 0
+                    and len(ssentinel.compiles) >= 1
+                    and "gan4j_serve_requests_total " in m_body
+                    and "gan4j_serve_shed_total " in m_body
+                    and "gan4j_serve_queue_depth " in m_body
+                    and "gan4j_serve_batch_fill " in m_body
+                    and "gan4j_serve_p99_ms " in m_body
+                    and 'gan4j_bench_step_ms{series="serve"}' in m_body
+                    and isinstance(serve_blk, dict)
+                    and serve_blk.get("requests_total", 0) >= 1
+                    and serve_blk.get("ok") is True)
                 recorder.flush()
                 try:
                     events_ok = len(events_mod.read_events(
@@ -1365,7 +1553,8 @@ def dryrun(telemetry: bool = True,
                            and watchdog_ok and data_ok
                            and lint["ok"] and sanitizer["ok"]
                            and prove["ok"] and race_ok
-                           and bench_stable_ok and fleet_ok),
+                           and bench_stable_ok and fleet_ok
+                           and serve_ok),
                 "platform": device.platform,
                 "telemetry": telemetry,
                 "checkpoint": ckpt,
@@ -1383,6 +1572,8 @@ def dryrun(telemetry: bool = True,
                 "race": race,
                 "fleet_ok": bool(fleet_ok),
                 "fleet": fleet_rec,
+                "serve_ok": bool(serve_ok),
+                "serve": serve_rec,
                 "bench_stable_ok": bool(bench_stable_ok),
                 "bench_spread": spread,
                 "watchdog_beat_us": round(beat_us, 3)}
@@ -1422,6 +1613,25 @@ def main(argv=None) -> None:
                    help="serve /metrics + /healthz during the e2e "
                         "trainer run (and the --dryrun smoke's "
                         "self-scrape); 0 = ephemeral")
+    p.add_argument("--serve", action="store_true",
+                   help="serving bench of record (serve/): ramp an "
+                        "open-loop Poisson load to the continuous-"
+                        "batching engine's saturation throughput and "
+                        "print one JSON line — saturation req/s plus "
+                        "p50/p99 at --serve-load-frac of it as the v7 "
+                        "spread block (the regression-gated 'serve' "
+                        "series), measured under an armed recompile "
+                        "sentinel")
+    p.add_argument("--serve-stage-s", type=float, default=2.0,
+                   metavar="S",
+                   help="seconds per load stage (ramp and SLO repeats)")
+    p.add_argument("--serve-repeats", type=int, default=5,
+                   help="SLO-point repeat stages for the spread block")
+    p.add_argument("--serve-load-frac", type=float, default=0.8,
+                   help="fraction of measured saturation the SLO "
+                        "latency numbers are reported at")
+    p.add_argument("--serve-start-rps", type=float, default=50.0,
+                   help="first rung of the geometric saturation ramp")
     p.add_argument("--fleet", action="store_true",
                    help="multi-tenant fleet bench of record "
                         "(train/fleet.py): sweep tenant counts as "
@@ -1534,6 +1744,13 @@ def main(argv=None) -> None:
     if args.dryrun:
         print(json.dumps(dryrun(telemetry=args.telemetry,
                                 metrics_port=args.metrics_port)))
+        return
+    if args.serve:
+        print(json.dumps(serve_bench(
+            start_rps=args.serve_start_rps,
+            stage_s=args.serve_stage_s,
+            repeats=args.serve_repeats,
+            load_frac=args.serve_load_frac)))
         return
     if args.fleet_stage is not None:
         print(json.dumps(fleet_stage_time(

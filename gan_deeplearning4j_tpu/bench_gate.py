@@ -35,6 +35,7 @@ SERIES: Tuple[Tuple[str, Tuple[str, ...]], ...] = (
     ("celeba", ("celeba",)),
     ("celeba_fast", ("celeba_fast",)),
     ("fleet", ("fleet",)),
+    ("serve", ("serve",)),
 )
 
 # Tolerance floor: 5% — the day-to-day jitter of a healthy capture on

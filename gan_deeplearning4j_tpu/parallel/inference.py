@@ -144,7 +144,8 @@ class ParallelInference:
         shape: one array per output layer).  With ``buckets`` declared,
         every dispatch shape is a bucket: requests pad up to the
         smallest bucket that holds them, oversized requests chunk by
-        the largest — the compiled-program set stays closed."""
+        the largest with the tail padded to ITS covering bucket — the
+        compiled-program set stays closed."""
         if not xs:
             raise ValueError("output() needs at least one input array")
         b = xs[0].shape[0]
@@ -159,8 +160,16 @@ class ParallelInference:
             chunk = self.max_batch
         chunks = []
         for lo in range(0, b, chunk):
-            chunks.append(self._dispatch(
-                [x[lo:lo + chunk] for x in xs], pad_to=chunk))
+            part = [x[lo:lo + chunk] for x in xs]
+            pad_to = chunk
+            if self.buckets is not None:
+                # the tail chunk pads to its COVERING bucket, not the
+                # chunking unit: a 70-row request dispatches as 64 + 8,
+                # not 64 + 64 — fewer dead rows, and every oversized
+                # request still lands inside the declared bucket set
+                # (the closed-program-set contract holds for tails too)
+                pad_to = self.bucket_for(part[0].shape[0]) or chunk
+            chunks.append(self._dispatch(part, pad_to=pad_to))
         return [jnp.concatenate(parts) for parts in zip(*chunks)]
 
     __call__ = output

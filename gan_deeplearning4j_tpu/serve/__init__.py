@@ -1,0 +1,34 @@
+"""Serving plane — the continuous-batching generation service.
+
+``parallel/inference.py`` proves ONE bucketed sharded dispatch; this
+package turns it into a service: an admission-controlled request queue
+(``admission.py``) drained by a dedicated dispatch thread
+(``engine.py``) that coalesces concurrent requests into the next
+bucketed dispatch, and an open-loop Poisson load harness
+(``loadgen.py``) that measures p50/p95/p99 and saturation throughput
+(``bench --serve``, docs/SERVING.md).
+"""
+
+from gan_deeplearning4j_tpu.serve.admission import (
+    AdmissionQueue,
+    Request,
+    ShedError,
+)
+from gan_deeplearning4j_tpu.serve.engine import ServeEngine
+from gan_deeplearning4j_tpu.serve.loadgen import (
+    measure_saturation,
+    percentiles,
+    run_load,
+    z_inputs,
+)
+
+__all__ = [
+    "AdmissionQueue",
+    "Request",
+    "ServeEngine",
+    "ShedError",
+    "measure_saturation",
+    "percentiles",
+    "run_load",
+    "z_inputs",
+]

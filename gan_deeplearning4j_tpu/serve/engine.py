@@ -1,0 +1,433 @@
+"""The continuous-batching dispatch engine.
+
+One dedicated thread (``gan4j-serve-dispatch``) drains the admission
+queue each cycle, coalesces whatever arrived into ONE batch, pads it to
+the smallest covering serving bucket (``parallel/inference.py`` —
+the engine never invents a dispatch shape, so steady-state serving is
+recompile-free by construction), and overlaps host work with device
+work at pipeline depth 1: while batch N runs on the device, the loop
+is already draining and coalescing batch N+1; N's outputs are fenced
+and fanned back to their requests only after N+1 is dispatched.
+
+Supervision: a ``HeartbeatWatchdog`` (train/watchdog.py) watches the
+dispatch thread through the existing beat/region API.  A hang anywhere
+in the cycle (a wedged dispatch, a dead device) becomes a
+``WatchdogTimeout`` raised ON the dispatch thread; the loop fails every
+in-flight and queued request with the typed error (never a silent
+hang), re-arms a fresh watchdog, and keeps serving.
+
+Weight hot-swap: ``refresh()`` flags the loop to re-snapshot the
+graph's params (``ParallelInference.refresh_params``) between batches —
+same shapes, same compiled programs, zero recompiles; ``hotswap_from``
+first loads the newest VERIFIED checkpoint into the graph
+(checkpoint/checkpointer.py) and then flags the refresh.
+
+Ops surface: ``report()`` feeds ``MetricsRegistry.observe_serve`` (the
+``gan4j_serve_*`` series and the ``/healthz`` serving block), every
+dispatch is a ``serve.dispatch`` span and every shed a ``serve.shed``
+instant (telemetry/events.py).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from contextlib import nullcontext
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from gan_deeplearning4j_tpu.parallel.inference import (
+    DEFAULT_SERVING_BUCKETS,
+    ParallelInference,
+)
+from gan_deeplearning4j_tpu.serve.admission import AdmissionQueue, Request
+from gan_deeplearning4j_tpu.serve.loadgen import percentiles
+from gan_deeplearning4j_tpu.telemetry import events
+from gan_deeplearning4j_tpu.train.watchdog import (
+    HeartbeatWatchdog,
+    WatchdogTimeout,
+)
+from gan_deeplearning4j_tpu.utils.device import (
+    device_fence,
+    overlap_device_get,
+)
+
+# fault-injection seam (testing/chaos.py hang_at_dispatch): called at
+# the top of every batch dispatch so a chaos test can simulate a
+# dispatch that never completes — the serving-plane hang class the
+# watchdog converts into typed request failures.  None in production.
+_chaos_dispatch_hook: Optional[Callable[[], None]] = None
+
+# one in-flight batch: (requests, per-segment output arrays still on
+# device, dispatch-start time, real rows, padded device rows)
+_Batch = Tuple[List[Request], List[List], float, int, int]
+
+
+class ServeEngine:
+    """Continuous-batching generation service over one
+    ``ParallelInference`` dispatch.
+
+    ``graph``: the generator ``ComputationGraph`` to serve.
+    ``buckets``: the closed dispatch-shape set (defaults to
+    ``DEFAULT_SERVING_BUCKETS`` — the gan4j-prove ``serving_infer``
+    contract shapes).  ``admission``: the bounded front door (default:
+    an ``AdmissionQueue()``).  ``watchdog_deadline_s``: explicit hang
+    deadline for the dispatch loop (None = the watchdog's auto-scaled
+    deadline); ``supervise=False`` disables the watchdog entirely
+    (single-threaded tests)."""
+
+    def __init__(self, graph=None, mesh=None,
+                 buckets: Sequence[int] = DEFAULT_SERVING_BUCKETS,
+                 admission: Optional[AdmissionQueue] = None,
+                 supervise: bool = True,
+                 watchdog_deadline_s: Optional[float] = None,
+                 idle_poll_s: float = 0.01,
+                 latency_window: int = 4096,
+                 infer: Optional[ParallelInference] = None):
+        if infer is not None:
+            if infer.buckets is None:
+                raise ValueError(
+                    "the engine needs a bucketed ParallelInference — "
+                    "an unbucketed one has no closed dispatch-shape "
+                    "set to serve from")
+            self._infer = infer
+            graph = infer.graph
+        else:
+            if graph is None:
+                raise ValueError("ServeEngine needs a graph or a "
+                                 "prebuilt ParallelInference")
+            self._infer = ParallelInference(graph, mesh=mesh,
+                                            buckets=buckets)
+        self.admission = admission if admission is not None \
+            else AdmissionQueue()
+        self._supervise = bool(supervise)
+        self._wd_deadline_s = watchdog_deadline_s
+        self._idle_poll_s = float(idle_poll_s)
+        self._max_rows = self._infer.buckets[-1]
+        self._n_inputs = len(graph.input_names)
+        self._lock = threading.Lock()
+        # the swap lock serializes host-side param mutation (a
+        # checkpoint restore on a caller thread) against the dispatch
+        # thread's re-snapshot; it nests with NOTHING (engine lock,
+        # admission lock and swap lock are pairwise disjoint —
+        # docs/STATIC_ANALYSIS.md, rule lock-order-cycle)
+        self._swap_lock = threading.Lock()
+        self._stop = threading.Event()
+        self._refresh = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._watchdog: Optional[HeartbeatWatchdog] = None
+        self._open: List[Request] = []
+        self._latencies: deque = deque(maxlen=int(latency_window))
+        self._fills: deque = deque(maxlen=256)
+        self._requests_total = 0
+        self._batches_total = 0
+        self._timeouts_total = 0
+
+    # -- producer API (any thread) ---------------------------------------------
+
+    def submit(self, *xs) -> Request:
+        """Enqueue one generation request; returns the ``Request`` (its
+        ``result()`` blocks for the outputs).  Raises ``ShedError``
+        when admission control rejects it, ``RuntimeError`` when the
+        engine is not running (a dead engine must never accept work it
+        can't finish)."""
+        if not self.running:
+            raise RuntimeError("serve engine is not running")
+        req = Request(xs)
+        if len(req.xs) != self._n_inputs:
+            raise ValueError(
+                f"request carries {len(req.xs)} input(s); the served "
+                f"graph takes {self._n_inputs}")
+        return self.admission.submit(req)
+
+    def generate(self, *xs, timeout: Optional[float] = 60.0) -> List:
+        """Synchronous convenience: submit + bounded wait."""
+        return self.submit(*xs).result(timeout=timeout)
+
+    def refresh(self) -> None:
+        """Flag a zero-recompile weight re-snapshot: the dispatch loop
+        runs ``refresh_params`` between batches (same shapes, same
+        compiled programs)."""
+        self._refresh.set()
+
+    def hotswap_from(self, directory: str, name: str = "gen") -> int:
+        """Load the newest VERIFIED checkpoint under ``directory`` into
+        the served graph, then flag the refresh.  Returns the restored
+        step.  Raises ``NoVerifiedCheckpointError`` when nothing
+        verifiable exists (the engine keeps serving the old weights)."""
+        from gan_deeplearning4j_tpu.checkpoint.checkpointer import (
+            TrainCheckpointer,
+        )
+
+        ckpt = TrainCheckpointer(directory)
+        with self._swap_lock:
+            step, _ = ckpt.restore({name: self._infer.graph})
+        self.refresh()
+        events.instant("serve.hotswap", step=step, directory=directory)
+        return step
+
+    # -- lifecycle -------------------------------------------------------------
+
+    def warmup(self, *example_xs) -> None:
+        """Compile every bucket shape before taking traffic: one
+        dispatch per declared bucket with zero-filled inputs shaped
+        like ``example_xs`` (any row count; only trailing dims and
+        dtypes matter).  After this, steady-state serving pays zero
+        compiles (the RecompileSentinel-pinned contract)."""
+        if len(example_xs) != self._n_inputs:
+            raise ValueError(
+                f"warmup needs {self._n_inputs} example input(s)")
+        examples = [np.asarray(x) for x in example_xs]
+        outs = None
+        for b in self._infer.buckets:
+            xs = [np.zeros((b,) + tuple(x.shape[1:]), dtype=x.dtype)
+                  for x in examples]
+            outs = self._infer.output(*xs)
+        if outs is not None:
+            device_fence(outs)
+
+    @property
+    def running(self) -> bool:
+        with self._lock:
+            return self._thread is not None
+
+    def start(self) -> "ServeEngine":
+        with self._lock:
+            if self._thread is not None:
+                raise RuntimeError("serve engine already started")
+            self._stop.clear()
+            thread = threading.Thread(
+                target=self._loop, name="gan4j-serve-dispatch",
+                daemon=True)
+            self._thread = thread
+        thread.start()
+        self._arm_watchdog(thread)
+        return self
+
+    def stop(self) -> None:
+        """Stop the dispatch loop (bounded join) and fail anything
+        still queued with a typed error — a stopped engine answers
+        every outstanding request, it never strands one."""
+        self._stop.set()
+        self.admission.wake.set()  # break the idle park
+        with self._lock:
+            thread, self._thread = self._thread, None
+            wd, self._watchdog = self._watchdog, None
+        if wd is not None:
+            wd.stop()
+        if thread is not None:
+            thread.join(timeout=30.0)
+        err = RuntimeError("serve engine stopped")
+        self.admission.fail_all(err)
+        with self._lock:
+            leftovers, self._open = self._open, []
+        for r in leftovers:
+            if not r.done.is_set():
+                r.error = err
+                r.done.set()
+
+    def __enter__(self) -> "ServeEngine":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    # -- the dispatch loop (gan4j-serve-dispatch thread) -----------------------
+
+    def _loop(self) -> None:
+        pending: Optional[_Batch] = None
+        cycle = 0
+        while not self._stop.is_set():
+            try:
+                wd = self._wd()
+                if wd is not None:
+                    wd.beat()
+                if self._refresh.is_set():
+                    self._refresh.clear()
+                    with self._swap_lock:
+                        self._infer.refresh_params()
+                reqs = self.admission.drain(self._max_rows)
+                inflight: Optional[_Batch] = None
+                if reqs:
+                    with self._lock:
+                        self._open.extend(reqs)
+                    inflight = self._dispatch(reqs, wd)
+                # pipeline depth 1: batch N+1 is already on the device
+                # before batch N's outputs are fenced and fanned out
+                if pending is not None:
+                    self._complete(pending, wd)
+                pending = inflight
+                if reqs or pending is not None:
+                    cycle += 1
+                    if wd is not None:
+                        wd.beat(step=cycle)
+                else:
+                    self.admission.wake.wait(self._idle_poll_s)
+            except WatchdogTimeout:
+                pending = None
+                try:
+                    self._on_timeout()
+                except WatchdogTimeout:  # gan4j-lint: disable=swallowed-exception — a watchdog re-raise landing mid-recovery IS the timeout already being handled
+                    pass
+        # orderly exit: the batch already on the device completes;
+        # stop() fails whatever is still queued
+        if pending is not None:
+            self._complete(pending, None)
+
+    def _wd(self) -> Optional[HeartbeatWatchdog]:
+        with self._lock:
+            return self._watchdog
+
+    def _plan(self, rows: int) -> List[int]:
+        """The bucket segments a ``rows``-row batch dispatches as —
+        the same policy as ``ParallelInference.output`` (one covering
+        bucket, or largest-bucket chunks with the tail covered),
+        computed HOST-side so the engine can pad in numpy and every
+        device program is exactly a declared bucket forward (zero
+        eager-op compiles, no matter what row counts traffic
+        coalesces into)."""
+        bucket = self._infer.bucket_for(rows)
+        if bucket is not None:
+            return [bucket]
+        chunk = self._infer.buckets[-1]
+        segments: List[int] = []
+        for lo in range(0, rows, chunk):
+            n = min(chunk, rows - lo)
+            segments.append(self._infer.bucket_for(n) or chunk)
+        return segments
+
+    def _dispatch(self, reqs: List[Request],
+                  wd: Optional[HeartbeatWatchdog]) -> _Batch:
+        hook = _chaos_dispatch_hook
+        rows = sum(r.rows for r in reqs)
+        segments = self._plan(rows)
+        padded = sum(segments)
+        region = wd.region("dispatch") if wd is not None \
+            else nullcontext()
+        with region, events.span("serve.dispatch",
+                                 requests=len(reqs), rows=rows,
+                                 padded=padded,
+                                 segments=len(segments)):
+            if hook is not None:
+                hook()
+            t0 = time.perf_counter()
+            # coalesce + pad in HOST numpy: the device only ever sees
+            # exact bucket shapes, so the compiled-program set is the
+            # warmed bucket forwards and nothing else
+            xs = []
+            for i in range(self._n_inputs):
+                parts = [r.xs[i] for r in reqs]
+                if padded > rows:
+                    parts.append(np.zeros(
+                        (padded - rows,) + parts[0].shape[1:],
+                        dtype=parts[0].dtype))
+                xs.append(parts[0] if len(parts) == 1
+                          else np.concatenate(parts))
+            outs: List[List] = []
+            lo = 0
+            for seg in segments:
+                outs.append(self._infer.output(
+                    *[x[lo:lo + seg] for x in xs]))
+                lo += seg
+        return (reqs, outs, t0, rows, padded)
+
+    def _complete(self, batch: _Batch,
+                  wd: Optional[HeartbeatWatchdog]) -> None:
+        reqs, seg_outs, t0, rows, padded = batch
+        region = wd.region("readback") if wd is not None \
+            else nullcontext()
+        with region:
+            # the fence IS the materialization: one overlapped readback
+            # of every segment's outputs; responses are then sliced in
+            # numpy (no per-request device ops, no compile shapes)
+            host_segs = overlap_device_get(seg_outs)
+        full = (host_segs[0] if len(host_segs) == 1
+                else [np.concatenate([seg[i] for seg in host_segs])
+                      for i in range(len(host_segs[0]))])
+        now = time.perf_counter()
+        lo = 0
+        for r in reqs:
+            r.outputs = [o[lo:lo + r.rows] for o in full]
+            lo += r.rows
+            r.t_done = now
+            r.done.set()
+        self.admission.note_dispatch(rows, now - t0)
+        with self._lock:
+            self._requests_total += len(reqs)
+            self._batches_total += 1
+            self._fills.append(rows / padded)
+            for r in reqs:
+                self._latencies.append((now - r.t_submit) * 1000.0)
+            del self._open[:len(reqs)]
+
+    # -- hang recovery ---------------------------------------------------------
+
+    def _on_timeout(self) -> None:
+        """The dispatch loop hung past the watchdog deadline: fail
+        every in-flight and queued request with the typed error (the
+        never-hang contract — a request always gets an answer), re-arm
+        a fresh watchdog, keep serving."""
+        self._disarm_watchdog()
+        err = WatchdogTimeout(
+            "serving dispatch hung past the watchdog deadline; "
+            "in-flight and queued requests failed (see the "
+            "serve.timeout event and gan4j_serve_* series)")
+        with self._lock:
+            open_reqs, self._open = self._open, []
+            self._timeouts_total += 1
+            thread = self._thread
+        now = time.perf_counter()
+        for r in open_reqs:
+            r.error = err
+            r.t_done = now
+            r.done.set()
+        failed_queued = self.admission.fail_all(err)
+        events.instant("serve.timeout", failed_inflight=len(open_reqs),
+                       failed_queued=len(failed_queued))
+        self._arm_watchdog(thread)
+
+    def _disarm_watchdog(self) -> None:
+        with self._lock:
+            wd, self._watchdog = self._watchdog, None
+        if wd is not None:
+            wd.stop()  # no further async raises after this returns
+
+    def _arm_watchdog(self,
+                      thread: Optional[threading.Thread]) -> None:
+        if not self._supervise or thread is None:
+            return
+        wd = HeartbeatWatchdog(deadline_s=self._wd_deadline_s)
+        wd.start(thread=thread)
+        with self._lock:
+            self._watchdog = wd
+
+    # -- ops surface -----------------------------------------------------------
+
+    def report(self) -> Dict:
+        """Scrape feed for ``MetricsRegistry.observe_serve`` (the
+        ``gan4j_serve_*`` series and the ``/healthz`` serving block)."""
+        adm = self.admission.report()
+        with self._lock:
+            lats = list(self._latencies)
+            fills = list(self._fills)
+            requests_total = self._requests_total
+            batches_total = self._batches_total
+            timeouts_total = self._timeouts_total
+            wd = self._watchdog
+        p50, p95, p99 = percentiles(lats, (50.0, 95.0, 99.0))
+        stalled = bool(wd is not None and wd.stalled)
+        return {
+            "requests_total": requests_total,
+            "batches_total": batches_total,
+            "shed_total": adm["shed_total"],
+            "admitted_total": adm["admitted_total"],
+            "queue_depth": adm["depth"],
+            "batch_fill": (sum(fills) / len(fills)) if fills else 0.0,
+            "p50_ms": p50, "p95_ms": p95, "p99_ms": p99,
+            "timeouts_total": timeouts_total,
+            "rate_rows_per_s": adm["rate_rows_per_s"],
+            "stalled": stalled,
+            "ok": not stalled,
+        }

@@ -56,6 +56,18 @@ Metric names (all ``gan4j_``-prefixed):
                                         deadlock witnessed at runtime;
                                         docs/STATIC_ANALYSIS.md,
                                         rule lock-order-cycle)
+  gan4j_serve_requests_total   counter  generation requests served to
+                                        completion (serve/engine.py)
+  gan4j_serve_shed_total       counter  requests rejected by admission
+                                        control (serve/admission.py) —
+                                        any sustained increase means
+                                        the service is at capacity
+  gan4j_serve_queue_depth      gauge    admission queue depth now
+  gan4j_serve_batch_fill       gauge    real rows / padded bucket rows
+                                        of recent dispatches (low fill
+                                        = paying for dead rows)
+  gan4j_serve_p99_ms           gauge    p99 latency of the engine's
+                                        recent-request window
 """
 
 from __future__ import annotations
@@ -114,6 +126,11 @@ class MetricsRegistry:
             # lock-contention trend an alert watches long before one
             ("gan4j_lock_inversions_total", ()): 0.0,
             ("gan4j_lock_wait_seconds_total", ()): 0.0,
+            # serving plane (serve/engine.py): the request/shed
+            # counters exist at 0 from the first scrape — the shed
+            # alert rule must see the series before the first overload
+            ("gan4j_serve_requests_total", ()): 0.0,
+            ("gan4j_serve_shed_total", ()): 0.0,
         }
         self._gauges: Dict[Tuple[str, tuple], float] = {
             # age since the last data-plane incident; 0 until one
@@ -131,6 +148,11 @@ class MetricsRegistry:
             ("gan4j_fleet_tenants", ()): 0.0,
             ("gan4j_fleet_steps_per_sec", ()): 0.0,
             ("gan4j_fleet_dispatch_ms", ()): 0.0,
+            # serving-plane gauges (serve/engine.py): 0 = "no engine
+            # running"; the feed (observe_serve) raises them
+            ("gan4j_serve_queue_depth", ()): 0.0,
+            ("gan4j_serve_batch_fill", ()): 0.0,
+            ("gan4j_serve_p99_ms", ()): 0.0,
         }
         self._callbacks: List[Callable[["MetricsRegistry"], None]] = []
         self.run_id: Optional[str] = None
@@ -150,6 +172,9 @@ class MetricsRegistry:
         # fleet feed (train/fleet.FleetTrainer._fleet_report): drives
         # the gan4j_fleet_* series and the /healthz "fleet" block
         self._fleet_fn: Optional[Callable[[], Optional[Dict]]] = None
+        # serving feed (serve/engine.ServeEngine.report): drives the
+        # gan4j_serve_* series and the /healthz "serve" block
+        self._serve_fn: Optional[Callable[[], Optional[Dict]]] = None
 
     @staticmethod
     def _key(name: str, labels: Optional[Dict]) -> Tuple[str, tuple]:
@@ -327,6 +352,34 @@ class MetricsRegistry:
 
         self.add_callback(cb)
 
+    def observe_serve(self, report_fn: Callable[[], Optional[Dict]]) -> None:
+        """Register the serving-plane feed: ``report_fn`` returns a
+        ``ServeEngine.report()`` dict (request/shed totals, queue
+        depth, batch fill, latency percentiles).  Scrapes mirror it
+        into the ``gan4j_serve_*`` series and ``/healthz`` carries it
+        as the ``"serve"`` block — the bench-of-record headline
+        (saturation req/s at a p99 SLO, RESULTS.md) is measured from
+        exactly these series."""
+        with self._lock:
+            self._serve_fn = report_fn
+
+        def cb(reg: "MetricsRegistry") -> None:
+            rep = report_fn()
+            if not rep:
+                return
+            reg.set_counter("gan4j_serve_requests_total",
+                            float(rep.get("requests_total", 0)))
+            reg.set_counter("gan4j_serve_shed_total",
+                            float(rep.get("shed_total", 0)))
+            for key, series in (("queue_depth", "gan4j_serve_queue_depth"),
+                                ("batch_fill", "gan4j_serve_batch_fill"),
+                                ("p99_ms", "gan4j_serve_p99_ms")):
+                v = rep.get(key)
+                if isinstance(v, (int, float)):
+                    reg.set(series, float(v))
+
+        self.add_callback(cb)
+
     # -- render ---------------------------------------------------------------
 
     def render(self) -> str:
@@ -412,6 +465,27 @@ class MetricsRegistry:
                          "ok": bool(rep.get("ok", True))}
             except Exception:  # gan4j-lint: disable=swallowed-exception — a broken feed must not take down the probe
                 pass
+        # the serving block: live feed when an engine is running, else
+        # the pre-created series — ALWAYS present, like the rest.
+        # ok:false when the dispatch loop is stalled past its watchdog
+        # deadline (the serving-plane version of the 503 contract).
+        serve = None
+        sfn = self._serve_fn
+        if sfn is not None:
+            try:
+                rep = sfn() or {}
+                p99 = rep.get("p99_ms")
+                serve = {"requests_total": int(
+                             rep.get("requests_total", 0)),
+                         "shed_total": int(rep.get("shed_total", 0)),
+                         "queue_depth": int(rep.get("queue_depth", 0)),
+                         "batch_fill": float(
+                             rep.get("batch_fill", 0.0) or 0.0),
+                         "p99_ms": (float(p99) if isinstance(
+                             p99, (int, float)) else None),
+                         "ok": bool(rep.get("ok", True))}
+            except Exception:  # gan4j-lint: disable=swallowed-exception — a broken feed must not take down the probe
+                pass
         with self._lock:
             if data is None:
                 data = {"retries_total": int(self._counters.get(
@@ -433,12 +507,22 @@ class MetricsRegistry:
                          "dispatch_ms": float(self._gauges.get(
                              ("gan4j_fleet_dispatch_ms", ()), 0.0)),
                          "ok": True}
+            if serve is None:
+                serve = {"requests_total": int(self._counters.get(
+                             ("gan4j_serve_requests_total", ()), 0.0)),
+                         "shed_total": int(self._counters.get(
+                             ("gan4j_serve_shed_total", ()), 0.0)),
+                         "queue_depth": int(self._gauges.get(
+                             ("gan4j_serve_queue_depth", ()), 0.0)),
+                         "batch_fill": float(self._gauges.get(
+                             ("gan4j_serve_batch_fill", ()), 0.0)),
+                         "p99_ms": None, "ok": True}
             age = (None if self._last_record_wall is None
                    else round(time.time() - self._last_record_wall, 3))
             doc = {"status": "stalled" if stalled else "ok",
                    "stalled": stalled, "run_id": self.run_id,
                    "last_record_age_s": age, "data": data,
-                   "mesh": mesh, "fleet": fleet}
+                   "mesh": mesh, "fleet": fleet, "serve": serve}
             if beat_age is not None:
                 doc["last_beat_age_s"] = round(float(beat_age), 3)
             return doc

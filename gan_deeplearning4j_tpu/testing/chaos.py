@@ -28,6 +28,14 @@ the seeded, deterministic injector that does all four, driven by
 * **hang-the-readback** — ``ChaosInjector.hang_at_readback`` hooks
   ``utils/device.device_fence`` so a chosen fence call blocks
   indefinitely (a wedged device/tunnel), the OTHER silent hang class.
+* **hang-the-serving-dispatch** — ``ChaosInjector.hang_at_dispatch``
+  hooks the serving engine's dispatch seam (``serve/engine.py``) so a
+  chosen batch dispatch blocks indefinitely; pins that the
+  watchdog-supervised dispatch loop fails in-flight requests with a
+  typed error and keeps serving (never hangs).  ``SlowRequestSource``
+  is the traffic-shaped counterpart: it injects oversized request
+  sizes into a load harness's size stream at seeded indices, forcing
+  the chunked dispatch path under live traffic.
 * **NaN-into-grads** — ``NanSource`` poisons the features of a seeded
   batch (the classic bad-record path to non-finite grads), driving the
   telemetry NaN alarm — and the rollback-with-perturbation heal path —
@@ -217,6 +225,17 @@ class ChaosInjector:
         dumps diagnostics and checkpoints from its OWN thread."""
         return _ReadbackHang(at)
 
+    def hang_at_dispatch(self, at: int = 0) -> "_DispatchHang":
+        """Context manager: the ``at``-th serving batch dispatch inside
+        the block hangs indefinitely (``serve/engine.py``'s chaos seam
+        — a wedged device under the serving plane).  One-shot: after
+        the watchdog fails the in-flight requests and re-arms, later
+        dispatches proceed normally, so the "degrade, recover, keep
+        serving" contract is what the test observes.  Sleeps in small
+        increments for the same bytecode-boundary interruptibility as
+        ``hang_at_readback``."""
+        return _DispatchHang(at)
+
 
 class _ReadbackHang:
     def __init__(self, at: int):
@@ -248,6 +267,75 @@ class _ReadbackHang:
     def __exit__(self, *exc) -> None:
         self._device_mod._chaos_readback_hook = self._prev
         self._release.set()  # free any thread still parked in the hook
+
+
+class _DispatchHang:
+    """Seeded serving-dispatch hang (``ChaosInjector.hang_at_dispatch``):
+    parks the ``at``-th batch dispatch of ``serve/engine.py`` until the
+    watchdog unwinds it (or ``__exit__`` releases the parked thread on
+    cleanup).  Structured exactly like ``_ReadbackHang`` — observable
+    ``hung`` event, one-shot ``fired`` flag, released on exit."""
+
+    def __init__(self, at: int):
+        self.at = at
+        self.calls = 0
+        self.fired = False                  # one-shot, like _ReadbackHang
+        self.hung = threading.Event()       # observable: dispatch stuck
+        self._release = threading.Event()   # set on __exit__ (cleanup)
+        self._prev = None
+
+    def _hook(self) -> None:
+        if self.fired:
+            return
+        if self.calls == self.at:
+            self.fired = True
+            self.hung.set()
+            while not self._release.is_set():
+                time.sleep(0.05)
+        self.calls += 1
+
+    def __enter__(self) -> "_DispatchHang":
+        from gan_deeplearning4j_tpu.serve import engine as _serve_mod
+
+        self._serve_mod = _serve_mod
+        self._prev = _serve_mod._chaos_dispatch_hook
+        _serve_mod._chaos_dispatch_hook = self._hook
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self._serve_mod._chaos_dispatch_hook = self._prev
+        self._release.set()  # free any thread still parked in the hook
+
+
+class SlowRequestSource:
+    """Request-size iterator wrapper that injects OVERSIZED sizes at
+    seeded emitted indices — the serving-plane burst/abuse pattern: a
+    caller whose batches exceed the largest declared bucket forces the
+    chunked dispatch path under live traffic.  Wraps any iterable of
+    row counts (e.g. the load harness's size stream); ``factor`` scales
+    the hit sizes past ``largest_bucket``."""
+
+    def __init__(self, sizes, largest_bucket: int, slow_at=(0,),
+                 factor: int = 2):
+        if factor < 1:
+            raise ValueError("factor must be >= 1")
+        self._sizes = iter(sizes)
+        self.largest_bucket = int(largest_bucket)
+        self.slow_at = frozenset(slow_at)
+        self.factor = int(factor)
+        self.emitted = 0
+        self.injected = 0
+
+    def __iter__(self) -> "SlowRequestSource":
+        return self
+
+    def __next__(self) -> int:
+        size = next(self._sizes)
+        if self.emitted in self.slow_at:
+            self.injected += 1
+            size = self.largest_bucket * self.factor + size
+        self.emitted += 1
+        return size
 
 
 class _ShrinkWorld:

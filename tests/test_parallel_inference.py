@@ -71,6 +71,43 @@ def test_generator_4d_output(cpu_devices):
     _assert_ulp_close(ref, par)
 
 
+def test_oversized_tail_pads_to_covering_bucket(cpu_devices, dis,
+                                                recompile_sentinel):
+    """Oversized requests chunk by the largest bucket and the TAIL pads
+    to its covering bucket (70 -> 64 + 8), so oversized traffic can
+    never mint a dispatch shape outside the declared set — pinned under
+    an armed RecompileSentinel: warm the size mix once (bucket shapes
+    AND the host-side eager pad/slice/concat programs each size mints),
+    then steady-state repeats of the same mix must run with ZERO
+    further compiles."""
+    pi = ParallelInference(dis, mesh=data_mesh(8), buckets=(8, 32, 64))
+    dispatched = []
+    real_dispatch = pi._dispatch
+
+    def spy(xs, pad_to=None):
+        dispatched.append(pad_to)
+        return real_dispatch(xs, pad_to=pad_to)
+
+    pi._dispatch = spy
+    sizes = (65, 70, 100, 129)     # oversized: chunked paths
+    refs = {n: dis.output(_x(n, seed=n))[0] for n in sizes}
+    for b in pi.buckets:           # warm every declared bucket shape
+        pi.output(_x(b, seed=b))
+    for n in sizes:                # warm each size's eager host ops
+        pi.output(_x(n, seed=n))
+    recompile_sentinel.arm()
+    dispatched.clear()
+    for n in sizes:
+        _assert_ulp_close(refs[n], pi.output(_x(n, seed=n))[0])
+    # every dispatch shape was a declared bucket, and the tails took
+    # their COVERING bucket, not the 64-row chunking unit:
+    # 65 -> 64+8, 70 -> 64+8, 100 -> 64+64 (36 covers to 64),
+    # 129 -> 64+64+8
+    assert set(dispatched) <= set(pi.buckets)
+    assert dispatched == [64, 8, 64, 8, 64, 64, 64, 64, 8]
+    # teardown: recompile_sentinel.check() proves zero compiles landed
+
+
 def test_refresh_params_tracks_training(cpu_devices, dis):
     pi = ParallelInference(dis, mesh=data_mesh(8))
     x = _x(8, seed=3)
