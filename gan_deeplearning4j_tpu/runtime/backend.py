@@ -62,7 +62,9 @@ def backend_initialized() -> bool:
         from jax._src import xla_bridge
 
         return bool(xla_bridge._backends)
-    except Exception:  # gan4j-lint: disable=swallowed-exception — private-API probe; an unknown jax layout just means "assume initialized" (the conservative answer)
+    except Exception:
+        # private-API probe; an unknown jax layout just means "assume
+        # initialized" (the conservative answer)
         return True
 
 

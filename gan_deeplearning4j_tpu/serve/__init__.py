@@ -14,7 +14,7 @@ from gan_deeplearning4j_tpu.serve.admission import (
     Request,
     ShedError,
 )
-from gan_deeplearning4j_tpu.serve.engine import ServeEngine
+from gan_deeplearning4j_tpu.serve.engine import DispatchError, ServeEngine
 from gan_deeplearning4j_tpu.serve.loadgen import (
     measure_saturation,
     percentiles,
@@ -24,6 +24,7 @@ from gan_deeplearning4j_tpu.serve.loadgen import (
 
 __all__ = [
     "AdmissionQueue",
+    "DispatchError",
     "Request",
     "ServeEngine",
     "ShedError",

@@ -116,6 +116,7 @@ class AdmissionQueue:
         self._lock = threading.Lock()
         self._queue: deque = deque()
         self._queued_rows = 0
+        self._closed = False
         self._admitted_total = 0
         self._shed_total = 0
         self._rate_rows_per_s: Optional[float] = None
@@ -123,15 +124,23 @@ class AdmissionQueue:
     # -- producer side (any thread) -------------------------------------------
 
     def submit(self, request: Request) -> Request:
-        """Admit ``request`` or raise ``ShedError``.  Never blocks."""
+        """Admit ``request`` or raise ``ShedError``.  Never blocks.
+        Raises ``RuntimeError`` once the queue is closed — the check
+        happens under the SAME lock as the enqueue, so a submit racing
+        ``close()`` either lands before the shutdown sweep (and is
+        failed by it) or raises; it can never slip in after the sweep
+        and strand until the caller's ``result()`` timeout."""
         with self._lock:
+            closed = self._closed
             depth = len(self._queue)
             rate = self._rate_rows_per_s
             est_wait_ms = None
             if rate is not None and rate > 0:
                 est_wait_ms = ((self._queued_rows + request.rows)
                                / rate * 1000.0)
-            if depth >= self.max_depth:
+            if closed:
+                reason = None
+            elif depth >= self.max_depth:
                 reason = (f"queue depth {depth} at the max_depth "
                           f"{self.max_depth} bound")
             elif est_wait_ms is not None \
@@ -147,6 +156,11 @@ class AdmissionQueue:
             if reason is not None:
                 self._shed_total += 1
                 shed_total = self._shed_total
+        if closed:
+            # a closed queue is a STOPPED service, not load shedding:
+            # raise the engine's "not running" error, don't count a shed
+            raise RuntimeError(
+                "admission queue is closed (serve engine stopped)")
         if reason is not None:
             # event + raise OUTSIDE the lock: the recorder may write
             events.instant("serve.shed", depth=depth, rows=request.rows,
@@ -158,6 +172,19 @@ class AdmissionQueue:
                             budget_ms=self.deadline_ms)
         self.wake.set()
         return request
+
+    def close(self) -> None:
+        """Refuse all further admits: post-close ``submit`` raises
+        ``RuntimeError`` under the queue lock instead of enqueueing.
+        ``ServeEngine.stop`` closes the queue BEFORE its ``fail_all``
+        sweep so nothing can be admitted after the sweep and strand."""
+        with self._lock:
+            self._closed = True
+
+    def reopen(self) -> None:
+        """Accept admits again (an engine restart after ``stop``)."""
+        with self._lock:
+            self._closed = False
 
     # -- consumer side (the dispatch thread) -----------------------------------
 

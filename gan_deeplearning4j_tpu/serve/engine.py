@@ -14,7 +14,14 @@ dispatch thread through the existing beat/region API.  A hang anywhere
 in the cycle (a wedged dispatch, a dead device) becomes a
 ``WatchdogTimeout`` raised ON the dispatch thread; the loop fails every
 in-flight and queued request with the typed error (never a silent
-hang), re-arms a fresh watchdog, and keeps serving.
+hang), re-arms a fresh watchdog, and keeps serving.  A cycle that
+RAISES rather than hangs (a malformed coalesced batch that slipped past
+submit validation, a device failure) fails that batch's in-flight
+requests with a typed ``DispatchError`` and keeps serving — the
+dispatch thread never dies while ``submit`` keeps admitting; if it
+somehow still does, the exit path closes the admission queue and
+answers everything outstanding, so ``running`` turning False and
+"requests stop being accepted" happen together.
 
 Weight hot-swap: ``refresh()`` flags the loop to re-snapshot the
 graph's params (``ParallelInference.refresh_params``) between batches —
@@ -65,6 +72,26 @@ _chaos_dispatch_hook: Optional[Callable[[], None]] = None
 _Batch = Tuple[List[Request], List[List], float, int, int]
 
 
+class DispatchError(RuntimeError):
+    """A dispatch cycle raised on the serving thread (malformed
+    coalesced batch, device failure) — NOT a hang.  Attached to every
+    in-flight request of the failed batch as its typed answer; queued
+    requests are untouched and the engine keeps serving (the blast
+    radius of a poison batch is that batch).  The original exception is
+    chained as ``__cause__``."""
+
+
+def _array_trailing(spec) -> Tuple[int, ...]:
+    """The trailing (non-batch) shape of the HOST array a graph input
+    spec expects: flat inputs arrive flattened (``_forward`` reshapes
+    ``cnn_flat`` to NCHW itself), everything else arrives as the spec's
+    declared shape."""
+    if spec.kind == "cnn_flat":
+        h, w, c = spec.shape
+        return (h * w * c,)
+    return tuple(spec.shape)
+
+
 class ServeEngine:
     """Continuous-batching generation service over one
     ``ParallelInference`` dispatch.
@@ -107,6 +134,25 @@ class ServeEngine:
         self._idle_poll_s = float(idle_poll_s)
         self._max_rows = self._infer.buckets[-1]
         self._n_inputs = len(graph.input_names)
+        self._input_names = list(graph.input_names)
+        # per-input admission contract: the trailing (non-batch) array
+        # shape from the graph's InputSpec and the served dtype
+        # (float32 — the stack's parameter dtype — until warmup
+        # captures the real one from its examples).  submit() rejects
+        # a mismatch BEFORE admission: one tenant's malformed request
+        # must fail that tenant's call, never reach the shared
+        # dispatch thread's coalescing (where parts[0]'s shape/dtype
+        # would be assumed for the whole batch) — and a novel
+        # dtype/trailing shape would also mint a novel compile shape,
+        # breaking the closed-program-set contract.
+        self._input_trailing: List[Optional[Tuple[int, ...]]] = []
+        self._input_dtypes: List[np.dtype] = []
+        specs = getattr(graph, "input_specs", {}) or {}
+        for name in self._input_names:
+            spec = specs.get(name)
+            self._input_trailing.append(
+                None if spec is None else _array_trailing(spec))
+            self._input_dtypes.append(np.dtype(np.float32))
         self._lock = threading.Lock()
         # the swap lock serializes host-side param mutation (a
         # checkpoint restore on a caller thread) against the dispatch
@@ -124,23 +170,45 @@ class ServeEngine:
         self._requests_total = 0
         self._batches_total = 0
         self._timeouts_total = 0
+        self._errors_total = 0
 
     # -- producer API (any thread) ---------------------------------------------
 
     def submit(self, *xs) -> Request:
         """Enqueue one generation request; returns the ``Request`` (its
-        ``result()`` blocks for the outputs).  Raises ``ShedError``
-        when admission control rejects it, ``RuntimeError`` when the
-        engine is not running (a dead engine must never accept work it
-        can't finish)."""
+        ``result()`` blocks for the outputs).  Raises ``ValueError``
+        when the inputs don't match the served graph's input spec
+        (count, trailing shape, dtype — rejected BEFORE admission so a
+        malformed request can never poison the shared coalesced batch
+        or mint a novel compile shape), ``ShedError`` when admission
+        control rejects it, ``RuntimeError`` when the engine is not
+        running (a dead engine must never accept work it can't
+        finish)."""
         if not self.running:
             raise RuntimeError("serve engine is not running")
         req = Request(xs)
+        self._validate(req)
+        return self.admission.submit(req)
+
+    def _validate(self, req: Request) -> None:
         if len(req.xs) != self._n_inputs:
             raise ValueError(
                 f"request carries {len(req.xs)} input(s); the served "
                 f"graph takes {self._n_inputs}")
-        return self.admission.submit(req)
+        for i, x in enumerate(req.xs):
+            want = self._input_trailing[i]
+            if want is not None and tuple(x.shape[1:]) != want:
+                raise ValueError(
+                    f"input {i} ({self._input_names[i]!r}): trailing "
+                    f"shape {tuple(x.shape[1:])} does not match the "
+                    f"served graph's expected {want}")
+            dt = self._input_dtypes[i]
+            if np.dtype(x.dtype) != dt:
+                raise ValueError(
+                    f"input {i} ({self._input_names[i]!r}): dtype "
+                    f"{np.dtype(x.dtype)} does not match the served "
+                    f"{dt} — a novel dtype would be a novel compile "
+                    f"shape")
 
     def generate(self, *xs, timeout: Optional[float] = 60.0) -> List:
         """Synchronous convenience: submit + bounded wait."""
@@ -180,6 +248,22 @@ class ServeEngine:
             raise ValueError(
                 f"warmup needs {self._n_inputs} example input(s)")
         examples = [np.asarray(x) for x in example_xs]
+        trailing = list(self._input_trailing)
+        dtypes = list(self._input_dtypes)
+        for i, x in enumerate(examples):
+            want = trailing[i]
+            if want is not None and tuple(x.shape[1:]) != want:
+                raise ValueError(
+                    f"warmup example {i} ({self._input_names[i]!r}): "
+                    f"trailing shape {tuple(x.shape[1:])} does not "
+                    f"match the graph's input spec {want}")
+            trailing[i] = tuple(x.shape[1:])
+            dtypes[i] = np.dtype(x.dtype)
+        # the warmed shapes/dtypes ARE the compiled-program set: they
+        # become the admission contract submit() enforces
+        with self._lock:
+            self._input_trailing = trailing
+            self._input_dtypes = dtypes
         outs = None
         for b in self._infer.buckets:
             xs = [np.zeros((b,) + tuple(x.shape[1:]), dtype=x.dtype)
@@ -202,6 +286,7 @@ class ServeEngine:
                 target=self._loop, name="gan4j-serve-dispatch",
                 daemon=True)
             self._thread = thread
+        self.admission.reopen()  # a restart after stop() serves again
         thread.start()
         self._arm_watchdog(thread)
         return self
@@ -209,8 +294,13 @@ class ServeEngine:
     def stop(self) -> None:
         """Stop the dispatch loop (bounded join) and fail anything
         still queued with a typed error — a stopped engine answers
-        every outstanding request, it never strands one."""
+        every outstanding request, it never strands one.  The
+        admission queue is closed FIRST (under its own lock), so a
+        submit racing this method either lands before the fail_all
+        sweep (and is failed by it) or raises — it can never enqueue
+        after the sweep and strand until the caller's timeout."""
         self._stop.set()
+        self.admission.close()
         self.admission.wake.set()  # break the idle park
         with self._lock:
             thread, self._thread = self._thread, None
@@ -237,39 +327,81 @@ class ServeEngine:
     # -- the dispatch loop (gan4j-serve-dispatch thread) -----------------------
 
     def _loop(self) -> None:
+        try:
+            self._serve()
+        finally:
+            if not self._stop.is_set():
+                # the dispatch thread is dying OUTSIDE an orderly
+                # stop() — an async watchdog raise escaped even the
+                # recovery shield.  A dead engine must never keep
+                # admitting work nothing will serve: close the front
+                # door, answer everything outstanding, and drop the
+                # thread handle so ``running`` turns False.
+                err = RuntimeError(
+                    "serve dispatch thread died unexpectedly — the "
+                    "engine is stopped; outstanding requests failed "
+                    "with this typed error")
+                self.admission.close()
+                with self._lock:
+                    open_reqs, self._open = self._open, []
+                    if self._thread is threading.current_thread():
+                        self._thread = None
+                for r in open_reqs:
+                    if not r.done.is_set():
+                        r.error = err
+                        r.done.set()
+                self.admission.fail_all(err)
+
+    def _serve(self) -> None:
         pending: Optional[_Batch] = None
         cycle = 0
         while not self._stop.is_set():
             try:
-                wd = self._wd()
-                if wd is not None:
-                    wd.beat()
-                if self._refresh.is_set():
-                    self._refresh.clear()
-                    with self._swap_lock:
-                        self._infer.refresh_params()
-                reqs = self.admission.drain(self._max_rows)
-                inflight: Optional[_Batch] = None
-                if reqs:
-                    with self._lock:
-                        self._open.extend(reqs)
-                    inflight = self._dispatch(reqs, wd)
-                # pipeline depth 1: batch N+1 is already on the device
-                # before batch N's outputs are fenced and fanned out
-                if pending is not None:
-                    self._complete(pending, wd)
-                pending = inflight
-                if reqs or pending is not None:
-                    cycle += 1
+                try:
+                    wd = self._wd()
                     if wd is not None:
-                        wd.beat(step=cycle)
-                else:
-                    self.admission.wake.wait(self._idle_poll_s)
-            except WatchdogTimeout:
+                        wd.beat()
+                    if self._refresh.is_set():
+                        self._refresh.clear()
+                        with self._swap_lock:
+                            self._infer.refresh_params()
+                    reqs = self.admission.drain(self._max_rows)
+                    inflight: Optional[_Batch] = None
+                    if reqs:
+                        with self._lock:
+                            self._open.extend(reqs)
+                        inflight = self._dispatch(reqs, wd)
+                    # pipeline depth 1: batch N+1 is already on the
+                    # device before batch N's outputs are fenced and
+                    # fanned out
+                    if pending is not None:
+                        self._complete(pending, wd)
+                    pending = inflight
+                    if reqs or pending is not None:
+                        cycle += 1
+                        if wd is not None:
+                            wd.beat(step=cycle)
+                    else:
+                        self.admission.wake.wait(self._idle_poll_s)
+                except WatchdogTimeout:
+                    pending = None
+                    self._on_timeout()
+                except Exception as e:
+                    pending = None
+                    self._on_error(e)
+            except BaseException:
+                # async-raise lands at ANY bytecode boundary, so a
+                # second WatchdogTimeout can hit INSIDE the recovery
+                # handlers above (not just inside _on_timeout, which
+                # the old code guarded).  The first delivery is
+                # already being handled: finish the recovery
+                # best-effort — every open/queued request answered,
+                # watchdog re-armed — and keep serving; the dispatch
+                # thread dying is the one unacceptable outcome.
                 pending = None
                 try:
                     self._on_timeout()
-                except WatchdogTimeout:  # gan4j-lint: disable=swallowed-exception — a watchdog re-raise landing mid-recovery IS the timeout already being handled
+                except BaseException:  # gan4j-lint: disable=swallowed-exception — last-resort shield, see above
                     pass
         # orderly exit: the batch already on the device completes;
         # stop() fails whatever is still queued
@@ -380,6 +512,8 @@ class ServeEngine:
             thread = self._thread
         now = time.perf_counter()
         for r in open_reqs:
+            if r.done.is_set():  # answered before the cycle fell over
+                continue
             r.error = err
             r.t_done = now
             r.done.set()
@@ -387,6 +521,32 @@ class ServeEngine:
         events.instant("serve.timeout", failed_inflight=len(open_reqs),
                        failed_queued=len(failed_queued))
         self._arm_watchdog(thread)
+
+    def _on_error(self, exc: Exception) -> None:
+        """A dispatch cycle RAISED (malformed coalesced batch that
+        bypassed submit validation, a device error) — not a hang, so
+        the watchdog stays armed.  Fail every in-flight request with a
+        typed ``DispatchError`` and keep serving; queued requests are
+        untouched (they dispatch next cycle — the blast radius of a
+        poison batch is that batch).  The dispatch thread never dies
+        silently while ``submit`` keeps admitting."""
+        err = DispatchError(
+            f"serving dispatch failed: {exc!r} — this batch's "
+            "in-flight requests failed with the typed error; the "
+            "engine keeps serving (see the serve.error event)")
+        err.__cause__ = exc
+        with self._lock:
+            open_reqs, self._open = self._open, []
+            self._errors_total += 1
+        now = time.perf_counter()
+        for r in open_reqs:
+            if r.done.is_set():  # answered before the cycle fell over
+                continue
+            r.error = err
+            r.t_done = now
+            r.done.set()
+        events.instant("serve.error", error=repr(exc),
+                       failed_inflight=len(open_reqs))
 
     def _disarm_watchdog(self) -> None:
         with self._lock:
@@ -415,6 +575,7 @@ class ServeEngine:
             requests_total = self._requests_total
             batches_total = self._batches_total
             timeouts_total = self._timeouts_total
+            errors_total = self._errors_total
             wd = self._watchdog
         p50, p95, p99 = percentiles(lats, (50.0, 95.0, 99.0))
         stalled = bool(wd is not None and wd.stalled)
@@ -427,6 +588,7 @@ class ServeEngine:
             "batch_fill": (sum(fills) / len(fills)) if fills else 0.0,
             "p50_ms": p50, "p95_ms": p95, "p99_ms": p99,
             "timeouts_total": timeouts_total,
+            "errors_total": errors_total,
             "rate_rows_per_s": adm["rate_rows_per_s"],
             "stalled": stalled,
             "ok": not stalled,

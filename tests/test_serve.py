@@ -27,6 +27,7 @@ from gan_deeplearning4j_tpu.parallel import data_mesh
 from gan_deeplearning4j_tpu.parallel.inference import ParallelInference
 from gan_deeplearning4j_tpu.serve import (
     AdmissionQueue,
+    DispatchError,
     Request,
     ServeEngine,
     ShedError,
@@ -308,6 +309,104 @@ def test_engine_lifecycle_never_strands(gen_infer):
             req.result(timeout=1.0)
     else:                                    # raced the last cycle: fine
         assert req.outputs is not None
+
+
+def test_dispatch_exception_fails_batch_typed_keeps_serving(gen_infer):
+    """A poison batch (malformed request that bypassed submit
+    validation via direct admission enqueue) RAISES on the dispatch
+    thread during host-side coalescing.  The thread must not die:
+    that batch's requests fail with the typed ``DispatchError`` (the
+    original exception chained as ``__cause__``), the engine stays
+    ``running``, and the next request is served normally."""
+    eng = ServeEngine(infer=gen_infer, supervise=False)
+    eng.warmup(np.zeros((1, 2), np.float32))
+    good = Request(_mk(4, seed=31))
+    bad = Request((np.zeros((4, 3), np.float32),))  # wrong trailing dim
+    eng.admission.submit(good)
+    eng.admission.submit(bad)  # coalesced: np.concatenate must raise
+    with eng:
+        with pytest.raises(DispatchError) as ei:
+            bad.result(timeout=60.0)
+        assert ei.value.__cause__ is not None
+        with pytest.raises(DispatchError):
+            good.result(timeout=60.0)  # same poisoned batch
+        assert eng.running               # the thread survived
+        out = eng.generate(*_mk(4, seed=32), timeout=120.0)
+        assert out[0].shape[0] == 4      # ...and keeps serving
+        rep = eng.report()
+        assert rep["errors_total"] == 1
+        assert rep["timeouts_total"] == 0  # an error is not a hang
+
+
+def test_submit_rejects_malformed_before_admission(warm_engine):
+    """One tenant's malformed request fails THAT call with ValueError
+    at submit — it never reaches the shared dispatch thread's
+    coalescing (where it would take down every tenant's batch) and
+    never mints a novel compile shape."""
+    before = warm_engine.admission.report()["admitted_total"]
+    with pytest.raises(ValueError):                    # trailing shape
+        warm_engine.submit(np.zeros((4, 3), np.float32))
+    with pytest.raises(ValueError):                    # extra dim
+        warm_engine.submit(np.zeros((4, 2, 1), np.float32))
+    with pytest.raises(ValueError):                    # dtype
+        warm_engine.submit(np.zeros((4, 2), np.float64))
+    with pytest.raises(ValueError):                    # input count
+        warm_engine.submit(np.zeros((4, 2), np.float32),
+                           np.zeros((4, 2), np.float32))
+    assert warm_engine.admission.report()["admitted_total"] == before
+    out = warm_engine.generate(*_mk(4, seed=33), timeout=120.0)
+    assert out[0].shape[0] == 4
+
+
+def test_stop_closes_admission_and_restart_reopens(gen_infer):
+    """The submit/stop race: once ``stop()`` has run, an admission
+    enqueue raises under the queue lock instead of stranding a request
+    the fail_all sweep already missed; ``start()`` reopens the door."""
+    q = AdmissionQueue()
+    q.close()
+    with pytest.raises(RuntimeError):
+        q.submit(Request(_mk(1)))
+    q.reopen()
+    q.submit(Request(_mk(1)))                # admits again
+    eng = ServeEngine(infer=gen_infer, supervise=False)
+    eng.start()
+    eng.stop()
+    with pytest.raises(RuntimeError):        # closed, not stranded
+        eng.admission.submit(Request(_mk(4)))
+    eng.start()                              # restart serves again
+    try:
+        out = eng.generate(*_mk(4, seed=34), timeout=120.0)
+        assert out[0].shape[0] == 4
+    finally:
+        eng.stop()
+
+
+def test_watchdog_reraise_inside_recovery_survives(gen_infer):
+    """A second async WatchdogTimeout can land INSIDE the recovery
+    handler itself (async-raise hits any bytecode boundary).  The
+    shield finishes the recovery: the failed batch still gets a typed
+    answer and the dispatch loop keeps serving."""
+    eng = ServeEngine(infer=gen_infer, supervise=False)
+    eng.warmup(np.zeros((1, 2), np.float32))
+    orig = eng._on_error
+    calls = []
+
+    def flaky(exc):
+        if not calls:
+            calls.append(exc)
+            raise WatchdogTimeout("second delivery mid-recovery")
+        orig(exc)
+
+    eng._on_error = flaky
+    bad = Request((np.zeros((4, 3), np.float32),))
+    eng.admission.submit(bad)
+    with eng:
+        with pytest.raises((WatchdogTimeout, DispatchError)):
+            bad.result(timeout=60.0)         # typed, never a hang
+        assert calls                         # recovery WAS interrupted
+        assert eng.running
+        out = eng.generate(*_mk(4, seed=35), timeout=120.0)
+        assert out[0].shape[0] == 4
 
 
 def test_exporter_serve_series_precreated_and_live(warm_engine):
