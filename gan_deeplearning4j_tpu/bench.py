@@ -833,7 +833,7 @@ def fleet_bench(sweep=FLEET_SWEEP, flagship: int = FLEET_FLAGSHIP,
 def serve_bench(start_rps: float = 50.0, stage_s: float = 2.0,
                 repeats: int = 5, load_frac: float = 0.8,
                 growth: float = 1.6, max_stages: int = 12,
-                seed: int = 0) -> dict:
+                seed: int = 0, gateway: bool = False) -> dict:
     """The serving bench of record (serve/): ramp an open-loop Poisson
     load to the engine's saturation throughput, then measure p50/p95/
     p99 request latency over ``repeats`` stages at ``load_frac`` of
@@ -842,6 +842,13 @@ def serve_bench(start_rps: float = 50.0, stage_s: float = 2.0,
     executes under an armed RecompileSentinel, and the capture carries
     the post-warmup compile count (the zero-recompile claim, measured
     not asserted).
+
+    ``gateway=True`` re-measures the SAME SLO operating point through
+    the HTTP front door — gateway + router + retrying client over a
+    real socket (serve/gateway.py) — as the regression-gated "gateway"
+    series: one replica over the SAME compiled dispatch, so
+    gateway-p50 minus serve-p50 IS the wire cost (parse + validate +
+    route + encode + loopback TCP), not a different model.
     """
     import statistics
 
@@ -912,6 +919,36 @@ def serve_bench(start_rps: float = 50.0, stage_s: float = 2.0,
                              ("requests_total", "batches_total",
                               "shed_total", "batch_fill",
                               "rate_rows_per_s", "timeouts_total")}
+        gw_stages = []
+        if gateway:
+            # the front-door A/B: same compiled dispatch, same SLO
+            # rate, but through gateway + router + client over a real
+            # socket — the still-armed sentinel extends the zero-
+            # recompile claim across the wire path
+            from gan_deeplearning4j_tpu.serve import (
+                Gateway,
+                GatewayClient,
+                Router,
+                run_socket_load,
+            )
+            g_eng = ServeEngine(infer=pi, watchdog_deadline_s=60.0)
+            g_eng.warmup(np.zeros((1, 2), np.float32))
+            g_eng.start()
+            router = Router(replicas=[g_eng])
+            try:
+                with Gateway(router) as gw:
+                    client = GatewayClient("127.0.0.1", gw.port,
+                                           retries=2, seed=seed)
+                    for i in range(max(1, repeats)):
+                        gw_stages.append(run_socket_load(
+                            client, rate, duration_s=stage_s,
+                            make_inputs=make_inputs,
+                            encoding="npy", seed=seed + 200 + i))
+                    gw_rep = gw.report()
+                    out["gateway_report"] = gw_rep
+            finally:
+                router.stop()
+            out["gateway_slo_stages"] = gw_stages
     p50s = [s["p50_ms"] for s in stages if s["p50_ms"] is not None]
     p99s = [s["p99_ms"] for s in stages if s["p99_ms"] is not None]
     if p50s:
@@ -939,6 +976,35 @@ def serve_bench(start_rps: float = 50.0, stage_s: float = 2.0,
         }
         out["p99_ms"] = round(statistics.median(p99s), 4) if p99s \
             else None
+    g50s = [s["p50_ms"] for s in gw_stages if s["p50_ms"] is not None]
+    if g50s:
+        g_med = statistics.median(g50s)
+        if len(g50s) >= 2:
+            q1, _, q3 = statistics.quantiles(
+                g50s, n=4, method="inclusive")
+            g_iqr = q3 - q1
+        else:
+            g_iqr = 0.0
+        # the gate-compatible "gateway" series: socket-path request
+        # p50 at the same SLO operating point as "serve" above
+        out["gateway"] = {
+            "multistep_step_ms": round(g_med, 4),
+            "spread": {
+                "median_ms": round(g_med, 4),
+                "iqr_ms": round(g_iqr, 4),
+                "min_ms": round(min(g50s), 4),
+                "max_ms": round(max(g50s), 4),
+                "repeats": len(g50s),
+                "window_calls": [
+                    min(s["completed"] for s in gw_stages),
+                    max(s["completed"] for s in gw_stages)],
+                "window_steps_per_call": 1,
+            },
+        }
+        out["gateway_p99_ms"] = round(statistics.median(
+            [s["p99_ms"] for s in gw_stages
+             if s["p99_ms"] is not None]), 4) if gw_stages else None
+        out["gateway_errors"] = sum(s["errors"] for s in gw_stages)
     out["post_warmup_recompiles"] = len(sentinel.recompiles)
     out["regression_gate"] = bench_gate.check_against_lastgood(
         out, os.path.join(os.path.dirname(BASELINE_PATH),
@@ -1432,6 +1498,52 @@ def dryrun(telemetry: bool = True,
                             "multistep_step_ms": round(s_p50, 4),
                             "spread": {"median_ms": round(s_p50, 4),
                                        "iqr_ms": 0.0}}})
+                # the network front door (serve/gateway.py): a short
+                # Poisson burst through gateway + router + client over
+                # a REAL loopback socket, reusing the serve block's
+                # already-compiled dispatch so a still-armed sentinel
+                # proves the wire path adds ZERO compiles; the report
+                # feeds the exporter so the scrape below must carry
+                # the gan4j_gateway_* series and the /healthz gateway
+                # block
+                with events_mod.span("bench.gateway"):
+                    from gan_deeplearning4j_tpu.serve import (
+                        Gateway,
+                        GatewayClient,
+                        Router,
+                        run_socket_load,
+                    )
+                    gsentinel = RecompileSentinel(registry=registry)
+                    g_eng = ServeEngine(infer=s_pi,
+                                        watchdog_deadline_s=60.0)
+                    g_eng.warmup(_np.zeros((1, 2), _np.float32))
+                    g_router = Router(replicas=[g_eng])
+                    with gsentinel:
+                        gsentinel.arm()
+                        g_eng.start()
+                        try:
+                            with Gateway(g_router) as g_gw:
+                                g_client = GatewayClient(
+                                    "127.0.0.1", g_gw.port,
+                                    retries=2, seed=3)
+                                g_stats = run_socket_load(
+                                    g_client, rate_rps=60.0,
+                                    n_requests=12,
+                                    make_inputs=z_inputs(2, seed=4),
+                                    encoding="npy", seed=5)
+                                gw_rec = g_gw.report()
+                        finally:
+                            g_router.stop()
+                    gw_rec["post_warmup_recompiles"] = len(
+                        gsentinel.recompiles)
+                    registry.observe_gateway(lambda: gw_rec)
+                    g_p50 = g_stats["p50_ms"] or 0.0
+                    publish_bench_series(
+                        registry,
+                        {"gateway": {
+                            "multistep_step_ms": round(g_p50, 4),
+                            "spread": {"median_ms": round(g_p50, 4),
+                                       "iqr_ms": 0.0}}})
                 # one record through the registry feed, then a REAL
                 # scrape over the socket: the CI assertion that the
                 # exporter answers with the step/goodput/NaN series
@@ -1536,6 +1648,33 @@ def dryrun(telemetry: bool = True,
                     and isinstance(serve_blk, dict)
                     and serve_blk.get("requests_total", 0) >= 1
                     and serve_blk.get("ok") is True)
+                # front-door surface: the socket burst completed with
+                # zero failures of ANY kind and zero post-warmup
+                # compiles (the wire path is parse/validate/route —
+                # it must never touch the compiler), the
+                # gan4j_gateway_* series live in the scrape (fed: the
+                # request count must be the real one), the "gateway"
+                # bench series present, and the /healthz gateway block
+                # healthy with the replica behind it
+                gateway_blk = health.get("gateway")
+                gateway_ok = (
+                    g_stats["completed"] == 12
+                    and g_stats["errors"] == 0
+                    and g_stats["shed"] == 0
+                    and g_stats["unavailable"] == 0
+                    and g_stats["undrained"] == 0
+                    and gw_rec["requests_total"] >= 12
+                    and gw_rec["post_warmup_recompiles"] == 0
+                    and "gan4j_gateway_requests_total " in m_body
+                    and "gan4j_gateway_rejected_total " in m_body
+                    and "gan4j_gateway_active_connections " in m_body
+                    and "gan4j_gateway_replica_healthy " in m_body
+                    and 'gan4j_bench_step_ms{series="gateway"}'
+                    in m_body
+                    and isinstance(gateway_blk, dict)
+                    and gateway_blk.get("requests_total", 0) >= 12
+                    and gateway_blk.get("replicas_healthy") == 1
+                    and gateway_blk.get("ok") is True)
                 recorder.flush()
                 try:
                     events_ok = len(events_mod.read_events(
@@ -1554,7 +1693,7 @@ def dryrun(telemetry: bool = True,
                            and lint["ok"] and sanitizer["ok"]
                            and prove["ok"] and race_ok
                            and bench_stable_ok and fleet_ok
-                           and serve_ok),
+                           and serve_ok and gateway_ok),
                 "platform": device.platform,
                 "telemetry": telemetry,
                 "checkpoint": ckpt,
@@ -1574,6 +1713,8 @@ def dryrun(telemetry: bool = True,
                 "fleet": fleet_rec,
                 "serve_ok": bool(serve_ok),
                 "serve": serve_rec,
+                "gateway_ok": bool(gateway_ok),
+                "gateway": gw_rec,
                 "bench_stable_ok": bool(bench_stable_ok),
                 "bench_spread": spread,
                 "watchdog_beat_us": round(beat_us, 3)}
@@ -1632,6 +1773,13 @@ def main(argv=None) -> None:
                         "latency numbers are reported at")
     p.add_argument("--serve-start-rps", type=float, default=50.0,
                    help="first rung of the geometric saturation ramp")
+    p.add_argument("--gateway", action="store_true",
+                   help="(with --serve) re-measure the SLO operating "
+                        "point through the HTTP front door — gateway + "
+                        "router + retrying client over a real socket "
+                        "(serve/gateway.py) — publishing the "
+                        "regression-gated 'gateway' series; the p50 "
+                        "delta vs the 'serve' series is the wire cost")
     p.add_argument("--fleet", action="store_true",
                    help="multi-tenant fleet bench of record "
                         "(train/fleet.py): sweep tenant counts as "
@@ -1750,7 +1898,8 @@ def main(argv=None) -> None:
             start_rps=args.serve_start_rps,
             stage_s=args.serve_stage_s,
             repeats=args.serve_repeats,
-            load_frac=args.serve_load_frac)))
+            load_frac=args.serve_load_frac,
+            gateway=args.gateway)))
         return
     if args.fleet_stage is not None:
         print(json.dumps(fleet_stage_time(

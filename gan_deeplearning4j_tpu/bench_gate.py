@@ -36,6 +36,7 @@ SERIES: Tuple[Tuple[str, Tuple[str, ...]], ...] = (
     ("celeba_fast", ("celeba_fast",)),
     ("fleet", ("fleet",)),
     ("serve", ("serve",)),
+    ("gateway", ("gateway",)),
 )
 
 # Tolerance floor: 5% — the day-to-day jitter of a healthy capture on

@@ -4,9 +4,12 @@
 package turns it into a service: an admission-controlled request queue
 (``admission.py``) drained by a dedicated dispatch thread
 (``engine.py``) that coalesces concurrent requests into the next
-bucketed dispatch, and an open-loop Poisson load harness
-(``loadgen.py``) that measures p50/p95/p99 and saturation throughput
-(``bench --serve``, docs/SERVING.md).
+bucketed dispatch, an open-loop Poisson load harness (``loadgen.py``)
+that measures p50/p95/p99 and saturation throughput (``bench
+--serve``, docs/SERVING.md), and the network front door: an HTTP
+gateway (``gateway.py``) over a health-aware replica/tenant router
+(``router.py``) with a retrying reference client (``client.py``) —
+``bench --serve --gateway``, docs/SERVING.md "Network front door".
 """
 
 from gan_deeplearning4j_tpu.serve.admission import (
@@ -14,22 +17,41 @@ from gan_deeplearning4j_tpu.serve.admission import (
     Request,
     ShedError,
 )
+from gan_deeplearning4j_tpu.serve.client import (
+    GatewayClient,
+    GatewayHTTPError,
+)
 from gan_deeplearning4j_tpu.serve.engine import DispatchError, ServeEngine
+from gan_deeplearning4j_tpu.serve.gateway import Gateway, TokenBucket
 from gan_deeplearning4j_tpu.serve.loadgen import (
     measure_saturation,
     percentiles,
     run_load,
+    run_socket_load,
     z_inputs,
+)
+from gan_deeplearning4j_tpu.serve.router import (
+    FleetTenantBank,
+    NoHealthyReplicaError,
+    Router,
 )
 
 __all__ = [
     "AdmissionQueue",
     "DispatchError",
+    "FleetTenantBank",
+    "Gateway",
+    "GatewayClient",
+    "GatewayHTTPError",
+    "NoHealthyReplicaError",
     "Request",
+    "Router",
     "ServeEngine",
     "ShedError",
+    "TokenBucket",
     "measure_saturation",
     "percentiles",
     "run_load",
+    "run_socket_load",
     "z_inputs",
 ]

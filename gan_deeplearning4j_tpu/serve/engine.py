@@ -277,6 +277,16 @@ class ServeEngine:
         with self._lock:
             return self._thread is not None
 
+    @property
+    def stalled(self) -> bool:
+        """True while the dispatch loop is past its watchdog deadline
+        (the ``/healthz`` serve block's failure condition).  Cheap —
+        one lock and a flag read, no percentile math — so the router's
+        per-request health probe can call it on the hot path."""
+        with self._lock:
+            wd = self._watchdog
+        return bool(wd is not None and wd.stalled)
+
     def start(self) -> "ServeEngine":
         with self._lock:
             if self._thread is not None:

@@ -27,6 +27,7 @@ from __future__ import annotations
 import random
 import time
 from collections import deque
+from concurrent.futures import ThreadPoolExecutor
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -165,6 +166,137 @@ def run_load(engine, rate_rps: float,
         "shed": shed,
         "errors": errors,
         "undrained": undrained,
+        "wall_s": wall_s,
+        "p50_ms": p50, "p95_ms": p95, "p99_ms": p99,
+    }
+
+
+def run_socket_load(client, rate_rps: float,
+                    duration_s: Optional[float] = None,
+                    n_requests: Optional[int] = None,
+                    size_mix: Sequence[Tuple[int, float]]
+                    = DEFAULT_SIZE_MIX,
+                    make_inputs: Optional[Callable] = None,
+                    seed: int = 0,
+                    tenant: Optional[str] = None,
+                    encoding: str = "npy",
+                    max_workers: int = 32,
+                    drain_timeout_s: float = 60.0) -> Dict:
+    """``run_load`` over a real socket: the same open-loop Poisson
+    arrival process, driven through a ``GatewayClient`` against the
+    HTTP gateway so the measurement covers the FULL network path —
+    parse, validate, rate limit, route, admit, dispatch, encode.
+
+    Each arrival fires a blocking ``client.generate`` on a worker pool
+    (HTTP has no submit/result split, so concurrency comes from
+    threads; size ``max_workers`` above the expected outstanding count
+    or pool queueing bleeds into the latency numbers).  Outcomes are
+    classified by the gateway's typed wire contract:
+
+    * 200 → completed (latency measured from the scheduled arrival);
+    * 429 after the client's retries → ``shed``;
+    * 503/504 after retries → ``unavailable`` (typed: a replica died
+      or the backend timed out — distinct from shed so a chaos test
+      can assert "zero NON-typed failures" exactly);
+    * anything else (400s, transport errors) → ``errors``.
+
+    Returns the ``run_load`` dict shape plus ``unavailable`` and the
+    client's ``retried_total`` delta."""
+    from gan_deeplearning4j_tpu.serve.client import GatewayHTTPError
+
+    if duration_s is None and n_requests is None:
+        raise ValueError("run_socket_load needs duration_s or "
+                         "n_requests")
+    if rate_rps <= 0:
+        raise ValueError("rate_rps must be > 0")
+    if make_inputs is None:
+        raise ValueError("run_socket_load needs a make_inputs factory "
+                         "(e.g. serve.loadgen.z_inputs(dim))")
+    rng = random.Random(seed)
+    sizes = [s for s, _ in size_mix]
+    weights = [w for _, w in size_mix]
+    retried_before = client.retried_total
+
+    def _one(rows: int, t_sched: float):
+        try:
+            client.generate(make_inputs(rows), tenant=tenant,
+                            encoding=encoding)
+            return ("ok", (time.perf_counter() - t_sched) * 1000.0,
+                    rows)
+        except GatewayHTTPError as e:
+            if e.status == 429:
+                return ("shed", None, rows)
+            if e.status in (503, 504):
+                return ("unavailable", None, rows)
+            return ("error", None, rows)
+        except Exception:
+            return ("error", None, rows)
+
+    outstanding: deque = deque()
+    latencies: List[float] = []
+    submitted = shed = unavailable = errors = rows_ok = 0
+
+    def _reap_done() -> None:
+        nonlocal shed, unavailable, errors, rows_ok
+        while outstanding and outstanding[0].done():
+            kind, lat_ms, rows = outstanding.popleft().result()
+            if kind == "ok":
+                latencies.append(lat_ms)
+                rows_ok += rows
+            elif kind == "shed":
+                shed += 1
+            elif kind == "unavailable":
+                unavailable += 1
+            else:
+                errors += 1
+
+    with ThreadPoolExecutor(
+            max_workers=max_workers,
+            thread_name_prefix="gan4j-gateway-load") as pool:
+        t0 = time.perf_counter()
+        t_next = t0
+        while True:
+            if n_requests is not None and submitted >= n_requests:
+                break
+            now = time.perf_counter()
+            if duration_s is not None and now - t0 >= duration_s:
+                break
+            if t_next > now:
+                time.sleep(min(t_next - now, 0.05))
+                continue
+            rows = rng.choices(sizes, weights=weights)[0]
+            outstanding.append(pool.submit(_one, rows,
+                                           time.perf_counter()))
+            submitted += 1
+            # the ABSOLUTE schedule: a slow request doesn't slow arrivals
+            t_next += rng.expovariate(rate_rps)
+            _reap_done()
+        gen_end = time.perf_counter()
+        deadline = gen_end + drain_timeout_s
+        while outstanding and time.perf_counter() < deadline:
+            if not outstanding[0].done():
+                time.sleep(0.05)
+            _reap_done()
+        undrained = len(outstanding)
+        for f in outstanding:
+            f.cancel()
+    wall_s = time.perf_counter() - t0
+    gen_s = gen_end - t0
+    p50, p95, p99 = percentiles(latencies, (50.0, 95.0, 99.0))
+    completed = len(latencies)
+    return {
+        "offered_rps": rate_rps,
+        "achieved_rps": completed / wall_s if wall_s > 0 else 0.0,
+        "gen_s": gen_s,
+        "drain_s": wall_s - gen_s,
+        "rows_per_sec": rows_ok / wall_s if wall_s > 0 else 0.0,
+        "submitted": submitted,
+        "completed": completed,
+        "shed": shed,
+        "unavailable": unavailable,
+        "errors": errors,
+        "undrained": undrained,
+        "retried": client.retried_total - retried_before,
         "wall_s": wall_s,
         "p50_ms": p50, "p95_ms": p95, "p99_ms": p99,
     }

@@ -1,0 +1,344 @@
+"""Multi-engine routing: replica load balancing and fleet tenants.
+
+One ``ServeEngine`` is one dispatch thread over one compiled model.
+Production serving needs two more axes, and this module is both:
+
+* **Replica balancing** — ``Router`` spreads plain generate traffic
+  round-robin across N engine replicas with health-aware dispatch: a
+  replica whose watchdog reports stalled (``engine.stalled`` — the
+  ``/healthz`` serve block's failure condition) or whose engine is not
+  running is EJECTED and re-probed at most every ``recheck_s`` until it
+  recovers; requests drain to the survivors.  A replica that sheds
+  (``ShedError``) is NOT unhealthy — it is at capacity — so the router
+  offers the request to the next replica and only re-raises the shed
+  when every healthy replica shed it (the service, not one engine, is
+  full).  Graceful degradation, never a hang: with zero healthy
+  replicas ``submit`` raises a typed ``NoHealthyReplicaError``
+  immediately.
+
+* **Fleet tenants** — ``FleetTenantBank`` wires PR 12's multi-tenant
+  fleet into serving: ``/v1/tenants/{id}/generate`` routes to the
+  tenant's own generator, built by assigning
+  ``slice_tenant(fleet_state, id).gen_params`` onto a fresh generator
+  graph (exactly the ``FleetCheckpointer.restore(tenants=id)``
+  contract — bit-equal by the slicing pin in tests/test_fleet.py).
+  The full fleet state is restored ONCE and cached host-side (MLP-GAN
+  fleets are small — thousands of tenants of ~10k params each); live
+  per-tenant engines are an LRU of at most ``max_live`` so a million
+  tenants is a routing table, not a million dispatch threads.
+
+Lock discipline: the router lock and the bank lock guard only their
+own bookkeeping (round-robin cursor, ejection map, LRU); engine calls
+— submit, warmup, stop — always happen OUTSIDE them
+(docs/STATIC_ANALYSIS.md, rule lock-held-blocking-call), so neither
+lock can participate in a cycle with the engine/admission locks.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import OrderedDict
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from gan_deeplearning4j_tpu.parallel.inference import (
+    DEFAULT_SERVING_BUCKETS,
+    ParallelInference,
+)
+from gan_deeplearning4j_tpu.serve.admission import Request, ShedError
+from gan_deeplearning4j_tpu.serve.engine import ServeEngine, _array_trailing
+from gan_deeplearning4j_tpu.telemetry import events
+
+
+class NoHealthyReplicaError(RuntimeError):
+    """Every replica is ejected (stalled or stopped): the typed
+    "service unavailable" answer — the router never parks a request
+    hoping a replica comes back."""
+
+
+class Router:
+    """Health-aware round-robin over engine replicas, plus optional
+    tenant routing through a ``FleetTenantBank``.
+
+    ``replicas``: started ``ServeEngine`` instances (may be empty when
+    only tenant routing is used).  ``tenants``: a ``FleetTenantBank``
+    (or anything with an ``engine(tenant_id) -> ServeEngine`` method).
+    ``recheck_s``: how often an ejected replica is re-probed."""
+
+    def __init__(self, replicas: Sequence[ServeEngine] = (),
+                 tenants: Optional["FleetTenantBank"] = None,
+                 recheck_s: float = 0.5):
+        self.replicas: Tuple[ServeEngine, ...] = tuple(replicas)
+        self.tenants = tenants
+        self._recheck_s = float(recheck_s)
+        self._lock = threading.Lock()
+        self._rr = 0
+        # replica index -> monotonic time of the last failed probe;
+        # presence in the map IS the ejected state
+        self._down: Dict[int, float] = {}
+        self._ejected_total = 0
+
+    # -- health ----------------------------------------------------------------
+
+    def _probe(self, idx: int) -> bool:
+        eng = self.replicas[idx]
+        return eng.running and not eng.stalled
+
+    def _healthy(self, idx: int) -> bool:
+        """Probe gate for one replica: healthy replicas are checked
+        every time (the probe is two flag reads); ejected replicas are
+        re-probed at most every ``recheck_s`` so a dead engine costs
+        one timestamp compare per request, not a probe."""
+        now = time.monotonic()
+        with self._lock:
+            down_at = self._down.get(idx)
+            if down_at is not None and now - down_at < self._recheck_s:
+                return False
+        ok = self._probe(idx)
+        with self._lock:
+            if ok:
+                if idx in self._down:
+                    del self._down[idx]
+                    events.instant("router.replica_restored",
+                                   replica=idx)
+            else:
+                if idx not in self._down:
+                    self._ejected_total += 1
+                    events.instant("router.replica_ejected",
+                                   replica=idx)
+                self._down[idx] = now
+        return ok
+
+    def _eject(self, idx: int) -> None:
+        with self._lock:
+            if idx not in self._down:
+                self._ejected_total += 1
+                events.instant("router.replica_ejected", replica=idx)
+            self._down[idx] = time.monotonic()
+
+    # -- dispatch --------------------------------------------------------------
+
+    def submit(self, xs: Sequence[np.ndarray],
+               tenant: Optional[str] = None) -> Request:
+        """Admit one request and return its ``Request`` handle.
+
+        Tenant requests go to the tenant's own engine (``KeyError``
+        for an unknown tenant).  Plain requests try each healthy
+        replica once in round-robin order: a stopped/stalled replica
+        is ejected and skipped, a shedding replica is passed over; the
+        request fails with the LAST shed only when every healthy
+        replica shed it, and with ``NoHealthyReplicaError`` when none
+        was healthy at all."""
+        if tenant is not None:
+            if self.tenants is None:
+                raise KeyError(tenant)
+            return self.tenants.engine(tenant).submit(*xs)
+        if not self.replicas:
+            raise NoHealthyReplicaError(
+                "router has no replicas configured")
+        n = len(self.replicas)
+        with self._lock:
+            start = self._rr
+            self._rr = (self._rr + 1) % n
+        last_shed: Optional[ShedError] = None
+        tried = 0
+        for off in range(n):
+            idx = (start + off) % n
+            if not self._healthy(idx):
+                continue
+            tried += 1
+            try:
+                return self.replicas[idx].submit(*xs)
+            except ShedError as e:
+                last_shed = e  # at capacity, not unhealthy: try next
+            except ValueError:
+                raise  # caller bug — identical on every replica
+            except RuntimeError:
+                # "engine is not running" / "queue is closed": the
+                # replica died between the probe and the submit
+                self._eject(idx)
+        if last_shed is not None:
+            raise last_shed
+        raise NoHealthyReplicaError(
+            f"no healthy replica ({n} configured, {tried} accepting)")
+
+    # -- ops surface -----------------------------------------------------------
+
+    def healthy_count(self) -> int:
+        return sum(1 for i in range(len(self.replicas))
+                   if self._healthy(i))
+
+    def report(self) -> Dict:
+        replica_ok = [self._healthy(i)
+                      for i in range(len(self.replicas))]
+        with self._lock:
+            ejected_total = self._ejected_total
+        ok = (any(replica_ok) if self.replicas
+              else self.tenants is not None)
+        return {
+            "replicas": len(self.replicas),
+            "replicas_healthy": sum(replica_ok),
+            "replica_ok": replica_ok,
+            "ejected_total": ejected_total,
+            "tenants_live": (self.tenants.live_count()
+                             if self.tenants is not None else 0),
+            "ok": ok,
+        }
+
+    def stop(self) -> None:
+        """Stop every replica and tenant engine (bounded — each
+        ``ServeEngine.stop`` is)."""
+        for eng in self.replicas:
+            eng.stop()
+        if self.tenants is not None:
+            self.tenants.stop()
+
+
+class FleetTenantBank:
+    """Per-tenant serving engines sliced from one fleet state.
+
+    ``build_graph``: zero-arg factory returning a fresh generator
+    ``ComputationGraph`` whose parameter tree matches the fleet's
+    ``gen_params`` entry (e.g. ``lambda: M.build_generator(cfg)``).
+    ``checkpointer``: a ``FleetCheckpointer`` to restore the fleet
+    state from (lazily, once); or pass ``state`` (a fleet
+    ``ProtocolState`` with a leading tenant axis) directly.
+    ``max_live``: LRU bound on concurrently-live tenant engines —
+    the eviction victim is stopped (its engine answers everything
+    outstanding first; ``ServeEngine.stop`` is bounded).
+
+    Tenant ids are validated against the fleet size BEFORE slicing:
+    jax index-clamping would otherwise silently serve the LAST tenant
+    for any out-of-range id — an unacceptable cross-tenant leak."""
+
+    def __init__(self, build_graph: Callable, *,
+                 checkpointer=None, state=None,
+                 mesh=None,
+                 buckets: Sequence[int] = DEFAULT_SERVING_BUCKETS,
+                 max_live: int = 4,
+                 supervise: bool = False,
+                 watchdog_deadline_s: Optional[float] = None,
+                 admission_factory: Optional[Callable] = None):
+        if (checkpointer is None) == (state is None):
+            raise ValueError(
+                "FleetTenantBank needs exactly one of checkpointer= "
+                "or state=")
+        if max_live <= 0:
+            raise ValueError("max_live must be > 0")
+        self._build_graph = build_graph
+        self._checkpointer = checkpointer
+        self._state = state
+        self._mesh = mesh
+        self._buckets = tuple(buckets)
+        self._max_live = int(max_live)
+        self._supervise = bool(supervise)
+        self._wd_deadline_s = watchdog_deadline_s
+        self._admission_factory = admission_factory
+        self._lock = threading.Lock()
+        self._live: "OrderedDict[int, ServeEngine]" = OrderedDict()
+        self._num_tenants: Optional[int] = None
+
+    # -- state -----------------------------------------------------------------
+
+    def _ensure_state(self):
+        """Restore the full fleet state once and cache it host-side.
+        ``restore(tenants=t)`` is DEFINED as ``slice_tenant`` of the
+        full restore (train/fleet.py), so slicing the cached state per
+        tenant is bit-equal to a per-tenant restore without re-reading
+        the checkpoint for every tenant."""
+        with self._lock:
+            state = self._state
+        if state is not None:
+            return state
+        _, state, extra = self._checkpointer.restore()
+        n = extra.get("fleet_tenants")
+        with self._lock:
+            if self._state is None:
+                self._state = state
+                if n is not None:
+                    self._num_tenants = int(n)
+            state = self._state
+        return state
+
+    def num_tenants(self) -> int:
+        state = self._ensure_state()
+        with self._lock:
+            if self._num_tenants is None:
+                import jax
+
+                leaf = jax.tree_util.tree_leaves(state.gen_params)[0]
+                self._num_tenants = int(leaf.shape[0])
+            return self._num_tenants
+
+    # -- engines ---------------------------------------------------------------
+
+    def _build_engine(self, tenant: int) -> ServeEngine:
+        from gan_deeplearning4j_tpu.train.fleet import slice_tenant
+
+        state = self._ensure_state()
+        graph = self._build_graph()
+        graph.params = slice_tenant(state, tenant).gen_params
+        infer = ParallelInference(graph, mesh=self._mesh,
+                                  buckets=self._buckets)
+        admission = (self._admission_factory()
+                     if self._admission_factory is not None else None)
+        eng = ServeEngine(infer=infer, admission=admission,
+                          supervise=self._supervise,
+                          watchdog_deadline_s=self._wd_deadline_s)
+        # warm every bucket before the first request: tenant engines
+        # obey the same closed-compiled-set contract as replicas
+        examples = [
+            np.zeros((1,) + _array_trailing(graph.input_specs[name]),
+                     np.float32)
+            for name in graph.input_names]
+        eng.warmup(*examples)
+        eng.start()
+        return eng
+
+    def engine(self, tenant) -> ServeEngine:
+        """The live engine for ``tenant`` (built, warmed and started on
+        first use; LRU thereafter).  Raises ``KeyError`` for an id that
+        is not an integer in ``[0, num_tenants)``."""
+        try:
+            t = int(tenant)
+        except (TypeError, ValueError):
+            raise KeyError(tenant) from None
+        with self._lock:
+            eng = self._live.get(t)
+            if eng is not None:
+                self._live.move_to_end(t)
+                return eng
+        if not 0 <= t < self.num_tenants():
+            raise KeyError(tenant)
+        # build OUTSIDE the lock (compile + thread start are slow);
+        # a racing builder for the same tenant loses and is stopped
+        built = self._build_engine(t)
+        evicted: List[ServeEngine] = []
+        with self._lock:
+            eng = self._live.get(t)
+            if eng is None:
+                self._live[t] = built
+                eng = built
+                while len(self._live) > self._max_live:
+                    _, victim = self._live.popitem(last=False)
+                    evicted.append(victim)
+            else:
+                evicted.append(built)
+        for victim in evicted:
+            victim.stop()
+        if evicted:
+            events.instant("router.tenant_evicted",
+                           evicted=len(evicted), tenant=t)
+        return eng
+
+    def live_count(self) -> int:
+        with self._lock:
+            return len(self._live)
+
+    def stop(self) -> None:
+        with self._lock:
+            live, self._live = list(self._live.values()), OrderedDict()
+        for eng in live:
+            eng.stop()

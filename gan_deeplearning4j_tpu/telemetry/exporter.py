@@ -131,6 +131,12 @@ class MetricsRegistry:
             # alert rule must see the series before the first overload
             ("gan4j_serve_requests_total", ()): 0.0,
             ("gan4j_serve_shed_total", ()): 0.0,
+            # network front door (serve/gateway.py): the wire-level
+            # request/reject counters exist at 0 from the first scrape
+            # — a reject alert rule must see the series before the
+            # first abusive caller shows up
+            ("gan4j_gateway_requests_total", ()): 0.0,
+            ("gan4j_gateway_rejected_total", ()): 0.0,
         }
         self._gauges: Dict[Tuple[str, tuple], float] = {
             # age since the last data-plane incident; 0 until one
@@ -153,6 +159,11 @@ class MetricsRegistry:
             ("gan4j_serve_queue_depth", ()): 0.0,
             ("gan4j_serve_batch_fill", ()): 0.0,
             ("gan4j_serve_p99_ms", ()): 0.0,
+            # gateway gauges (serve/gateway.py): 0 connections and 0
+            # healthy replicas = "no gateway running"; the feed
+            # (observe_gateway) raises them
+            ("gan4j_gateway_active_connections", ()): 0.0,
+            ("gan4j_gateway_replica_healthy", ()): 0.0,
         }
         self._callbacks: List[Callable[["MetricsRegistry"], None]] = []
         self.run_id: Optional[str] = None
@@ -175,6 +186,9 @@ class MetricsRegistry:
         # serving feed (serve/engine.ServeEngine.report): drives the
         # gan4j_serve_* series and the /healthz "serve" block
         self._serve_fn: Optional[Callable[[], Optional[Dict]]] = None
+        # gateway feed (serve/gateway.Gateway.report): drives the
+        # gan4j_gateway_* series and the /healthz "gateway" block
+        self._gateway_fn: Optional[Callable[[], Optional[Dict]]] = None
 
     @staticmethod
     def _key(name: str, labels: Optional[Dict]) -> Tuple[str, tuple]:
@@ -380,6 +394,33 @@ class MetricsRegistry:
 
         self.add_callback(cb)
 
+    def observe_gateway(self, report_fn: Callable[[], Optional[Dict]]
+                        ) -> None:
+        """Register the network-front-door feed: ``report_fn`` returns
+        a ``Gateway.report()`` dict (wire request/reject totals, live
+        connection count, replica health).  Scrapes mirror it into the
+        ``gan4j_gateway_*`` series and ``/healthz`` carries it as the
+        ``"gateway"`` block — ``ok: false`` the moment the router has
+        zero healthy replicas (the front door is up but nothing behind
+        it can serve)."""
+        with self._lock:
+            self._gateway_fn = report_fn
+
+        def cb(reg: "MetricsRegistry") -> None:
+            rep = report_fn()
+            if not rep:
+                return
+            reg.set_counter("gan4j_gateway_requests_total",
+                            float(rep.get("requests_total", 0)))
+            reg.set_counter("gan4j_gateway_rejected_total",
+                            float(rep.get("rejected_total", 0)))
+            reg.set("gan4j_gateway_active_connections",
+                    float(rep.get("active_connections", 0)))
+            reg.set("gan4j_gateway_replica_healthy",
+                    float(rep.get("replicas_healthy", 0)))
+
+        self.add_callback(cb)
+
     # -- render ---------------------------------------------------------------
 
     def render(self) -> str:
@@ -486,6 +527,26 @@ class MetricsRegistry:
                          "ok": bool(rep.get("ok", True))}
             except Exception:  # gan4j-lint: disable=swallowed-exception — a broken feed must not take down the probe
                 pass
+        # the gateway block: live feed when a gateway is running, else
+        # the pre-created series — ALWAYS present, like the rest.
+        # ok:false when the router has zero healthy replicas.
+        gateway = None
+        gfn = self._gateway_fn
+        if gfn is not None:
+            try:
+                rep = gfn() or {}
+                gateway = {"requests_total": int(
+                               rep.get("requests_total", 0)),
+                           "rejected_total": int(
+                               rep.get("rejected_total", 0)),
+                           "active_connections": int(
+                               rep.get("active_connections", 0)),
+                           "replicas_healthy": int(
+                               rep.get("replicas_healthy", 0)),
+                           "replicas": int(rep.get("replicas", 0)),
+                           "ok": bool(rep.get("ok", True))}
+            except Exception:  # gan4j-lint: disable=swallowed-exception — a broken feed must not take down the probe
+                pass
         with self._lock:
             if data is None:
                 data = {"retries_total": int(self._counters.get(
@@ -517,12 +578,27 @@ class MetricsRegistry:
                          "batch_fill": float(self._gauges.get(
                              ("gan4j_serve_batch_fill", ()), 0.0)),
                          "p99_ms": None, "ok": True}
+            if gateway is None:
+                gateway = {"requests_total": int(self._counters.get(
+                               ("gan4j_gateway_requests_total", ()),
+                               0.0)),
+                           "rejected_total": int(self._counters.get(
+                               ("gan4j_gateway_rejected_total", ()),
+                               0.0)),
+                           "active_connections": int(self._gauges.get(
+                               ("gan4j_gateway_active_connections",
+                                ()), 0.0)),
+                           "replicas_healthy": int(self._gauges.get(
+                               ("gan4j_gateway_replica_healthy", ()),
+                               0.0)),
+                           "replicas": 0, "ok": True}
             age = (None if self._last_record_wall is None
                    else round(time.time() - self._last_record_wall, 3))
             doc = {"status": "stalled" if stalled else "ok",
                    "stalled": stalled, "run_id": self.run_id,
                    "last_record_age_s": age, "data": data,
-                   "mesh": mesh, "fleet": fleet, "serve": serve}
+                   "mesh": mesh, "fleet": fleet, "serve": serve,
+                   "gateway": gateway}
             if beat_age is not None:
                 doc["last_beat_age_s"] = round(float(beat_age), 3)
             return doc

@@ -59,6 +59,16 @@ the seeded, deterministic injector that does all four, driven by
   table) and ``ChaosInjector.corrupt_csv_rows`` rewrites seeded lines
   of an on-disk CSV as garbage — both feed the quarantine layer
   (``ValidatingSource`` / the row-tolerant ``CSVRecordReader.read``).
+* **abuse-the-network-path** — ``SlowLorisClient`` opens a raw socket
+  to the HTTP gateway and drips the request body one tiny chunk at a
+  time (the classic connection-starvation attack); pins that the
+  gateway's TOTAL body-read deadline answers 408 in bounded time no
+  matter how slowly bytes arrive.  ``mid_body_disconnect`` sends the
+  headers plus a fraction of the declared body and hangs up — the
+  vanished-caller case the gateway must count and shrug off without
+  losing the connection thread.  ``kill_replica`` stops one engine of
+  a live ``Router`` replica set under traffic — the router must eject
+  it and drain requests to the survivors with only TYPED failures.
 
 Everything is parameterized by an explicit seed: a chaos failure must
 replay exactly.
@@ -67,9 +77,11 @@ replay exactly.
 from __future__ import annotations
 
 import random
+import select
+import socket
 import threading
 import time
-from typing import List, Optional
+from typing import List, Optional, Tuple
 
 import numpy as np
 
@@ -623,3 +635,121 @@ class NanSource:
 
     def __getattr__(self, name):
         return getattr(self.source, name)
+
+
+# -- network-path injectors (serve/gateway.py) --------------------------------
+
+_DEFAULT_LORIS_BODY = b'{"inputs": [[[0.0, 0.0]]]}'
+
+
+def _request_head(path: str, body_len: int, content_type: str) -> bytes:
+    return (f"POST {path} HTTP/1.1\r\n"
+            f"Host: chaos\r\n"
+            f"Content-Type: {content_type}\r\n"
+            f"Content-Length: {body_len}\r\n"
+            f"Connection: close\r\n\r\n").encode("ascii")
+
+
+def _read_status(sock: socket.socket) -> Optional[int]:
+    """Best-effort read of the response status line from a raw socket
+    (the peer may have closed already — that's a legitimate outcome
+    for an abusive client)."""
+    try:
+        sock.settimeout(2.0)
+        data = b""
+        while b"\r\n" not in data and len(data) < 4096:
+            chunk = sock.recv(1024)
+            if not chunk:
+                break
+            data += chunk
+        parts = data.split(b" ", 2)
+        return int(parts[1]) if len(parts) >= 2 else None
+    except (OSError, ValueError, IndexError):
+        return None
+
+
+class SlowLorisClient:
+    """Raw-socket client that sends complete headers declaring the full
+    ``Content-Length``, then drips the body ``drip_bytes`` at a time
+    every ``drip_interval_s`` — the connection-starvation abuse
+    pattern.  A per-recv socket timeout on the server is USELESS here
+    (every drip resets it); only a TOTAL body-read deadline bounds the
+    connection hold time, which is exactly what the test asserts:
+    ``run()`` returns as soon as the server answers (or resets), and
+    the elapsed time must be far below the full drip duration.
+
+    ``run(max_s)`` returns ``(status, elapsed_s, sent_bytes)`` —
+    ``status`` is the HTTP status the server managed to send (408 from
+    a well-behaved gateway) or None if the connection just died."""
+
+    def __init__(self, host: str, port: int, path: str = "/v1/generate",
+                 body: bytes = _DEFAULT_LORIS_BODY,
+                 content_type: str = "application/json",
+                 drip_bytes: int = 1, drip_interval_s: float = 0.1):
+        if drip_bytes <= 0 or drip_interval_s < 0:
+            raise ValueError("drip_bytes must be > 0 and "
+                             "drip_interval_s >= 0")
+        self.host = host
+        self.port = int(port)
+        self.path = path
+        self.body = bytes(body)
+        self.content_type = content_type
+        self.drip_bytes = int(drip_bytes)
+        self.drip_interval_s = float(drip_interval_s)
+
+    def run(self, max_s: float = 30.0
+            ) -> Tuple[Optional[int], float, int]:
+        t0 = time.monotonic()
+        sent = 0
+        status: Optional[int] = None
+        with socket.create_connection((self.host, self.port),
+                                      timeout=5.0) as sock:
+            sock.sendall(_request_head(self.path, len(self.body),
+                                       self.content_type))
+            while sent < len(self.body) \
+                    and time.monotonic() - t0 < max_s:
+                # an early answer (the 408) ends the abuse: a loris
+                # that keeps dripping into a closed window just eats
+                # a reset
+                readable, _, _ = select.select([sock], [], [], 0)
+                if readable:
+                    break
+                try:
+                    sock.sendall(
+                        self.body[sent:sent + self.drip_bytes])
+                    sent += self.drip_bytes
+                except OSError:  # gan4j-lint: disable=swallowed-exception — a server reset mid-drip IS a result for this injector: stop dripping and read whatever status the server managed to send
+                    break
+                time.sleep(self.drip_interval_s)
+            status = _read_status(sock)
+        return status, time.monotonic() - t0, min(sent, len(self.body))
+
+
+def mid_body_disconnect(host: str, port: int,
+                        path: str = "/v1/generate",
+                        body: bytes = _DEFAULT_LORIS_BODY,
+                        content_type: str = "application/json",
+                        frac: float = 0.5) -> int:
+    """Send complete headers declaring ``len(body)`` bytes, then only
+    ``frac`` of the body, then hang up — the vanished-caller case.
+    The gateway must count it and release the connection thread; there
+    is nobody left to answer.  Returns the body bytes actually sent."""
+    if not 0 <= frac < 1:
+        raise ValueError("frac must be in [0, 1)")
+    cut = int(len(body) * frac)
+    with socket.create_connection((host, port), timeout=5.0) as sock:
+        sock.sendall(_request_head(path, len(body), content_type))
+        if cut:
+            sock.sendall(body[:cut])
+    return cut
+
+
+def kill_replica(router, index: int):
+    """Stop one engine of a live ``Router`` replica set — the
+    mid-load replica death the router must absorb: the dead replica is
+    ejected on its next probe/submit and requests drain to the
+    survivors with only TYPED failures.  Returns the stopped engine
+    (restartable with ``engine.start()`` to exercise recovery)."""
+    eng = router.replicas[index]
+    eng.stop()
+    return eng

@@ -1,0 +1,454 @@
+"""Network front door (serve/gateway.py + router.py + client.py):
+the wire is transparent, the error contract is typed, and socket-level
+chaos degrades to typed failures — never a hang, never a leak.
+
+Correctness ground truth: the gateway is an ADAPTER, not a model — a
+request over the socket must return the SAME BYTES as calling
+``ServeEngine.submit`` directly.  Both wire formats make that exact:
+npy/npz are bit-exact by construction, and JSON is bit-exact because
+float32 -> float64 -> shortest-repr JSON -> float64 -> float32 is the
+identity.  The fleet-tenant version of the same pin: an HTTP request
+to ``/v1/tenants/{t}/generate`` is bit-equal to a
+``slice_tenant``-restored single model served directly (the
+tests/test_fleet.py slicing contract, extended over the socket).
+
+The perf contract rides along: the gateway pads nothing and dispatches
+through the same bucketed engines, so steady-state SOCKET traffic
+under an armed RecompileSentinel pays zero compiles.
+
+Replica notes: every replica here shares ONE ``ParallelInference``
+(one compiled bucket set for the whole module — a jitted dispatch is
+thread-safe, and replicas sharing identical params is exactly the
+load-balancing deployment), so the module pays the bucket compiles
+once no matter how many engines the chaos tests churn through.
+"""
+
+import json
+import threading
+import time
+from http.client import HTTPConnection
+
+import numpy as np
+import pytest
+
+from gan_deeplearning4j_tpu.models import dcgan_mnist as M
+from gan_deeplearning4j_tpu.parallel import data_mesh
+from gan_deeplearning4j_tpu.parallel.inference import ParallelInference
+from gan_deeplearning4j_tpu.serve import (
+    AdmissionQueue,
+    Gateway,
+    GatewayClient,
+    GatewayHTTPError,
+    Router,
+    ServeEngine,
+    run_socket_load,
+    z_inputs,
+)
+from gan_deeplearning4j_tpu.telemetry import MetricsRegistry
+from gan_deeplearning4j_tpu.testing.chaos import (
+    SlowLorisClient,
+    kill_replica,
+    mid_body_disconnect,
+)
+
+BUCKETS = (8, 32, 64)
+
+
+@pytest.fixture(scope="module")
+def gen_infer(cpu_devices):
+    """The module's ONE compiled dispatch (see module docstring)."""
+    gen = M.build_generator()
+    return ParallelInference(gen, mesh=data_mesh(8), buckets=BUCKETS)
+
+
+def _engine(gen_infer, admission=None):
+    eng = ServeEngine(infer=gen_infer, admission=admission,
+                      watchdog_deadline_s=30.0)
+    eng.warmup(np.zeros((1, 2), np.float32))
+    eng.start()
+    return eng
+
+
+@pytest.fixture(scope="module")
+def stack(gen_infer):
+    """A started 2-replica router behind a gateway, plus a client —
+    the steady-state fixture (the chaos tests that KILL replicas build
+    their own engines so this one stays healthy)."""
+    engines = [_engine(gen_infer) for _ in range(2)]
+    router = Router(replicas=engines, recheck_s=0.2)
+    gw = Gateway(router, read_timeout_s=1.0).start()
+    client = GatewayClient("127.0.0.1", gw.port, retries=2,
+                           backoff_s=0.02, seed=5)
+    yield gw, router, client
+    gw.stop()
+    router.stop()
+
+
+def _mk(rows, seed=0):
+    return np.random.RandomState(seed).rand(rows, 2).astype(
+        np.float32) * 2 - 1
+
+
+def _raw(gw, method, path, body=None, headers=()):
+    conn = HTTPConnection("127.0.0.1", gw.port, timeout=30.0)
+    try:
+        conn.request(method, path, body=body, headers=dict(headers))
+        resp = conn.getresponse()
+        return resp.status, dict(resp.getheaders()), resp.read()
+    finally:
+        conn.close()
+
+
+def test_roundtrip_bitequal_both_encodings(stack, gen_infer):
+    """A socket request returns the SAME BYTES as a direct engine
+    submit, for both wire formats — the gateway is transparent."""
+    gw, router, client = stack
+    for rows in (3, 8, 20):
+        z = _mk(rows, seed=40 + rows)
+        want = router.replicas[0].submit(z).result(timeout=120.0)
+        for encoding in ("json", "npy"):
+            got = client.generate([z], encoding=encoding)
+            assert len(got) == len(want)
+            for g, w in zip(got, want):
+                assert g.dtype == np.float32
+                assert np.array_equal(g, np.asarray(w)), encoding
+
+
+def test_healthz_ok_and_degraded_status(stack):
+    gw, router, client = stack
+    doc = client.healthz()
+    assert doc["_status"] == 200
+    blk = doc["gateway"]
+    assert blk["ok"] is True
+    assert blk["replicas"] == 2 and blk["replicas_healthy"] == 2
+
+
+def test_wire_error_contract(stack):
+    """The typed status-code map, end to end over the socket: 400
+    validation, 404 route/tenant, 405 method, 413 oversized-declared
+    (body never read).  Every reject carries a JSON ``type``."""
+    gw, router, client = stack
+
+    def err(status, *args, **kw):
+        s, h, data = _raw(gw, *args, **kw)
+        assert s == status, (args[1], s, data)
+        return json.loads(data.decode())["type"]
+
+    assert err(405, "GET", "/v1/generate") == "method"
+    assert err(404, "POST", "/v1/nothing",
+               body=b"x", headers=(("Content-Type",
+                                    "application/json"),)) == "route"
+    assert err(400, "POST", "/v1/generate") == "validation"  # no body
+    assert err(400, "POST", "/v1/generate", body=b"{nope",
+               headers=(("Content-Type",
+                         "application/json"),)) == "validation"
+    assert err(400, "POST", "/v1/generate", body=b"\x00" * 64,
+               headers=(("Content-Type",
+                         "application/x-npy"),)) == "validation"
+    # wrong trailing shape: rejected by the ENGINE's validation,
+    # mapped to 400 — and identically by every replica, so no eject
+    bad = json.dumps({"inputs": [[[0.0, 0.0, 0.0]]]}).encode()
+    assert err(400, "POST", "/v1/generate", body=bad,
+               headers=(("Content-Type",
+                         "application/json"),)) == "validation"
+    # declared-oversized: 413 from the HEADER, body never read
+    conn = HTTPConnection("127.0.0.1", gw.port, timeout=30.0)
+    try:
+        conn.putrequest("POST", "/v1/generate")
+        conn.putheader("Content-Type", "application/json")
+        conn.putheader("Content-Length", str(1 << 30))
+        conn.endheaders()
+        resp = conn.getresponse()
+        assert resp.status == 413
+        assert json.loads(resp.read().decode())["type"] == "validation"
+    finally:
+        conn.close()
+    # unknown tenant on a router with no fleet bank: 404, fail-fast
+    with pytest.raises(GatewayHTTPError) as ei:
+        client.generate([_mk(2)], tenant="7")
+    assert ei.value.status == 404
+    assert ei.value.error_type == "unknown_tenant"
+    # replicas unharmed by the abuse above
+    assert router.report()["replicas_healthy"] == 2
+
+
+def test_rate_limit_is_per_tenant(stack):
+    """The token bucket sits in FRONT of admission and is keyed by
+    tenant: exhausting tenant a's bucket 429s tenant a (with an
+    integral Retry-After) and costs tenant b nothing."""
+    gw0, router, _ = stack
+    with Gateway(router, rate_limit=(2.0, 0.25)) as gw:
+        body = json.dumps(
+            {"inputs": [_mk(2, seed=9).tolist()]}).encode()
+
+        def post(tenant):
+            return _raw(gw, "POST", "/v1/generate", body=body,
+                        headers=(("Content-Type", "application/json"),
+                                 ("X-Tenant", tenant)))
+
+        for _ in range(2):
+            s, _, _ = post("a")
+            assert s == 200
+        s, h, data = post("a")
+        assert s == 429
+        assert json.loads(data.decode())["type"] == "rate_limit"
+        assert float(h["Retry-After"]) >= 1.0
+        s, _, _ = post("b")                       # b is unaffected
+        assert s == 200
+        rep = gw.report()
+        assert rep["rejected_by_type"].get("rate_limit", 0) >= 1
+
+
+def test_zero_recompiles_under_socket_load(stack, recompile_sentinel):
+    """The closed-compiled-set contract holds over the WIRE: warm
+    buckets, arm the sentinel, then a Poisson mix through the real
+    socket (sizes spanning pad-up and exact buckets) pays zero
+    compiles and zero failures of any kind."""
+    gw, router, client = stack
+    recompile_sentinel.arm()
+    stats = run_socket_load(client, rate_rps=80.0, n_requests=25,
+                            make_inputs=z_inputs(2, seed=3),
+                            encoding="npy", seed=21)
+    assert stats["completed"] == 25
+    assert stats["shed"] == 0 and stats["unavailable"] == 0
+    assert stats["errors"] == 0 and stats["undrained"] == 0
+    # teardown: recompile_sentinel.check() proves zero compiles
+
+
+def test_slow_loris_bounded_and_typed(stack):
+    """A client dripping one byte per interval is answered 408 at the
+    TOTAL read deadline — not per-recv-reset forever — and the
+    connection thread is released (the next request is unaffected)."""
+    gw, router, client = stack
+    loris = SlowLorisClient("127.0.0.1", gw.port, drip_bytes=1,
+                            drip_interval_s=0.1)  # ~2.6s body at 0.1s/B
+    t0 = time.monotonic()
+    status, elapsed, sent = loris.run(max_s=15.0)
+    assert status == 408
+    # bounded by the 1.0s TOTAL read deadline — well under the ~2.6s
+    # the full drip would take (per-recv timers alone never fire)
+    assert elapsed < 2.0, elapsed
+    assert time.monotonic() - t0 < 10.0
+    assert gw.report()["rejected_by_type"].get("slow_body", 0) >= 1
+    out = client.generate([_mk(4, seed=1)])       # service unharmed
+    assert out[0].shape[0] == 4
+
+
+def test_mid_body_disconnect_absorbed(stack):
+    """A peer that vanishes mid-body is counted and absorbed: no
+    reply is owed, the thread is released, service continues."""
+    gw, router, client = stack
+    before = gw.report()["rejected_by_type"].get("disconnect", 0)
+    sent = mid_body_disconnect("127.0.0.1", gw.port)
+    assert sent > 0
+    deadline = time.monotonic() + 5.0             # handler is async
+    while time.monotonic() < deadline:
+        if gw.report()["rejected_by_type"].get(
+                "disconnect", 0) > before:
+            break
+        time.sleep(0.05)
+    assert gw.report()["rejected_by_type"].get(
+        "disconnect", 0) > before
+    out = client.generate([_mk(4, seed=2)])
+    assert out[0].shape[0] == 4
+
+
+def test_burst_sheds_429_p99_bounded_healthz_ok(gen_infer):
+    """The e2e acceptance: an over-capacity Poisson burst through the
+    REAL socket against a 2-replica router is shed with 429s (typed,
+    zero raw errors) while admitted p99 stays bounded and the
+    /healthz gateway block stays ok throughout."""
+    engines = [_engine(gen_infer,
+                       admission=AdmissionQueue(max_depth=8,
+                                                deadline_ms=400.0))
+               for _ in range(2)]
+    router = Router(replicas=engines, recheck_s=0.2)
+    registry = MetricsRegistry()
+    try:
+        with Gateway(router) as gw:
+            registry.observe_gateway(gw.report)
+            client = GatewayClient("127.0.0.1", gw.port, retries=0,
+                                   seed=13)  # fail fast: count sheds
+            for _ in range(3):                # prime the rate EWMA
+                client.generate([_mk(8, seed=3)], encoding="npy")
+            stats = run_socket_load(client, rate_rps=500.0,
+                                    n_requests=150,
+                                    make_inputs=z_inputs(2, seed=4),
+                                    encoding="npy", seed=31)
+            assert stats["shed"] >= 1         # over capacity: shed...
+            assert stats["completed"] >= 1    # ...but not a blackout
+            assert stats["errors"] == 0       # every failure TYPED
+            assert stats["unavailable"] == 0  # nothing died
+            assert stats["undrained"] == 0    # nothing hung
+            assert stats["p99_ms"] is not None
+            assert stats["p99_ms"] < 5000.0
+            # the wire counters made it to a real scrape
+            body = registry.render()
+            lines = dict(ln.split(" ", 1)
+                         for ln in body.splitlines()
+                         if ln.startswith("gan4j_gateway_"))
+            assert float(
+                lines["gan4j_gateway_requests_total"]) >= 150.0
+            assert float(lines["gan4j_gateway_rejected_total"]) >= 1.0
+            assert float(
+                lines["gan4j_gateway_replica_healthy"]) == 2.0
+            doc = registry.health()
+            assert doc["gateway"]["ok"] is True
+            assert doc["gateway"]["rejected_total"] >= 1
+            assert client.healthz()["_status"] == 200
+    finally:
+        router.stop()
+
+
+def test_kill_replica_drains_to_survivor(gen_infer):
+    """The chaos acceptance: killing a replica MID-LOAD yields zero
+    non-typed failures — the router ejects it, in-flight retries land
+    on the survivor, the load drains — and a restarted replica is
+    re-admitted after the recheck interval."""
+    engines = [_engine(gen_infer) for _ in range(2)]
+    router = Router(replicas=engines, recheck_s=0.2)
+    try:
+        with Gateway(router) as gw:
+            client = GatewayClient("127.0.0.1", gw.port, retries=3,
+                                   backoff_s=0.02, seed=17)
+            result = {}
+
+            def load():
+                result.update(run_socket_load(
+                    client, rate_rps=40.0, duration_s=2.0,
+                    make_inputs=z_inputs(2, seed=6),
+                    encoding="npy", seed=41))
+
+            t = threading.Thread(target=load,
+                                 name="gan4j-test-killload")
+            t.start()
+            time.sleep(0.5)
+            killed = kill_replica(router, 0)      # mid-load
+            t.join(timeout=120.0)
+            assert not t.is_alive()
+            assert result["errors"] == 0          # zero NON-typed
+            assert result["completed"] >= 1       # survivor served
+            assert result["undrained"] == 0       # full drain
+            rep = router.report()
+            assert rep["replicas_healthy"] == 1
+            assert rep["ejected_total"] >= 1
+            assert rep["ok"] is True              # degraded, not down
+            # recovery: restart the replica, wait out the recheck
+            killed.start()
+            deadline = time.monotonic() + 5.0
+            while (router.report()["replicas_healthy"] < 2
+                   and time.monotonic() < deadline):
+                time.sleep(0.05)
+            assert router.report()["replicas_healthy"] == 2
+            out = client.generate([_mk(4, seed=8)])
+            assert out[0].shape[0] == 4
+    finally:
+        router.stop()
+
+
+def test_exporter_gateway_series_precreated_and_live(stack):
+    """The gateway series exist at 0 from the FIRST scrape and the
+    /healthz gateway block is ALWAYS present; with a live feed the
+    scrape and the block carry the wire counters."""
+    fresh = MetricsRegistry()
+    body = fresh.render()
+    assert "gan4j_gateway_requests_total 0.0" in body
+    assert "gan4j_gateway_rejected_total 0.0" in body
+    assert "gan4j_gateway_active_connections 0.0" in body
+    assert "gan4j_gateway_replica_healthy 0.0" in body
+    doc = fresh.health()
+    assert doc["gateway"] == {"requests_total": 0, "rejected_total": 0,
+                              "active_connections": 0,
+                              "replicas_healthy": 0, "replicas": 0,
+                              "ok": True}
+    gw, router, client = stack
+    live = MetricsRegistry()
+    live.observe_gateway(gw.report)
+    client.generate([_mk(4, seed=12)])
+    body = live.render()
+    line = [ln for ln in body.splitlines()
+            if ln.startswith("gan4j_gateway_requests_total ")][0]
+    assert float(line.split()[1]) >= 1.0
+    doc = live.health()
+    assert doc["gateway"]["requests_total"] >= 1
+    assert doc["gateway"]["replicas"] == 2
+    assert doc["gateway"]["ok"] is True
+
+
+def test_fleet_tenant_http_bitequal_to_sliced_control(
+        cpu_devices, tmp_path):
+    """The fleet acceptance over the WIRE: after real (diverged)
+    fleet training steps and a checkpoint round-trip, an HTTP request
+    to ``/v1/tenants/{t}/generate`` returns outputs BIT-EQUAL to a
+    ``slice_tenant``-restored single model served directly — and
+    distinct tenants return distinct outputs (no cross-tenant leak).
+    The LRU bound holds and an out-of-range tenant is a typed 404
+    (jax index-clamping must never silently serve the last tenant)."""
+    import jax
+    import jax.numpy as jnp
+
+    from gan_deeplearning4j_tpu.models import mlpgan_insurance as I
+    from gan_deeplearning4j_tpu.runtime import prng
+    from gan_deeplearning4j_tpu.serve import FleetTenantBank
+    from gan_deeplearning4j_tpu.train import fleet as fleet_lib
+    from gan_deeplearning4j_tpu.train import fused_step as fused_lib
+
+    cfg = I.InsuranceConfig(seed=prng.NUMBER_OF_THE_BEAST)
+    dis = I.build_discriminator(cfg)
+    graphs = (dis, I.build_generator(cfg), I.build_gan(cfg),
+              I.build_classifier(dis, cfg))
+    maps = (I.DIS_TO_GAN, I.GAN_TO_GEN, I.DIS_TO_CLASSIFIER)
+    k = jax.random.key(7)
+    feats = jax.random.uniform(jax.random.fold_in(k, 0), (16, 12),
+                               dtype=jnp.float32)
+    ones = jnp.ones((16, 1), jnp.float32)
+    zeros = jnp.zeros((16, 1), jnp.float32)
+    root = prng.root_key()
+    fstep = fleet_lib.make_fleet_step(
+        *graphs, *maps, z_size=cfg.z_size,
+        num_features=cfg.num_features, donate=False)
+    fstate = fleet_lib.replicate_state(
+        fused_lib.state_from_graphs(*graphs), 3)
+    zks = fleet_lib.tenant_keys(prng.stream(root, "fleet-z"), 3)
+    rks = fleet_lib.tenant_keys(prng.stream(root, "fleet-rng"), 3)
+    for _ in range(2):                     # diverge the tenants
+        fstate, _ = fstep(fstate, feats, ones, zks, rks,
+                          ones, zeros, ones)
+    ck = fleet_lib.FleetCheckpointer(str(tmp_path))
+    ck.save(2, fstate)
+
+    bank = FleetTenantBank(lambda: I.build_generator(cfg),
+                           checkpointer=ck, mesh=data_mesh(1),
+                           buckets=(8,), max_live=2)
+    router = Router(tenants=bank)
+    try:
+        with Gateway(router) as gw:
+            client = GatewayClient("127.0.0.1", gw.port, retries=1)
+            z = _mk(4, seed=3)
+            # control: slice_tenant-restored single model, direct
+            ctrl_graph = I.build_generator(cfg)
+            ctrl_graph.params = fleet_lib.slice_tenant(
+                fstate, 1).gen_params
+            ctrl = ServeEngine(infer=ParallelInference(
+                ctrl_graph, mesh=data_mesh(1), buckets=(8,)),
+                supervise=False)
+            ctrl.warmup(np.zeros((1, 2), np.float32))
+            with ctrl:
+                want = ctrl.submit(z).result(timeout=120.0)
+            for encoding in ("json", "npy"):
+                got = client.generate([z], tenant="1",
+                                      encoding=encoding)
+                assert len(got) == len(want)
+                for g, w in zip(got, want):
+                    assert np.array_equal(g, np.asarray(w)), encoding
+            other = client.generate([z], tenant="0", encoding="npy")
+            assert not np.array_equal(other[0], got[0])
+            with pytest.raises(GatewayHTTPError) as ei:
+                client.generate([z], tenant="99")
+            assert ei.value.status == 404
+            assert ei.value.error_type == "unknown_tenant"
+            client.generate([z], tenant="2", encoding="npy")
+            assert bank.live_count() == 2      # LRU bound held
+            assert router.report()["tenants_live"] == 2
+    finally:
+        router.stop()

@@ -266,7 +266,15 @@ def test_chunked_metrics_match_per_step(tmp_path):
     for a, b in zip(recs["2"], recs["1"]):
         assert a["step"] == b["step"]
         for key in ("d_loss", "g_loss", "classifier_loss"):
-            assert a[key] == b[key], (a["step"], key)
+            # ulp-scale bound, not bitwise: the K>1 scanned multistep
+            # and the K=1 per-step program are the same math, but XLA
+            # fuses (and thus orders) the f32 loss reductions
+            # differently across the two traced programs — observed
+            # drift is ~2e-7 relative (a few float32 ulps), same
+            # fusion-order class as the batch-position caveat pinned
+            # in tests/test_serve.py.
+            assert a[key] == pytest.approx(b[key], rel=2e-5), (
+                a["step"], key)
 
 
 @pytest.mark.slow
@@ -312,13 +320,22 @@ def test_stream_chunked_matches_resident_and_per_step(tmp_path):
             for key in ("d_loss", "g_loss", "classifier_loss"):
                 assert a[key] == pytest.approx(b[key], rel=2e-5), (
                     mode, a["step"], key)
-    # artifacts bitwise identical across all three data paths
+    # artifacts numerically identical across all three data paths (not
+    # bitwise: the K>1 scanned multistep and the K=1 per-step dispatch
+    # are the same math, but XLA fuses the f32 reductions differently
+    # across the two traced programs — the fusion-order class pinned in
+    # tests/test_serve.py).  The per-step drift is ~2e-7 (a few float32
+    # ulps) but it lands in the WEIGHTS, so four training steps
+    # compound it: observed max ~8e-5 relative in the step-4 grid dump
+    # — hence the 2e-4 band, tight against the observation, nowhere
+    # near a real divergence (which grows without bound).
     for f in ["insurance_out_2.csv", "insurance_out_4.csv",
               "insurance_test_predictions_4.csv"]:
-        want = open(os.path.join(str(tmp_path / "resident"), f), "rb").read()
+        want = read_csv_matrix(os.path.join(str(tmp_path / "resident"), f))
         for mode in ("chunked", "perstep"):
-            got = open(os.path.join(str(tmp_path / mode), f), "rb").read()
-            assert got == want, (mode, f)
+            got = read_csv_matrix(os.path.join(str(tmp_path / mode), f))
+            np.testing.assert_allclose(
+                got, want, rtol=2e-4, atol=1e-6, err_msg=f"{mode}/{f}")
 
 
 @pytest.mark.slow
