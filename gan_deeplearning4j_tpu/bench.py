@@ -833,7 +833,8 @@ def fleet_bench(sweep=FLEET_SWEEP, flagship: int = FLEET_FLAGSHIP,
 def serve_bench(start_rps: float = 50.0, stage_s: float = 2.0,
                 repeats: int = 5, load_frac: float = 0.8,
                 growth: float = 1.6, max_stages: int = 12,
-                seed: int = 0, gateway: bool = False) -> dict:
+                seed: int = 0, gateway: bool = False,
+                mesh: bool = False) -> dict:
     """The serving bench of record (serve/): ramp an open-loop Poisson
     load to the engine's saturation throughput, then measure p50/p95/
     p99 request latency over ``repeats`` stages at ``load_frac`` of
@@ -849,6 +850,13 @@ def serve_bench(start_rps: float = 50.0, stage_s: float = 2.0,
     series: one replica over the SAME compiled dispatch, so
     gateway-p50 minus serve-p50 IS the wire cost (parse + validate +
     route + encode + loopback TCP), not a different model.
+
+    ``mesh=True`` measures the operating point once more through the
+    MESH TIER (serve/replica.py + mesh.py): the same load balanced
+    over TWO standalone replica PROCESSES by ``MeshRouter`` — the
+    regression-gated "mesh" series, so mesh-p50 vs gateway-p50 is the
+    cost/benefit of going multi-process (two GILs and two dispatch
+    loops vs one, against per-process compile caches).
     """
     import statistics
 
@@ -949,6 +957,42 @@ def serve_bench(start_rps: float = 50.0, stage_s: float = 2.0,
             finally:
                 router.stop()
             out["gateway_slo_stages"] = gw_stages
+    mesh_stages = []
+    if mesh:
+        # the mesh-tier A/B: the same SLO rate balanced over TWO
+        # replica PROCESSES — each compiles its own programs, so this
+        # stage runs outside the sentinel (the zero-recompile claim
+        # for a replica process lives in ITS dryrun/tests, not here)
+        import tempfile as _tempfile
+
+        from gan_deeplearning4j_tpu.serve import (
+            MeshRouter,
+            ReplicaLauncher,
+            RemoteReplica,
+            run_socket_load,
+        )
+        m_router = MeshRouter(recheck_s=1.0)
+        m_procs = []
+        with _tempfile.TemporaryDirectory(
+                prefix="gan4j_meshbench_") as m_logs:
+            launcher = ReplicaLauncher(buckets=buckets,
+                                       log_dir=m_logs)
+            try:
+                for _ in range(2):
+                    proc = launcher.spawn()
+                    m_procs.append(proc)
+                    m_router.add(RemoteReplica(proc.host, proc.port))
+                for i in range(max(1, repeats)):
+                    mesh_stages.append(run_socket_load(
+                        m_router, rate, duration_s=stage_s,
+                        make_inputs=make_inputs,
+                        encoding="npy", seed=seed + 300 + i))
+                out["mesh_report"] = m_router.report()
+            finally:
+                m_router.close()
+                for proc in m_procs:
+                    proc.stop()
+        out["mesh_slo_stages"] = mesh_stages
     p50s = [s["p50_ms"] for s in stages if s["p50_ms"] is not None]
     p99s = [s["p99_ms"] for s in stages if s["p99_ms"] is not None]
     if p50s:
@@ -1005,6 +1049,36 @@ def serve_bench(start_rps: float = 50.0, stage_s: float = 2.0,
             [s["p99_ms"] for s in gw_stages
              if s["p99_ms"] is not None]), 4) if gw_stages else None
         out["gateway_errors"] = sum(s["errors"] for s in gw_stages)
+    m50s = [s["p50_ms"] for s in mesh_stages
+            if s["p50_ms"] is not None]
+    if m50s:
+        m_med = statistics.median(m50s)
+        if len(m50s) >= 2:
+            q1, _, q3 = statistics.quantiles(
+                m50s, n=4, method="inclusive")
+            m_iqr = q3 - q1
+        else:
+            m_iqr = 0.0
+        # the gate-compatible "mesh" series: request p50 at the same
+        # SLO operating point, balanced over two replica processes
+        out["mesh"] = {
+            "multistep_step_ms": round(m_med, 4),
+            "spread": {
+                "median_ms": round(m_med, 4),
+                "iqr_ms": round(m_iqr, 4),
+                "min_ms": round(min(m50s), 4),
+                "max_ms": round(max(m50s), 4),
+                "repeats": len(m50s),
+                "window_calls": [
+                    min(s["completed"] for s in mesh_stages),
+                    max(s["completed"] for s in mesh_stages)],
+                "window_steps_per_call": 1,
+            },
+        }
+        out["mesh_p99_ms"] = round(statistics.median(
+            [s["p99_ms"] for s in mesh_stages
+             if s["p99_ms"] is not None]), 4) if mesh_stages else None
+        out["mesh_errors"] = sum(s["errors"] for s in mesh_stages)
     out["post_warmup_recompiles"] = len(sentinel.recompiles)
     out["regression_gate"] = bench_gate.check_against_lastgood(
         out, os.path.join(os.path.dirname(BASELINE_PATH),
@@ -1544,6 +1618,52 @@ def dryrun(telemetry: bool = True,
                             "multistep_step_ms": round(g_p50, 4),
                             "spread": {"median_ms": round(g_p50, 4),
                                        "iqr_ms": 0.0}}})
+                # the mesh tier (serve/replica.py + mesh.py +
+                # controlplane.py): a REAL control plane spawning
+                # replica PROCESSES — min 1, hair-trigger autoscaler
+                # so the smoke exercises one genuine scale-up — then
+                # finite generates routed over their sockets; both
+                # reports feed the exporter so the scrape below must
+                # carry the gan4j_mesh_*/gan4j_controlplane_* series
+                # and the serving_mesh/controlplane /healthz blocks
+                with events_mod.span("bench.mesh"):
+                    from gan_deeplearning4j_tpu.serve import (
+                        Autoscaler,
+                        ControlPlane,
+                        MeshRouter,
+                        ReplicaLauncher,
+                    )
+                    m_mesh = MeshRouter(recheck_s=0.5)
+                    m_outs = []
+                    with tempfile.TemporaryDirectory(
+                            prefix="gan4j_mesh_") as m_logs:
+                        m_cp = ControlPlane(
+                            ReplicaLauncher(
+                                buckets=(8,), log_dir=m_logs,
+                                env={"JAX_PLATFORMS": "cpu"}),
+                            mesh=m_mesh,
+                            autoscaler=Autoscaler(
+                                min_replicas=1, max_replicas=2,
+                                up_queue_depth=0.0, up_after=1,
+                                down_after=10_000, cooldown_ticks=2),
+                            tick_s=0.25)
+                        try:
+                            m_cp.start()
+                            m_deadline = time.monotonic() + 90.0
+                            while (time.monotonic() < m_deadline
+                                   and len(m_cp.replica_names()) < 2):
+                                time.sleep(0.2)
+                            for _ in range(3):
+                                m_outs.append(m_mesh.generate(
+                                    [_np.zeros((4, 2),
+                                               _np.float32)])[0])
+                            mesh_rec = m_mesh.report()
+                            cp_rec = m_cp.report()
+                        finally:
+                            m_cp.stop()
+                            m_mesh.close()
+                    registry.observe_serving_mesh(lambda: mesh_rec)
+                    registry.observe_controlplane(lambda: cp_rec)
                 # one record through the registry feed, then a REAL
                 # scrape over the socket: the CI assertion that the
                 # exporter answers with the step/goodput/NaN series
@@ -1675,6 +1795,38 @@ def dryrun(telemetry: bool = True,
                     and gateway_blk.get("requests_total", 0) >= 12
                     and gateway_blk.get("replicas_healthy") == 1
                     and gateway_blk.get("ok") is True)
+                # mesh-tier surface: the control plane spawned the
+                # fleet (one GENUINE scale event past min_replicas),
+                # every routed generate over the real sockets came
+                # back finite, zero tick-loop errors (every failure
+                # typed and handled), the gan4j_mesh_* /
+                # gan4j_controlplane_* series live in the scrape, and
+                # both /healthz blocks healthy
+                mesh_blk = health.get("serving_mesh")
+                cp_blk = health.get("controlplane")
+                mesh_ok = (
+                    mesh_rec["replicas"] == 2
+                    and mesh_rec["replicas_healthy"] == 2
+                    and mesh_rec["ok"] is True
+                    and cp_rec["scale_up_total"] >= 1
+                    and cp_rec["tick_errors_total"] == 0
+                    and cp_rec["ok"] is True
+                    and len(m_outs) == 3
+                    and all(bool(_np.isfinite(o).all())
+                            for o in m_outs)
+                    and "gan4j_mesh_replicas " in m_body
+                    and "gan4j_mesh_replicas_healthy " in m_body
+                    and "gan4j_mesh_ejected_total " in m_body
+                    and "gan4j_controlplane_replicas " in m_body
+                    and "gan4j_controlplane_scale_events_total "
+                    in m_body
+                    and "gan4j_controlplane_rollbacks_total " in m_body
+                    and isinstance(mesh_blk, dict)
+                    and mesh_blk.get("replicas") == 2
+                    and mesh_blk.get("ok") is True
+                    and isinstance(cp_blk, dict)
+                    and cp_blk.get("replicas") == 2
+                    and cp_blk.get("ok") is True)
                 recorder.flush()
                 try:
                     events_ok = len(events_mod.read_events(
@@ -1693,7 +1845,7 @@ def dryrun(telemetry: bool = True,
                            and lint["ok"] and sanitizer["ok"]
                            and prove["ok"] and race_ok
                            and bench_stable_ok and fleet_ok
-                           and serve_ok and gateway_ok),
+                           and serve_ok and gateway_ok and mesh_ok),
                 "platform": device.platform,
                 "telemetry": telemetry,
                 "checkpoint": ckpt,
@@ -1715,6 +1867,9 @@ def dryrun(telemetry: bool = True,
                 "serve": serve_rec,
                 "gateway_ok": bool(gateway_ok),
                 "gateway": gw_rec,
+                "mesh_ok": bool(mesh_ok),
+                "mesh": mesh_rec,
+                "controlplane": cp_rec,
                 "bench_stable_ok": bool(bench_stable_ok),
                 "bench_spread": spread,
                 "watchdog_beat_us": round(beat_us, 3)}
@@ -1780,6 +1935,14 @@ def main(argv=None) -> None:
                         "(serve/gateway.py) — publishing the "
                         "regression-gated 'gateway' series; the p50 "
                         "delta vs the 'serve' series is the wire cost")
+    p.add_argument("--mesh", action="store_true",
+                   help="(with --serve) measure the SLO operating "
+                        "point once more through the MESH TIER — the "
+                        "same load balanced over two standalone "
+                        "replica processes by MeshRouter "
+                        "(serve/replica.py + mesh.py) — publishing "
+                        "the regression-gated 'mesh' series; the p50 "
+                        "delta vs 'gateway' is the multi-process cost")
     p.add_argument("--fleet", action="store_true",
                    help="multi-tenant fleet bench of record "
                         "(train/fleet.py): sweep tenant counts as "
@@ -1899,7 +2062,8 @@ def main(argv=None) -> None:
             stage_s=args.serve_stage_s,
             repeats=args.serve_repeats,
             load_frac=args.serve_load_frac,
-            gateway=args.gateway)))
+            gateway=args.gateway,
+            mesh=args.mesh)))
         return
     if args.fleet_stage is not None:
         print(json.dumps(fleet_stage_time(

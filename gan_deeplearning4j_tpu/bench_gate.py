@@ -37,6 +37,7 @@ SERIES: Tuple[Tuple[str, Tuple[str, ...]], ...] = (
     ("fleet", ("fleet",)),
     ("serve", ("serve",)),
     ("gateway", ("gateway",)),
+    ("mesh", ("mesh",)),
 )
 
 # Tolerance floor: 5% — the day-to-day jitter of a healthy capture on

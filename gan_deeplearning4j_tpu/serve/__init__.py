@@ -10,6 +10,14 @@ that measures p50/p95/p99 and saturation throughput (``bench
 gateway (``gateway.py``) over a health-aware replica/tenant router
 (``router.py``) with a retrying reference client (``client.py``) —
 ``bench --serve --gateway``, docs/SERVING.md "Network front door".
+
+Above the single process sits the mesh tier: replicas as standalone
+PROCESSES (``replica.py``), a router over their HTTP surfaces with
+typed ejection and bounded re-probe (``mesh.py``), and the
+self-healing control plane (``controlplane.py``) — autoscaling with
+hysteresis, dead-replica replacement, and budgeted canary/promote/
+rollback weight deployments — docs/SERVING.md "Mesh and control
+plane".
 """
 
 from gan_deeplearning4j_tpu.serve.admission import (
@@ -21,6 +29,15 @@ from gan_deeplearning4j_tpu.serve.client import (
     GatewayClient,
     GatewayHTTPError,
 )
+from gan_deeplearning4j_tpu.serve.controlplane import (
+    Autoscaler,
+    CanaryDeployment,
+    ControlPlane,
+    DeploymentRollbackError,
+    ReplicaLauncher,
+    ReplicaProcess,
+    ReplicaSpawnError,
+)
 from gan_deeplearning4j_tpu.serve.engine import DispatchError, ServeEngine
 from gan_deeplearning4j_tpu.serve.gateway import Gateway, TokenBucket
 from gan_deeplearning4j_tpu.serve.loadgen import (
@@ -30,6 +47,11 @@ from gan_deeplearning4j_tpu.serve.loadgen import (
     run_socket_load,
     z_inputs,
 )
+from gan_deeplearning4j_tpu.serve.mesh import (
+    MeshRouter,
+    RemoteReplica,
+    ReplicaProbeError,
+)
 from gan_deeplearning4j_tpu.serve.router import (
     FleetTenantBank,
     NoHealthyReplicaError,
@@ -38,12 +60,22 @@ from gan_deeplearning4j_tpu.serve.router import (
 
 __all__ = [
     "AdmissionQueue",
+    "Autoscaler",
+    "CanaryDeployment",
+    "ControlPlane",
+    "DeploymentRollbackError",
     "DispatchError",
     "FleetTenantBank",
     "Gateway",
     "GatewayClient",
     "GatewayHTTPError",
+    "MeshRouter",
     "NoHealthyReplicaError",
+    "RemoteReplica",
+    "ReplicaLauncher",
+    "ReplicaProbeError",
+    "ReplicaProcess",
+    "ReplicaSpawnError",
     "Request",
     "Router",
     "ServeEngine",

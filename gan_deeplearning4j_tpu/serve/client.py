@@ -9,10 +9,17 @@ request right now"), honoring the server's ``Retry-After`` hint when it
 is larger than the computed backoff.  Everything else (400, 404, 413…)
 is a caller bug or a routing miss and fails fast on the first answer.
 
-Stdlib-only (``http.client``), one connection per request — the client
-is deliberately boring so the loadgen numbers measure the GATEWAY, not
-a connection-pool implementation.  Jitter comes from a seeded
-``random.Random`` so tests and the bench are reproducible.
+Stdlib-only (``http.client``) with a BOUNDED keep-alive pool: the
+gateway speaks HTTP/1.1 with a Content-Length on every reply, so a
+connection survives across requests and the ~80ms+ per-request
+connect cost (BENCH_GATEWAY_r09) is paid once, not per call.  A
+pooled socket can be stale (server restarted, idle timeout) — the
+first transport error on a REUSED connection gets exactly one typed
+reconnect on a fresh socket (counted in ``reconnects_total``) before
+the retry policy sees anything; fresh-socket failures propagate
+immediately, so retry storms no longer amplify connection churn.
+Jitter comes from a seeded ``random.Random`` so tests and the bench
+are reproducible.
 
 Wire formats (mirrors serve/gateway.py):
 
@@ -32,9 +39,11 @@ from __future__ import annotations
 import io
 import json
 import random
+import threading
 import time
+from collections import deque
 from http.client import HTTPConnection, HTTPException
-from typing import Dict, List, Optional, Sequence
+from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -89,12 +98,18 @@ class GatewayClient:
     loadgen's shed-counting mode).  ``backoff_s`` doubles per attempt
     (times ``backoff_mult``) with multiplicative jitter in
     ``[1, 1+jitter]``; a server ``Retry-After`` overrides the computed
-    backoff when larger.  ``seed`` makes the jitter reproducible."""
+    backoff when larger.  ``seed`` makes the jitter reproducible.
+    ``pool_size`` bounds the idle keep-alive pool (0 disables reuse);
+    ``reused_total`` / ``reconnects_total`` count pool hits and typed
+    stale-socket reconnects.  ``close()`` drains the pool."""
 
     def __init__(self, host: str, port: int, *,
                  retries: int = 3, backoff_s: float = 0.05,
                  backoff_mult: float = 2.0, jitter: float = 0.5,
-                 timeout_s: float = 60.0, seed: int = 0):
+                 timeout_s: float = 60.0, seed: int = 0,
+                 pool_size: int = 4):
+        if pool_size < 0:
+            raise ValueError("pool_size must be >= 0")
         self.host = host
         self.port = int(port)
         self.retries = int(retries)
@@ -102,25 +117,92 @@ class GatewayClient:
         self.backoff_mult = float(backoff_mult)
         self.jitter = float(jitter)
         self.timeout_s = float(timeout_s)
+        self.pool_size = int(pool_size)
         self._rng = random.Random(seed)
+        self._pool_lock = threading.Lock()
+        self._idle: deque = deque()
+        self._pool_closed = False
         self.retried_total = 0
+        self.reused_total = 0
+        self.reconnects_total = 0
+
+    # -- connection pool -------------------------------------------------------
+
+    def _checkout(self) -> Tuple[HTTPConnection, bool]:
+        """Pop an idle keep-alive connection, else make a fresh one
+        (``HTTPConnection`` connects lazily — no socket I/O here)."""
+        with self._pool_lock:
+            if self._idle:
+                self.reused_total += 1
+                return self._idle.popleft(), True
+        return (HTTPConnection(self.host, self.port,
+                               timeout=self.timeout_s), False)
+
+    def _checkin(self, conn: HTTPConnection) -> None:
+        """Return a healthy connection to the pool, or close it when
+        the pool is full/closed (the close happens OUTSIDE the lock)."""
+        surplus = None
+        with self._pool_lock:
+            if self._pool_closed or len(self._idle) >= self.pool_size:
+                surplus = conn
+            else:
+                self._idle.append(conn)
+        if surplus is not None:
+            surplus.close()
+
+    def close(self) -> None:
+        """Close every pooled connection and refuse further pooling
+        (requests still work — they just run connection-per-call)."""
+        with self._pool_lock:
+            self._pool_closed = True
+            taken = list(self._idle)
+            self._idle.clear()
+        for conn in taken:
+            conn.close()
+
+    def __enter__(self) -> "GatewayClient":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
 
     # -- low-level -------------------------------------------------------------
 
     def _request(self, method: str, path: str, body: Optional[bytes],
                  content_type: Optional[str]):
-        conn = HTTPConnection(self.host, self.port,
-                              timeout=self.timeout_s)
+        headers = {}
+        if content_type is not None:
+            headers["Content-Type"] = content_type
+        conn, reused = self._checkout()
         try:
-            headers = {}
-            if content_type is not None:
-                headers["Content-Type"] = content_type
-            conn.request(method, path, body=body, headers=headers)
-            resp = conn.getresponse()
-            data = resp.read()
-            return (resp.status, dict(resp.getheaders()), data)
-        finally:
+            try:
+                conn.request(method, path, body=body, headers=headers)
+                resp = conn.getresponse()
+                data = resp.read()
+            except (ConnectionError, HTTPException, OSError):
+                conn.close()
+                if not reused:
+                    raise
+                # a pooled socket can be stale (server restarted, idle
+                # timeout): exactly ONE typed reconnect on a fresh
+                # socket; a second failure is a real transport error
+                # and propagates to the retry policy
+                with self._pool_lock:
+                    self.reconnects_total += 1
+                conn = HTTPConnection(self.host, self.port,
+                                      timeout=self.timeout_s)
+                reused = False
+                conn.request(method, path, body=body, headers=headers)
+                resp = conn.getresponse()
+                data = resp.read()
+        except BaseException:
             conn.close()
+            raise
+        if resp.will_close:
+            conn.close()
+        else:
+            self._checkin(conn)
+        return (resp.status, dict(resp.getheaders()), data)
 
     def _raise(self, status: int, headers: Dict, data: bytes) -> None:
         retry_after = None
@@ -172,7 +254,8 @@ class GatewayClient:
             time.sleep(wait)
             backoff *= self.backoff_mult
             attempt += 1
-            self.retried_total += 1
+            with self._pool_lock:
+                self.retried_total += 1
 
     # -- API -------------------------------------------------------------------
 

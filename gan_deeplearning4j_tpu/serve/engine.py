@@ -220,21 +220,74 @@ class ServeEngine:
         compiled programs)."""
         self._refresh.set()
 
-    def hotswap_from(self, directory: str, name: str = "gen") -> int:
+    def hotswap_from(self, directory: str, name: str = "gen", *,
+                     step: Optional[int] = None,
+                     max_step: Optional[int] = None) -> int:
         """Load the newest VERIFIED checkpoint under ``directory`` into
         the served graph, then flag the refresh.  Returns the restored
-        step.  Raises ``NoVerifiedCheckpointError`` when nothing
-        verifiable exists (the engine keeps serving the old weights)."""
+        step.
+
+        A corrupt/unverifiable newest checkpoint is SKIPPED — with a
+        ``serve.hotswap_rejected`` event naming the step and why — and
+        the walk falls back to the newest verified one, so a torn save
+        landing mid-swap degrades the swap to the previous weights
+        instead of failing it.  Raises ``NoVerifiedCheckpointError``
+        when nothing verifiable exists (the engine keeps serving the
+        old weights).  Structure mismatches (``ValueError``) are a
+        caller bug, not corruption, and always propagate.
+
+        ``step``: explicit pin — verification failure raises
+        ``CheckpointCorruptError``, no silent substitution (the
+        checkpointer's explicit-step contract).  ``max_step``: bound
+        the newest-first walk (the control plane's rollback path
+        restores strictly at-or-below the last known-good step)."""
         from gan_deeplearning4j_tpu.checkpoint.checkpointer import (
+            NoVerifiedCheckpointError,
             TrainCheckpointer,
         )
 
         ckpt = TrainCheckpointer(directory)
-        with self._swap_lock:
-            step, _ = ckpt.restore({name: self._infer.graph})
-        self.refresh()
-        events.instant("serve.hotswap", step=step, directory=directory)
-        return step
+        if step is not None:
+            with self._swap_lock:
+                got, _ = ckpt.restore({name: self._infer.graph},
+                                      step=step)
+            self.refresh()
+            events.instant("serve.hotswap", step=got,
+                           directory=directory)
+            return got
+        candidates = ckpt.steps()
+        if max_step is not None:
+            candidates = [s for s in candidates if s <= max_step]
+        for s in reversed(candidates):
+            # verify OUTSIDE the swap lock (sha256 over every file);
+            # only the in-place load itself excludes the dispatch
+            # loop's re-snapshot
+            if not ckpt.verify(s):
+                events.instant("serve.hotswap_rejected", step=s,
+                               directory=directory,
+                               reason="fails manifest verification "
+                                      "(torn or corrupt)")
+                continue
+            try:
+                with self._swap_lock:
+                    got, _ = ckpt.restore({name: self._infer.graph},
+                                          step=s)
+            except ValueError:
+                raise  # structure mismatch: fatal, not corruption
+            except Exception as e:  # unreadable despite the manifest
+                events.instant("serve.hotswap_rejected", step=s,
+                               directory=directory,
+                               reason=f"failed to load: {e!r}")
+                continue
+            self.refresh()
+            events.instant("serve.hotswap", step=got,
+                           directory=directory)
+            return got
+        raise NoVerifiedCheckpointError(
+            f"no VERIFIED checkpoint in {directory}"
+            + (f" at or below step {max_step}"
+               if max_step is not None else "")
+            + f" (candidates: {candidates})")
 
     # -- lifecycle -------------------------------------------------------------
 

@@ -13,7 +13,14 @@ Endpoints:
 * ``POST /v1/tenants/{id}/generate`` — the tenant's own fleet-sliced
   model.
 * ``GET /healthz`` — the gateway block (200 when the router has a
-  healthy replica, 503 otherwise).
+  healthy replica, 503 otherwise).  With a ``serve_report`` hook
+  configured (the replica process), the reply carries a ``serve``
+  block too and the status folds it in — the mesh probe's one-GET
+  health read (serve/mesh.py).
+* ``POST /admin/{name}`` — operator verbs registered via the
+  ``admin`` hook dict (the replica process registers ``hotswap`` and
+  ``chaos/wedge``): JSON params in, JSON result out, the same typed
+  status mapping (400 validation / 404 unknown / 503 failed).
 
 Error contract (the typed engine failures mapped to the wire):
 
@@ -50,7 +57,7 @@ import math
 import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from typing import Dict, List, Optional, Tuple
+from typing import Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 
@@ -65,6 +72,7 @@ from gan_deeplearning4j_tpu.train.watchdog import WatchdogTimeout
 
 _GENERATE = "/v1/generate"
 _TENANT_PREFIX = "/v1/tenants/"
+_ADMIN_PREFIX = "/admin/"
 
 
 class _SlowBody(Exception):
@@ -242,10 +250,9 @@ class _Handler(BaseHTTPRequestHandler):
 
     def do_GET(self):
         if self.path == "/healthz":
-            block = self.gateway.health_block()
-            self._reply(200 if block["ok"] else 503,
-                        json.dumps({"gateway": block},
-                                   indent=2).encode("utf-8"),
+            status, doc = self.gateway.health_doc()
+            self._reply(status,
+                        json.dumps(doc, indent=2).encode("utf-8"),
                         "application/json")
             return
         if self.path == _GENERATE or (
@@ -253,10 +260,85 @@ class _Handler(BaseHTTPRequestHandler):
                 and self.path.endswith("/generate")):
             self._reply_error(405, "method", "generate is POST-only")
             return
+        if self.path.startswith(_ADMIN_PREFIX):
+            self._reply_error(405, "method", "admin verbs are POST-only")
+            return
         self._reply_error(404, "route", f"no route {self.path}")
+
+    def _do_admin(self):
+        """``POST /admin/{name}``: JSON params in, JSON result out,
+        dispatched to the ``admin`` hook dict.  Typed mapping mirrors
+        generate: ``ValueError`` → 400, ``FileNotFoundError`` (incl.
+        ``NoVerifiedCheckpointError``) → 404, ``RuntimeError``/
+        ``OSError`` → 503.  Handlers run on THIS connection thread with
+        no gateway lock held — a slow hotswap costs one thread, not
+        the listener."""
+        name = self.path[len(_ADMIN_PREFIX):]
+        handler = self.gateway._admin_handler(name)
+        if handler is None:
+            self._reply_error(404, "route",
+                              f"no admin route {self.path}")
+            return
+        raw_len = self.headers.get("Content-Length")
+        try:
+            length = int(raw_len) if raw_len is not None else 0
+        except ValueError:
+            self._reply_error(400, "validation",
+                              "bad Content-Length")
+            return
+        if length > self.gateway.max_body_bytes:
+            self._reply_error(
+                413, "validation",
+                f"declared body of {length} bytes exceeds the "
+                f"{self.gateway.max_body_bytes} byte bound")
+            self.close_connection = True
+            return
+        params: Dict = {}
+        if length > 0:
+            try:
+                body = self._read_body(length)
+            except _SlowBody:
+                self._reply_error(
+                    408, "slow_body",
+                    f"request body did not arrive within "
+                    f"{self.gateway.read_timeout_s:.1f}s")
+                self.close_connection = True
+                return
+            except _Disconnect:
+                self.gateway._count_rejected(0, "disconnect")
+                self.close_connection = True
+                return
+            try:
+                params = json.loads(body.decode("utf-8"))
+            except (ValueError, UnicodeDecodeError) as e:
+                self._reply_error(400, "validation",
+                                  f"admin body is not valid JSON: {e}")
+                return
+            if not isinstance(params, dict):
+                self._reply_error(400, "validation",
+                                  "admin body must be a JSON object")
+                return
+        try:
+            result = handler(params)
+        except ValueError as e:
+            self._reply_error(400, "validation", str(e))
+            return
+        except FileNotFoundError as e:
+            self._reply_error(404, "not_found", str(e))
+            return
+        except (RuntimeError, OSError) as e:
+            self._reply_error(503, "admin_failed", str(e),
+                              retry_after=1.0)
+            return
+        self._reply(200,
+                    json.dumps({"result": result}).encode("utf-8"),
+                    "application/json")
 
     def do_POST(self):
         tenant: Optional[str] = None
+        if self.path.startswith(_ADMIN_PREFIX):
+            self._do_admin()
+            return
         if self.path == _GENERATE:
             # the limiter key for untenanted traffic: the declared
             # tenant header when present, else one shared bucket
@@ -373,15 +455,26 @@ class Gateway:
     (the slow-loris bound).  ``result_timeout_s``: bounded wait for
     the engine's answer (expiry → 504 — the gateway never strands a
     connection on a wedged backend; the engine's own watchdog is the
-    primary never-hang layer)."""
+    primary never-hang layer).
+
+    ``serve_report``: optional zero-arg hook returning the local
+    engine's report — when set, ``/healthz`` carries a ``serve`` block
+    and the status folds its ``ok`` in (the replica-process contract
+    the mesh probes).  ``admin``: optional ``{name: handler}`` dict of
+    operator verbs exposed as ``POST /admin/{name}`` (handler takes
+    the decoded JSON params dict, returns a JSON-able result).  Both
+    are fixed at construction — reads need no lock."""
 
     def __init__(self, router: Router, *,
                  host: str = "127.0.0.1", port: int = 0,
                  max_body_bytes: int = 8 << 20, max_rows: int = 4096,
                  read_timeout_s: float = 5.0,
                  rate_limit: Optional[Tuple[float, float]] = None,
-                 result_timeout_s: float = 60.0):
+                 result_timeout_s: float = 60.0,
+                 serve_report=None, admin=None):
         self.router = router
+        self._serve_report = serve_report
+        self._admin: Dict[str, Callable] = dict(admin or {})
         self._host = host
         self._port = int(port)
         self.max_body_bytes = int(max_body_bytes)
@@ -530,3 +623,26 @@ class Gateway:
 
     def health_block(self) -> Dict:
         return self.report()
+
+    def _admin_handler(self, name: str) -> Optional[Callable]:
+        return self._admin.get(name)  # fixed at construction
+
+    def health_doc(self) -> Tuple[int, Dict]:
+        """The full ``/healthz`` reply: the gateway block, plus the
+        local engine's ``serve`` block when a ``serve_report`` hook is
+        configured.  The status folds BOTH oks in, so a remote probe
+        reads replica health from the status line alone (a wedged
+        engine answers 503 while still listening)."""
+        block = self.health_block()
+        doc: Dict = {"gateway": block}
+        ok = bool(block["ok"])
+        if self._serve_report is not None:
+            try:
+                sblock = self._serve_report()
+            except Exception as e:
+                # a broken report hook is an UNHEALTHY replica, not a
+                # crashed health endpoint
+                sblock = {"ok": False, "error": repr(e)}
+            doc["serve"] = sblock
+            ok = ok and bool(sblock.get("ok"))
+        return (200 if ok else 503), doc

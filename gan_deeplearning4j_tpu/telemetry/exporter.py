@@ -137,6 +137,17 @@ class MetricsRegistry:
             # first abusive caller shows up
             ("gan4j_gateway_requests_total", ()): 0.0,
             ("gan4j_gateway_rejected_total", ()): 0.0,
+            # serving mesh (serve/mesh.py): ejections exist at 0 from
+            # the first scrape — an ejection alert rule must see the
+            # series before the first replica dies
+            ("gan4j_mesh_ejected_total", ()): 0.0,
+            # control plane (serve/controlplane.py): scale/replace/
+            # rollback counters exist at 0 from the first scrape — a
+            # rollback alert is exactly the one that must not wait for
+            # its first firing to learn the series name
+            ("gan4j_controlplane_scale_events_total", ()): 0.0,
+            ("gan4j_controlplane_replaced_total", ()): 0.0,
+            ("gan4j_controlplane_rollbacks_total", ()): 0.0,
         }
         self._gauges: Dict[Tuple[str, tuple], float] = {
             # age since the last data-plane incident; 0 until one
@@ -164,6 +175,15 @@ class MetricsRegistry:
             # (observe_gateway) raises them
             ("gan4j_gateway_active_connections", ()): 0.0,
             ("gan4j_gateway_replica_healthy", ()): 0.0,
+            # serving-mesh gauges (serve/mesh.py — replica PROCESSES,
+            # distinct from gan4j_mesh_devices, the elastic-training
+            # device mesh): 0 replicas = "no mesh running"; the feed
+            # (observe_serving_mesh) raises them
+            ("gan4j_mesh_replicas", ()): 0.0,
+            ("gan4j_mesh_replicas_healthy", ()): 0.0,
+            # control-plane gauge: the fleet size the controller is
+            # currently holding (observe_controlplane raises it)
+            ("gan4j_controlplane_replicas", ()): 0.0,
         }
         self._callbacks: List[Callable[["MetricsRegistry"], None]] = []
         self.run_id: Optional[str] = None
@@ -189,6 +209,17 @@ class MetricsRegistry:
         # gateway feed (serve/gateway.Gateway.report): drives the
         # gan4j_gateway_* series and the /healthz "gateway" block
         self._gateway_fn: Optional[Callable[[], Optional[Dict]]] = None
+        # serving-mesh feed (serve/mesh.MeshRouter.report): drives the
+        # gan4j_mesh_replicas/ejected series and the /healthz
+        # "serving_mesh" block (named to keep it distinct from the
+        # elastic-training "mesh" block above)
+        self._serving_mesh_fn: Optional[
+            Callable[[], Optional[Dict]]] = None
+        # control-plane feed (serve/controlplane.ControlPlane.report):
+        # drives the gan4j_controlplane_* series and the /healthz
+        # "controlplane" block (ok:false once a deploy goes fatal)
+        self._controlplane_fn: Optional[
+            Callable[[], Optional[Dict]]] = None
 
     @staticmethod
     def _key(name: str, labels: Optional[Dict]) -> Tuple[str, tuple]:
@@ -421,6 +452,61 @@ class MetricsRegistry:
 
         self.add_callback(cb)
 
+    def observe_serving_mesh(self, report_fn:
+                             Callable[[], Optional[Dict]]) -> None:
+        """Register the serving-mesh feed: ``report_fn`` returns a
+        ``MeshRouter.report()`` dict (replica count, healthy count,
+        lifetime ejections).  Scrapes mirror it into the
+        ``gan4j_mesh_replicas``/``gan4j_mesh_ejected_total`` series
+        and ``/healthz`` carries it as the ``"serving_mesh"`` block —
+        ``ok: false`` the moment zero replicas are healthy.  (The
+        ``"mesh"`` block is the elastic-training DEVICE mesh; this one
+        counts replica PROCESSES.)"""
+        with self._lock:
+            self._serving_mesh_fn = report_fn
+
+        def cb(reg: "MetricsRegistry") -> None:
+            rep = report_fn()
+            if not rep:
+                return
+            reg.set("gan4j_mesh_replicas",
+                    float(rep.get("replicas", 0)))
+            reg.set("gan4j_mesh_replicas_healthy",
+                    float(rep.get("replicas_healthy", 0)))
+            reg.set_counter("gan4j_mesh_ejected_total",
+                            float(rep.get("ejected_total", 0)))
+
+        self.add_callback(cb)
+
+    def observe_controlplane(self, report_fn:
+                             Callable[[], Optional[Dict]]) -> None:
+        """Register the control-plane feed: ``report_fn`` returns a
+        ``ControlPlane.report()`` dict (fleet size, scale/replace/
+        rollback totals, deploy state).  Scrapes mirror it into the
+        ``gan4j_controlplane_*`` series and ``/healthz`` carries it
+        as the ``"controlplane"`` block — ``ok: false`` once a
+        deployment has gone FATAL (budget exhausted) and a human must
+        look."""
+        with self._lock:
+            self._controlplane_fn = report_fn
+
+        def cb(reg: "MetricsRegistry") -> None:
+            rep = report_fn()
+            if not rep:
+                return
+            reg.set("gan4j_controlplane_replicas",
+                    float(rep.get("replicas", 0)))
+            reg.set_counter(
+                "gan4j_controlplane_scale_events_total",
+                float(rep.get("scale_up_total", 0))
+                + float(rep.get("scale_down_total", 0)))
+            reg.set_counter("gan4j_controlplane_replaced_total",
+                            float(rep.get("replaced_total", 0)))
+            reg.set_counter("gan4j_controlplane_rollbacks_total",
+                            float(rep.get("rollbacks_total", 0)))
+
+        self.add_callback(cb)
+
     # -- render ---------------------------------------------------------------
 
     def render(self) -> str:
@@ -547,6 +633,44 @@ class MetricsRegistry:
                            "ok": bool(rep.get("ok", True))}
             except Exception:  # gan4j-lint: disable=swallowed-exception — a broken feed must not take down the probe
                 pass
+        # the serving-mesh block (replica PROCESSES — the "mesh" block
+        # above is the elastic-training device mesh): live feed when a
+        # mesh is running, else the pre-created series — ALWAYS
+        # present, like the rest.  ok:false with zero healthy replicas.
+        serving_mesh = None
+        smfn = self._serving_mesh_fn
+        if smfn is not None:
+            try:
+                rep = smfn() or {}
+                serving_mesh = {
+                    "replicas": int(rep.get("replicas", 0)),
+                    "replicas_healthy": int(
+                        rep.get("replicas_healthy", 0)),
+                    "ejected_total": int(rep.get("ejected_total", 0)),
+                    "ok": bool(rep.get("ok", True))}
+            except Exception:  # gan4j-lint: disable=swallowed-exception — a broken feed must not take down the probe
+                pass
+        # the control-plane block: live feed when a controller is
+        # running, else the pre-created series — ALWAYS present.
+        # ok:false once a deployment has gone fatal (budget exhausted).
+        controlplane = None
+        cpfn = self._controlplane_fn
+        if cpfn is not None:
+            try:
+                rep = cpfn() or {}
+                controlplane = {
+                    "replicas": int(rep.get("replicas", 0)),
+                    "scale_up_total": int(rep.get("scale_up_total", 0)),
+                    "scale_down_total": int(
+                        rep.get("scale_down_total", 0)),
+                    "replaced_total": int(rep.get("replaced_total", 0)),
+                    "rollbacks_total": int(
+                        rep.get("rollbacks_total", 0)),
+                    "deploy_state": rep.get("deploy_state"),
+                    "fatal": rep.get("fatal"),
+                    "ok": bool(rep.get("ok", True))}
+            except Exception:  # gan4j-lint: disable=swallowed-exception — a broken feed must not take down the probe
+                pass
         with self._lock:
             if data is None:
                 data = {"retries_total": int(self._counters.get(
@@ -592,13 +716,36 @@ class MetricsRegistry:
                                ("gan4j_gateway_replica_healthy", ()),
                                0.0)),
                            "replicas": 0, "ok": True}
+            if serving_mesh is None:
+                serving_mesh = {
+                    "replicas": int(self._gauges.get(
+                        ("gan4j_mesh_replicas", ()), 0.0)),
+                    "replicas_healthy": int(self._gauges.get(
+                        ("gan4j_mesh_replicas_healthy", ()), 0.0)),
+                    "ejected_total": int(self._counters.get(
+                        ("gan4j_mesh_ejected_total", ()), 0.0)),
+                    "ok": True}
+            if controlplane is None:
+                controlplane = {
+                    "replicas": int(self._gauges.get(
+                        ("gan4j_controlplane_replicas", ()), 0.0)),
+                    "scale_up_total": 0, "scale_down_total": 0,
+                    "replaced_total": int(self._counters.get(
+                        ("gan4j_controlplane_replaced_total", ()),
+                        0.0)),
+                    "rollbacks_total": int(self._counters.get(
+                        ("gan4j_controlplane_rollbacks_total", ()),
+                        0.0)),
+                    "deploy_state": None, "fatal": None, "ok": True}
             age = (None if self._last_record_wall is None
                    else round(time.time() - self._last_record_wall, 3))
             doc = {"status": "stalled" if stalled else "ok",
                    "stalled": stalled, "run_id": self.run_id,
                    "last_record_age_s": age, "data": data,
                    "mesh": mesh, "fleet": fleet, "serve": serve,
-                   "gateway": gateway}
+                   "gateway": gateway,
+                   "serving_mesh": serving_mesh,
+                   "controlplane": controlplane}
             if beat_age is not None:
                 doc["last_beat_age_s"] = round(float(beat_age), 3)
             return doc

@@ -69,6 +69,15 @@ the seeded, deterministic injector that does all four, driven by
   losing the connection thread.  ``kill_replica`` stops one engine of
   a live ``Router`` replica set under traffic — the router must eject
   it and drain requests to the survivors with only TYPED failures.
+* **kill-the-replica-process** — ``kill_replica_process`` SIGKILLs a
+  spawned replica subprocess (serve/replica.py) mid-traffic; the
+  control plane must replace it and the mesh must drain to the
+  survivors.  ``wedge_replica`` makes a replica report unhealthy
+  while still listening (stalled-but-listening — a DIFFERENT ejection
+  path than a dead socket).  ``poison_checkpoint_dir`` forges a
+  newest checkpoint that VERIFIES but serves NaN — only the canary's
+  SLO probe can catch it, and auto-rollback must land on the previous
+  step with the rollback budget charged.
 
 Everything is parameterized by an explicit seed: a chaos failure must
 replay exactly.
@@ -76,12 +85,21 @@ replay exactly.
 
 from __future__ import annotations
 
+import hashlib
+import io
+import json
+import os
 import random
 import select
+import signal as _signal
 import socket
+import subprocess
+import tempfile
 import threading
 import time
-from typing import List, Optional, Tuple
+import zipfile
+from http.client import HTTPConnection
+from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
@@ -753,3 +771,125 @@ def kill_replica(router, index: int):
     eng = router.replicas[index]
     eng.stop()
     return eng
+
+
+# -- process-level injectors (the mesh/control-plane chaos set) ---------------
+
+
+def kill_replica_process(proc) -> int:
+    """SIGKILL a spawned replica subprocess — the process-level
+    variant of ``kill_replica``: no drain, no goodbye, no python
+    frames unwound.  The control plane must notice the corpse, eject
+    it from the mesh, spawn a replacement, and keep every in-flight
+    failure TYPED.  Accepts a ``controlplane.ReplicaProcess`` or a
+    raw ``Popen``; reaps (bounded) and returns the pid."""
+    popen = getattr(proc, "proc", proc)
+    pid = popen.pid
+    if popen.poll() is None:
+        os.kill(pid, _signal.SIGKILL)
+    try:
+        popen.wait(timeout=10.0)
+    except subprocess.TimeoutExpired:  # gan4j-lint: disable=swallowed-exception — a SIGKILLed child the kernel will not reap within 10s is not this injector's bug; the caller's alive() polling still sees the truth
+        pass
+    return pid
+
+
+def wedge_replica(host: str, port: int,
+                  seconds: float = 5.0) -> Dict:
+    """Make a replica report UNHEALTHY for ``seconds`` while its
+    socket keeps accepting — the stalled-but-listening failure mode
+    (a dead socket is ejected by a refused connect; a wedged replica
+    must be ejected by its 503 /healthz, which is a different code
+    path).  Drives the replica's ``POST /admin/chaos/wedge`` verb;
+    returns the replica's acknowledgment."""
+    conn = HTTPConnection(host, port, timeout=10.0)
+    try:
+        body = json.dumps({"seconds": float(seconds)}).encode("utf-8")
+        conn.request("POST", "/admin/chaos/wedge", body=body,
+                     headers={"Content-Type": "application/json"})
+        resp = conn.getresponse()
+        data = resp.read()
+    finally:
+        conn.close()
+    if resp.status != 200:
+        raise RuntimeError(
+            f"wedge_replica: HTTP {resp.status} from {host}:{port}: "
+            f"{data[:200]!r}")
+    return json.loads(data.decode("utf-8"))["result"]
+
+
+def poison_checkpoint_dir(directory: str, name: str = "gen") -> int:
+    """Forge a VERIFYING-but-poisoned newest checkpoint: copy the
+    newest verified ``ckpt_N`` to ``ckpt_{N+1}`` with every float
+    param of graph ``name`` NaN'd and the manifest REBUILT over the
+    new bytes.  Manifest verification passes — this is not a torn
+    write but a semantically bad save (the artifact of a diverged run
+    or a bad export), so only the control plane's canary SLO probe
+    (finite outputs) can catch it, and rollback must land on step N.
+    Returns the poisoned step."""
+    ckpt = _ckpt_mod.TrainCheckpointer(directory)
+    steps = ckpt.steps()
+    base = None
+    for s in reversed(steps):
+        if ckpt.verify(s):
+            base = s
+            break
+    if base is None:
+        raise FileNotFoundError(
+            f"no verified checkpoint in {directory} to poison")
+    new_step = max(steps) + 1
+    src = os.path.join(directory, f"ckpt_{base}")
+    with open(os.path.join(src, _ckpt_mod.MANIFEST_NAME)) as f:
+        src_manifest = json.load(f)
+    model_file = f"{name}_model.zip"
+    if model_file not in src_manifest["files"]:
+        raise FileNotFoundError(
+            f"ckpt_{base} has no graph {name!r} "
+            f"(files: {sorted(src_manifest['files'])})")
+    from gan_deeplearning4j_tpu.graph import serialization
+
+    with zipfile.ZipFile(os.path.join(src, model_file)) as z:
+        cfg = json.loads(z.read("config.json").decode("utf-8"))
+        with np.load(io.BytesIO(z.read("params.npz")),
+                     allow_pickle=False) as f:
+            params = {k: np.asarray(f[k]) for k in f.files}
+        with np.load(io.BytesIO(z.read("updater.npz")),
+                     allow_pickle=False) as f:
+            updater = {k: np.asarray(f[k]) for k in f.files}
+    poisoned = {k: (np.full_like(v, np.nan)
+                    if np.issubdtype(v.dtype, np.floating) else v)
+                for k, v in params.items()}
+    tmp = tempfile.mkdtemp(prefix=".ckpt_tmp_", dir=directory)
+    entries: Dict[str, Dict] = {}
+
+    def put(fname: str, data: bytes) -> None:
+        path = os.path.join(tmp, fname)
+        with open(path, "wb") as fh:
+            fh.write(data)
+        _ckpt_mod._fsync_file(path)
+        entries[fname] = {"bytes": len(data),
+                          "sha256": hashlib.sha256(data).hexdigest()}
+
+    put(model_file,
+        serialization.model_zip_bytes(cfg, poisoned, updater))
+    for fname in src_manifest["files"]:
+        if fname == model_file:
+            continue
+        with open(os.path.join(src, fname), "rb") as fh:
+            data = fh.read()
+        if fname == "state.json":
+            scalars = json.loads(data.decode("utf-8"))
+            scalars["step"] = new_step
+            data = json.dumps(scalars, indent=1).encode("utf-8")
+        put(fname, data)
+    manifest: Dict = {"step": new_step, "files": entries}
+    if "mesh_spec" in src_manifest:
+        manifest["mesh_spec"] = src_manifest["mesh_spec"]
+    mpath = os.path.join(tmp, _ckpt_mod.MANIFEST_NAME)
+    with open(mpath, "w") as fh:
+        json.dump(manifest, fh, indent=1)
+    _ckpt_mod._fsync_file(mpath)
+    _ckpt_mod._fsync_dir(tmp)
+    os.rename(tmp, os.path.join(directory, f"ckpt_{new_step}"))
+    _ckpt_mod._fsync_dir(directory)
+    return new_step

@@ -1,0 +1,488 @@
+"""Mesh tier (serve/replica.py + mesh.py + controlplane.py): replicas
+as real PROCESSES, a router over their sockets, and the self-healing
+control plane — all failure modes typed, all recovery automatic.
+
+Three layers of evidence:
+
+* **pure units** — the autoscaler's hysteresis (noisy traces do not
+  flap; bounds and cooldowns hold) and the canary state machine
+  (hold/promote/rollback on exactly the documented dirt) are plain
+  functions of their inputs, tested with no sockets at all.
+* **in-process socket contracts** — the keep-alive client pool
+  (reuse, bounded size, ONE typed reconnect on a stale socket) and
+  the hotswap fallback (corrupt newest checkpoint skipped with a
+  ``serve.hotswap_rejected`` event) against a local gateway.
+* **cross-process acceptance** — replica processes spawned with the
+  real launcher: mesh ejection/re-admission under wedge + SIGKILL,
+  then the three-part chaos e2e (load ramp trips scale-up; a killed
+  replica is ejected and replaced; a poisoned canary rolls back and
+  charges the budget) with ZERO non-typed failures and one
+  contiguous events timeline.
+
+Process spawns cost ~3-4s each; the socket tests budget five total.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from gan_deeplearning4j_tpu.checkpoint.checkpointer import (
+    CheckpointCorruptError,
+    NoVerifiedCheckpointError,
+    TrainCheckpointer,
+)
+from gan_deeplearning4j_tpu.models import dcgan_mnist as M
+from gan_deeplearning4j_tpu.parallel import data_mesh
+from gan_deeplearning4j_tpu.parallel.inference import ParallelInference
+from gan_deeplearning4j_tpu.serve import (
+    Autoscaler,
+    CanaryDeployment,
+    ControlPlane,
+    DeploymentRollbackError,
+    Gateway,
+    GatewayClient,
+    MeshRouter,
+    NoHealthyReplicaError,
+    RemoteReplica,
+    ReplicaLauncher,
+    Router,
+    ServeEngine,
+    run_socket_load,
+    z_inputs,
+)
+from gan_deeplearning4j_tpu.telemetry import events
+from gan_deeplearning4j_tpu.testing import chaos
+
+BUCKETS = (8, 32)
+REPLICA_ENV = {"JAX_PLATFORMS": "cpu"}
+
+
+@pytest.fixture(scope="module")
+def gen_infer(cpu_devices):
+    """The module's ONE compiled dispatch for in-process tests (the
+    cross-process tests compile inside their replica processes)."""
+    gen = M.build_generator()
+    return ParallelInference(gen, mesh=data_mesh(8), buckets=BUCKETS)
+
+
+def _engine(gen_infer):
+    eng = ServeEngine(infer=gen_infer, watchdog_deadline_s=30.0)
+    eng.warmup(np.zeros((1, 2), np.float32))
+    eng.start()
+    return eng
+
+
+def _mk(rows, seed=0):
+    return np.random.RandomState(seed).rand(rows, 2).astype(
+        np.float32) * 2 - 1
+
+
+def _wait(pred, timeout_s, what):
+    t0 = time.monotonic()
+    while time.monotonic() - t0 < timeout_s:
+        if pred():
+            return
+        time.sleep(0.1)
+    raise AssertionError(f"timed out after {timeout_s}s waiting for "
+                         f"{what}")
+
+
+# -- pure units: autoscaler ----------------------------------------------------
+
+
+def _scaler(**kw):
+    kw.setdefault("min_replicas", 1)
+    kw.setdefault("max_replicas", 3)
+    kw.setdefault("up_queue_depth", 4.0)
+    kw.setdefault("up_p99_ms", 500.0)
+    kw.setdefault("up_after", 2)
+    kw.setdefault("down_after", 3)
+    kw.setdefault("cooldown_ticks", 2)
+    return Autoscaler(**kw)
+
+
+HOT = {"queue_depth": 9, "p99_ms": 900.0, "shed_total": 0}
+IDLE = {"queue_depth": 0, "p99_ms": 1.0, "shed_total": 0}
+
+
+def test_autoscaler_noisy_trace_does_not_flap():
+    # alternating hot/idle never sustains a streak -> zero decisions
+    s = _scaler()
+    trace = [HOT, IDLE] * 10
+    assert [s.tick(m, 2) for m in trace] == [0] * len(trace)
+
+
+def test_autoscaler_hysteresis_and_cooldown():
+    s = _scaler()
+    # sustained heat: up_after=2 gates the first +1, then the
+    # cooldown (2 ticks) swallows the continuing streak before the
+    # next +1 -- exactly one scale event per cooldown window
+    assert [s.tick(HOT, 1) for _ in range(5)] == [0, 1, 0, 0, 1]
+
+
+def test_autoscaler_respects_bounds():
+    s = _scaler()
+    # already at max: sustained heat never scales past the ceiling
+    assert all(s.tick(HOT, 3) == 0 for _ in range(8))
+    s2 = _scaler()
+    # at min: sustained idle never scales below the floor
+    assert all(s2.tick(IDLE, 1) == 0 for _ in range(8))
+
+
+def test_autoscaler_scales_down_after_sustained_idle():
+    s = _scaler()
+    decisions = [s.tick(IDLE, 2) for _ in range(4)]
+    assert decisions == [0, 0, -1, 0]
+
+
+def test_autoscaler_shed_growth_counts_as_heat():
+    s = _scaler(up_shed_delta=1)
+    base = {"queue_depth": 0, "p99_ms": 1.0}
+    s.tick({**base, "shed_total": 0}, 1)       # baseline for the delta
+    assert s.tick({**base, "shed_total": 3}, 1) == 0   # streak 1
+    assert s.tick({**base, "shed_total": 6}, 1) == 1   # streak 2 -> up
+
+
+# -- pure units: canary state machine ------------------------------------------
+
+
+def _canary(**kw):
+    kw.setdefault("baseline_ms", 10.0)
+    kw.setdefault("hold_ticks", 2)
+    kw.setdefault("p99_factor", 3.0)
+    kw.setdefault("p99_floor_ms", 50.0)
+    return CanaryDeployment("/tmp/ckpt", 7, **kw)
+
+
+def test_canary_holds_then_promotes():
+    c = _canary()
+    assert c.observe(probe_ms=12.0, finite=True) == "hold"
+    assert c.observe(probe_ms=14.0, finite=True) == "promote"
+    assert c.state == "promoted"
+    # terminal: further observations are no-ops
+    assert c.observe(probe_ms=9999.0, finite=False) == "promoted"
+
+
+@pytest.mark.parametrize("kw,reason_frag", [
+    (dict(probe_ms=5.0, finite=False), "non-finite"),
+    (dict(probe_ms=5.0, finite=True, errors_delta=2), "error count"),
+    (dict(probe_ms=None, finite=True,
+          failure="DispatchError('boom')"), "boom"),
+    # bound = max(floor 50, baseline 10 x 3) = 50
+    (dict(probe_ms=60.0, finite=True), "SLO bound"),
+])
+def test_canary_one_dirty_observation_rolls_back(kw, reason_frag):
+    c = _canary()
+    assert c.observe(probe_ms=12.0, finite=True) == "hold"
+    assert c.observe(**kw) == "rollback"
+    assert c.state == "rolled_back"
+    assert reason_frag in c.reason
+
+
+def test_canary_latency_floor_forgives_fast_baselines():
+    # baseline 1ms would make 3ms "3x over" -- the floor absorbs
+    # scheduler noise on fast replicas
+    c = _canary(baseline_ms=1.0, hold_ticks=1, p99_floor_ms=250.0)
+    assert c.observe(probe_ms=40.0, finite=True) == "promote"
+
+
+# -- satellite: keep-alive client pool -----------------------------------------
+
+
+@pytest.fixture()
+def stack(gen_infer):
+    eng = _engine(gen_infer)
+    router = Router(replicas=[eng], recheck_s=0.2)
+    gw = Gateway(router, read_timeout_s=2.0).start()
+    yield gw, router
+    gw.stop()
+    router.stop()
+
+
+def test_client_pool_reuses_keepalive_sockets(stack):
+    gw, _ = stack
+    client = GatewayClient("127.0.0.1", gw.port, retries=0,
+                           pool_size=2)
+    try:
+        outs = [client.generate([_mk(4, seed=i)])[0] for i in range(3)]
+        for out in outs:
+            assert out.shape == (4, 1, 28, 28)
+            assert np.isfinite(out).all()
+        # calls 2 and 3 ride the checked-in socket from call 1
+        assert client.reused_total >= 2
+        assert client.reconnects_total == 0
+    finally:
+        client.close()
+
+
+def test_client_pool_bounded_and_closeable(stack):
+    gw, _ = stack
+    with pytest.raises(ValueError):
+        GatewayClient("127.0.0.1", gw.port, pool_size=-1)
+    client = GatewayClient("127.0.0.1", gw.port, retries=0,
+                           pool_size=0)  # pooling off entirely
+    client.generate([_mk(4)])
+    assert client.reused_total == 0
+    client.close()
+    # a closed pool degrades to connection-per-call, not failure
+    out = client.generate([_mk(4, seed=1)])[0]
+    assert np.isfinite(out).all()
+    assert client.reused_total == 0
+
+
+def test_client_pool_typed_reconnect_on_stale_socket(gen_infer):
+    # own stack: the gateway restarts on the SAME port, so the pooled
+    # socket goes stale exactly once
+    eng = _engine(gen_infer)
+    router = Router(replicas=[eng], recheck_s=0.2)
+    gw = Gateway(router, read_timeout_s=0.5).start()
+    client = GatewayClient("127.0.0.1", gw.port, retries=0,
+                           pool_size=2)
+    try:
+        client.generate([_mk(4)])          # checks a socket in
+        port = gw.port
+        gw.stop()
+        # the old handler holds the keep-alive socket until its idle
+        # read times out (0.5s) -- only THEN is the pooled socket
+        # genuinely stale
+        time.sleep(1.2)
+        gw = Gateway(router, port=port, read_timeout_s=0.5).start()
+        out = client.generate([_mk(4, seed=2)])[0]
+        assert np.isfinite(out).all()
+        assert client.reconnects_total == 1
+    finally:
+        client.close()
+        gw.stop()
+        router.stop()
+
+
+# -- satellite: hotswap fallback on a corrupt newest checkpoint ----------------
+
+
+def _corrupt(path):
+    with open(path, "r+b") as f:
+        f.seek(0)
+        f.write(b"\x00TORN\x00" * 8)
+
+
+def test_hotswap_skips_corrupt_newest_and_falls_back(gen_infer,
+                                                     tmp_path):
+    ck = TrainCheckpointer(str(tmp_path))
+    ck.save(1, {"gen": M.build_generator()})
+    ck.save(2, {"gen": M.build_generator()})
+    _corrupt(str(tmp_path / "ckpt_2" / "gen_model.zip"))
+    assert not ck.verify(2) and ck.verify(1)
+
+    eng = _engine(gen_infer)
+    recorder = events.EventRecorder()
+    prev = events.install(recorder)
+    try:
+        got = eng.hotswap_from(str(tmp_path))
+    finally:
+        events.install(prev)
+        eng.stop()
+    assert got == 1
+    names = [e["name"] for e in recorder.recent()]
+    rejected = [e for e in recorder.recent()
+                if e["name"] == "serve.hotswap_rejected"]
+    assert rejected and rejected[0]["step"] == 2
+    assert "serve.hotswap" in names
+
+
+def test_hotswap_explicit_corrupt_step_raises_typed(gen_infer,
+                                                    tmp_path):
+    ck = TrainCheckpointer(str(tmp_path))
+    ck.save(3, {"gen": M.build_generator()})
+    _corrupt(str(tmp_path / "ckpt_3" / "gen_model.zip"))
+    eng = _engine(gen_infer)
+    try:
+        with pytest.raises(CheckpointCorruptError):
+            eng.hotswap_from(str(tmp_path), step=3)
+        with pytest.raises(NoVerifiedCheckpointError):
+            eng.hotswap_from(str(tmp_path))  # nothing verifiable left
+    finally:
+        eng.stop()
+
+
+# -- cross-process: mesh ejection and re-admission over real sockets -----------
+
+
+def test_mesh_wedge_eject_readmit_and_kill(tmp_path):
+    launcher = ReplicaLauncher(buckets=(8, 16),
+                               log_dir=str(tmp_path),
+                               env=REPLICA_ENV)
+    recorder = events.EventRecorder(ring_size=1024)
+    prev = events.install(recorder)
+    procs, mesh = [], MeshRouter(recheck_s=0.3)
+    try:
+        for _ in range(2):
+            p = launcher.spawn()
+            procs.append(p)
+            mesh.add(RemoteReplica(p.host, p.port))
+        out = mesh.generate([_mk(4)])[0]
+        assert out.shape == (4, 1, 28, 28) and np.isfinite(out).all()
+        assert mesh.poll()["healthy"] == 2
+
+        # wedge replica 0: it answers 503 while listening -> ejected,
+        # traffic keeps flowing through replica 1
+        chaos.wedge_replica(procs[0].host, procs[0].port,
+                            seconds=1.2)
+        _wait(lambda: mesh.poll()["healthy"] == 1, 10,
+              "wedged replica ejection")
+        for i in range(3):
+            assert np.isfinite(
+                mesh.generate([_mk(4, seed=i)])[0]).all()
+        assert mesh.report()["ejected_total"] >= 1
+
+        # the wedge expires -> the bounded re-probe re-admits it
+        _wait(lambda: mesh.poll()["healthy"] == 2, 10,
+              "wedge recovery re-admission")
+
+        # SIGKILL replica 1: dead socket -> typed ejection, traffic
+        # keeps flowing through replica 0
+        chaos.kill_replica_process(procs[1])
+        for i in range(3):
+            assert np.isfinite(
+                mesh.generate([_mk(4, seed=10 + i)])[0]).all()
+        assert mesh.poll()["healthy"] == 1
+        rep = mesh.report()
+        assert rep["replicas_healthy"] == 1 and rep["ok"]
+
+        # nobody left -> typed, not a hang
+        mesh.remove(procs[0].name)
+        procs[0].stop()
+        with pytest.raises(NoHealthyReplicaError):
+            mesh.generate([_mk(4)])
+    finally:
+        events.install(prev)
+        mesh.close()
+        for p in procs:
+            p.kill()
+    names = [e["name"] for e in recorder.recent()]
+    assert "mesh.replica_ejected" in names
+    assert "mesh.replica_restored" in names
+
+
+# -- cross-process: the three-part chaos acceptance e2e ------------------------
+
+
+def test_chaos_acceptance_end_to_end(tmp_path):
+    """Load ramp trips scale-up; a SIGKILLed replica is ejected and
+    replaced; a poisoned canary auto-rolls back charging the budget.
+    Zero non-typed failures, one contiguous events timeline."""
+    ckdir = str(tmp_path / "ckpt")
+    TrainCheckpointer(ckdir).save(1, {"gen": M.build_generator()})
+
+    events_path = str(tmp_path / "events.jsonl")
+    recorder = events.EventRecorder(path=events_path, ring_size=4096)
+    prev = events.install(recorder)
+
+    launcher = ReplicaLauncher(buckets=(8, 16),
+                               log_dir=str(tmp_path),
+                               env=REPLICA_ENV)
+    scaler = Autoscaler(min_replicas=1, max_replicas=2,
+                        up_queue_depth=1.0, up_p99_ms=5.0,
+                        up_after=1, down_after=10_000,
+                        cooldown_ticks=2)
+    # p99_floor_ms is wide open: part 3 tests the NaN gate, and a
+    # loaded CI box must not trip the latency gate on a CLEAN deploy
+    cp = ControlPlane(launcher, autoscaler=scaler, tick_s=0.25,
+                      hold_ticks=2, max_rollbacks=2,
+                      probe_timeout_s=30.0, p99_floor_ms=10_000.0)
+    try:
+        cp.start()
+        first = cp.replica_names()
+        assert len(first) == 1
+
+        # -- part 1: load ramp -> scale-up, and the NEW replica serves
+        host, port = first[0].rsplit(":", 1)
+        client = GatewayClient(host, int(port), retries=0,
+                               timeout_s=30.0)
+        res = run_socket_load(client, rate_rps=60.0, n_requests=50,
+                              size_mix=((8, 1.0),),
+                              make_inputs=z_inputs(2),
+                              encoding="npy", max_workers=8)
+        client.close()
+        assert res["errors"] == 0, res  # sheds are typed; errors not
+        _wait(lambda: len(cp.replica_names()) == 2, 45,
+              "autoscaler scale-up to 2 replicas")
+        assert cp.report()["scale_up_total"] >= 1
+        new_name = (set(cp.replica_names()) - set(first)).pop()
+        nhost, nport = new_name.rsplit(":", 1)
+        fresh = RemoteReplica(nhost, int(nport))
+        try:
+            out = fresh.generate([_mk(4)])[0]
+            assert out.shape == (4, 1, 28, 28)
+            assert np.isfinite(out).all()
+        finally:
+            fresh.close()
+
+        # -- part 2: SIGKILL one replica -> ejected, replaced, healthy
+        victim = cp.replica_names()[0]
+        chaos.kill_replica_process(cp.process(victim))
+        _wait(lambda: cp.report()["replaced_total"] >= 1, 45,
+              "dead replica replacement")
+        _wait(lambda: len(cp.replica_names()) == 2, 45,
+              "fleet back to 2 replicas")
+        assert victim not in cp.replica_names()
+
+        # -- part 3: clean deploy promotes; poisoned deploy rolls
+        # back and charges the budget
+        cp.deploy(ckdir)
+        _wait(lambda: cp.deployment_status()["state"]
+              not in ("pending", "canary"), 60, "clean deploy")
+        status = cp.deployment_status()
+        assert status["state"] == "promoted", status
+
+        bad_step = chaos.poison_checkpoint_dir(ckdir)
+        assert TrainCheckpointer(ckdir).verify(bad_step)  # NaN, not torn
+        cp.deploy(ckdir)
+        _wait(lambda: cp.deployment_status()["state"]
+              not in ("pending", "canary"), 60, "poisoned deploy")
+        status = cp.deployment_status()
+        assert status["state"] == "rolled_back", status
+        assert status["restored_step"] == 1
+        assert "non-finite" in status["reason"]
+        rep = cp.report()
+        assert rep["rollbacks_total"] == 1   # the budget was charged
+        assert rep["promoted_total"] == 1
+        assert rep["fatal"] is None and rep["ok"]
+
+        # the budget is finite: exhausting it is FATAL and typed
+        cp.deploy(ckdir)
+        _wait(lambda: cp.deployment_status()["state"]
+              not in ("pending", "canary"), 60, "second poisoned deploy")
+        cp.deploy(ckdir)
+        _wait(lambda: cp.deployment_status()["state"]
+              not in ("pending", "canary"), 60, "final poisoned deploy")
+        assert cp.deployment_status()["state"] == "failed_fatal"
+        with pytest.raises(DeploymentRollbackError):
+            cp.deploy(ckdir)
+    finally:
+        cp.stop()
+        events.install(prev)
+        recorder.close()
+
+    # -- one contiguous timeline covering all three parts
+    evs = [e for e in events.read_events(events_path)
+           if e["name"] != "recorder.start"]
+    ts = [e["t"] for e in evs]
+    assert ts == sorted(ts)
+    names = [e["name"] for e in evs]
+    for must in ("controlplane.replica_spawned", "controlplane.scale_up",
+                 "controlplane.replica_replaced",
+                 "controlplane.canary_start", "controlplane.promoted",
+                 "controlplane.rollback", "controlplane.deploy_fatal"):
+        assert must in names, f"missing {must} in the timeline"
+    # ...and in causal order: spawn < scale_up < replace < canary <
+    # promote < rollback
+    order = [names.index(n) for n in (
+        "controlplane.replica_spawned", "controlplane.scale_up",
+        "controlplane.replica_replaced", "controlplane.canary_start",
+        "controlplane.promoted")]
+    assert order == sorted(order)
+    assert (names.index("controlplane.promoted")
+            < names.index("controlplane.rollback")
+            < names.index("controlplane.deploy_fatal"))
