@@ -1388,6 +1388,17 @@ def dryrun(telemetry: bool = True,
 
             data_health = DataHealth()
             registry.observe_data(data_health.report)
+            # resource telemetry (telemetry/resources.py): a live
+            # sampler feeds the gan4j_resource_* gauges for the whole
+            # smoke — the scrape below must carry them and /healthz
+            # must grow the "resources" block
+            from gan_deeplearning4j_tpu.telemetry.resources import (
+                ResourceMonitor,
+            )
+
+            rmon = ResourceMonitor(interval_s=0.5)
+            rmon.start()
+            registry.observe_resources(rmon.report)
             stop = serve_exporter(registry,
                                   0 if metrics_port is None
                                   else metrics_port)
@@ -1606,11 +1617,16 @@ def dryrun(telemetry: bool = True,
                                     make_inputs=z_inputs(2, seed=4),
                                     encoding="npy", seed=5)
                                 gw_rec = g_gw.report()
+                                client_rec = g_client.report()
                         finally:
                             g_router.stop()
                     gw_rec["post_warmup_recompiles"] = len(
                         gsentinel.recompiles)
                     registry.observe_gateway(lambda: gw_rec)
+                    # caller-side wire counters (satellite of the
+                    # tracing PR): the gan4j_client_* series must ride
+                    # the same scrape
+                    registry.observe_client(lambda: client_rec)
                     g_p50 = g_stats["p50_ms"] or 0.0
                     publish_bench_series(
                         registry,
@@ -1640,6 +1656,7 @@ def dryrun(telemetry: bool = True,
                         m_cp = ControlPlane(
                             ReplicaLauncher(
                                 buckets=(8,), log_dir=m_logs,
+                                events_dir=m_logs,
                                 env={"JAX_PLATFORMS": "cpu"}),
                             mesh=m_mesh,
                             autoscaler=Autoscaler(
@@ -1662,6 +1679,20 @@ def dryrun(telemetry: bool = True,
                         finally:
                             m_cp.stop()
                             m_mesh.close()
+                        # cross-process trace merge: must run INSIDE
+                        # this with-block (the replica events files
+                        # live in m_logs) and AFTER stop() (SIGTERM
+                        # makes each replica flush its tail)
+                        from gan_deeplearning4j_tpu.telemetry import (
+                            tracing as tracing_mod,
+                        )
+                        import glob as _glob
+
+                        recorder.flush()
+                        trace_merged = tracing_mod.merge_trace_files(
+                            [events_path] + sorted(_glob.glob(
+                                os.path.join(
+                                    m_logs, "*.events.jsonl"))))
                     registry.observe_serving_mesh(lambda: mesh_rec)
                     registry.observe_controlplane(lambda: cp_rec)
                 # one record through the registry feed, then a REAL
@@ -1827,6 +1858,52 @@ def dryrun(telemetry: bool = True,
                     and isinstance(cp_blk, dict)
                     and cp_blk.get("replicas") == 2
                     and cp_blk.get("ok") is True)
+                # distributed-tracing surface: every traced request in
+                # the smoke (12 gateway socket requests + 3 mesh
+                # generates) must resolve to a COMPLETE span tree after
+                # the cross-process merge — one root, every parent id
+                # resolving — and the mesh-rooted traces must span >= 2
+                # processes (the main process's route/hop spans joined
+                # with the replica's request/engine spans purely
+                # through the wire header).  The caller-side and
+                # resource series ride the same scrape, and span
+                # recording itself must cost well under the 2%
+                # telemetry budget at the gateway's own p50.
+                n_probe = 200
+                t0 = time.perf_counter()
+                for i in range(n_probe):
+                    events_mod.complete("bench.trace_probe", dur=0.0,
+                                        probe=i)
+                per_event_us = ((time.perf_counter() - t0)
+                                / n_probe * 1e6)
+                # ~14 trace.* records ride one fully traced gateway
+                # request (client 3, gateway 6, engine 5)
+                trace_overhead_frac = (
+                    (14.0 * per_event_us / 1e3) / g_p50
+                    if g_p50 else 0.0)
+                t_stats = trace_merged["stats"]
+                route_traces = [
+                    tr for tr in trace_merged["traces"].values()
+                    if tr["root"] == "trace.route"]
+                resources_blk = health.get("resources")
+                trace_ok = (
+                    t_stats["traces"] >= 15
+                    and t_stats["complete_frac"] >= 0.95
+                    and len(route_traces) >= 3
+                    and all(tr["complete"]
+                            and len(tr["processes"]) >= 2
+                            for tr in route_traces)
+                    and t_stats["cross_process"] >= 3
+                    and "gan4j_client_reused_total " in m_body
+                    and "gan4j_client_reconnects_total " in m_body
+                    and "gan4j_client_retried_total " in m_body
+                    and "gan4j_resource_rss_bytes " in m_body
+                    and "gan4j_resource_open_fds " in m_body
+                    and "gan4j_resource_threads " in m_body
+                    and isinstance(resources_blk, dict)
+                    and resources_blk.get("rss_bytes", 0) > 0
+                    and resources_blk.get("ok") is True
+                    and trace_overhead_frac < 0.02)
                 recorder.flush()
                 try:
                     events_ok = len(events_mod.read_events(
@@ -1835,6 +1912,7 @@ def dryrun(telemetry: bool = True,
                     events_ok = False
             finally:
                 watchdog.stop()
+                rmon.stop()
                 stop()
                 events_mod.install(prev_rec)
                 recorder.close()
@@ -1845,7 +1923,8 @@ def dryrun(telemetry: bool = True,
                            and lint["ok"] and sanitizer["ok"]
                            and prove["ok"] and race_ok
                            and bench_stable_ok and fleet_ok
-                           and serve_ok and gateway_ok and mesh_ok),
+                           and serve_ok and gateway_ok and mesh_ok
+                           and trace_ok),
                 "platform": device.platform,
                 "telemetry": telemetry,
                 "checkpoint": ckpt,
@@ -1870,11 +1949,165 @@ def dryrun(telemetry: bool = True,
                 "mesh_ok": bool(mesh_ok),
                 "mesh": mesh_rec,
                 "controlplane": cp_rec,
+                "trace_ok": bool(trace_ok),
+                "trace": t_stats,
+                "trace_overhead_frac": round(trace_overhead_frac, 6),
+                "trace_span_record_us": round(per_event_us, 3),
                 "bench_stable_ok": bool(bench_stable_ok),
                 "bench_spread": spread,
                 "watchdog_beat_us": round(beat_us, 3)}
     finally:
         BATCH = prev_batch
+
+
+def soak_bench(soak_seconds: float = 30.0, *, rate_rps: float = 40.0,
+               leak: bool = False, leak_bytes: int = 256 << 10,
+               artifacts_dir: Optional[str] = None) -> dict:
+    """Wall-clock soak with a LEAK GATE: run the full serving stack
+    (engine → router → gateway → client, real loopback sockets) under
+    open-loop Poisson load for ``soak_seconds`` while a
+    ``ResourceMonitor`` samples RSS / device bytes / fds / threads,
+    then gate on ``telemetry.resources.leak_verdict`` — a robust
+    (Theil–Sen) linear-trend test, not an absolute ceiling, so the
+    verdict names WHICH resource grows and by how much per second.
+
+    ``leak=True`` installs ``testing.chaos.LeakyDispatchSource`` — a
+    reference-hoarding injector on the engine's dispatch seam — which
+    MUST turn the verdict red (``"rss_bytes" in leaking``): the CI
+    lane that proves the gate can fail.  Artifacts (the events
+    timeline, the merged trace, the raw sample ring) land in
+    ``artifacts_dir`` for post-mortem upload.
+
+    ``ok`` folds: zero non-typed load failures, the
+    ``gan4j_resource_*``/``gan4j_client_*`` series live in a REAL
+    scrape, >= 95% complete trace trees over the soak's own traffic,
+    and a clean ``bench_gate.check_soak`` verdict."""
+    import tempfile
+    import urllib.request
+
+    import numpy as _np
+
+    from gan_deeplearning4j_tpu import bench_gate
+    from gan_deeplearning4j_tpu.models import dcgan_mnist as _dcgan
+    from gan_deeplearning4j_tpu.parallel.inference import (
+        ParallelInference,
+    )
+    from gan_deeplearning4j_tpu.serve import (
+        Gateway,
+        GatewayClient,
+        Router,
+        ServeEngine,
+        run_socket_load,
+        z_inputs,
+    )
+    from gan_deeplearning4j_tpu.telemetry import (
+        MetricsRegistry,
+        events as events_mod,
+        serve_exporter,
+        tracing as tracing_mod,
+    )
+    from gan_deeplearning4j_tpu.telemetry.resources import (
+        ResourceMonitor,
+        leak_verdict,
+    )
+
+    if artifacts_dir is None:
+        artifacts_dir = tempfile.mkdtemp(prefix="gan4j_soak_")
+    os.makedirs(artifacts_dir, exist_ok=True)
+    events_path = os.path.join(artifacts_dir, "soak.events.jsonl")
+    recorder = events_mod.EventRecorder(path=events_path)
+    prev_rec = events_mod.install(recorder)
+    registry = MetricsRegistry()
+    rmon = ResourceMonitor(interval_s=0.25)
+    rmon.start()
+    registry.observe_resources(rmon.report)
+    stop = serve_exporter(registry, 0)
+    injector = None
+    m_body = ""
+    try:
+        if leak:
+            from gan_deeplearning4j_tpu.testing.chaos import (
+                LeakyDispatchSource,
+            )
+
+            injector = LeakyDispatchSource(
+                bytes_per_dispatch=leak_bytes).install()
+        pi = ParallelInference(_dcgan.build_generator(),
+                               buckets=(8, 32))
+        engine = ServeEngine(infer=pi, watchdog_deadline_s=120.0)
+        engine.warmup(_np.zeros((1, 2), _np.float32))
+        router = Router(replicas=[engine])
+        engine.start()
+        try:
+            with Gateway(router) as gw:
+                client = GatewayClient("127.0.0.1", gw.port,
+                                       retries=2, seed=11)
+                registry.observe_serve(engine.report)
+                registry.observe_gateway(gw.report)
+                registry.observe_client(client.report)
+                stats = run_socket_load(
+                    client, rate_rps=rate_rps,
+                    duration_s=float(soak_seconds),
+                    make_inputs=z_inputs(2, seed=12),
+                    encoding="npy", seed=13)
+                url = f"http://127.0.0.1:{stop.port}/metrics"
+                try:
+                    with urllib.request.urlopen(url, timeout=10) as r:
+                        m_body = (r.read().decode()
+                                  if r.status == 200 else "")
+                except OSError:
+                    m_body = ""
+                client.close()
+        finally:
+            router.stop()
+    finally:
+        # stop sampling BEFORE the injector releases its hoard — the
+        # ring must end on the leaked state, not the cleaned-up one
+        rmon.stop()
+        if injector is not None:
+            injector.uninstall()
+        stop()
+        events_mod.install(prev_rec)
+        recorder.close()
+    series_ok = all(s in m_body for s in (
+        "gan4j_resource_rss_bytes ", "gan4j_resource_open_fds ",
+        "gan4j_resource_threads ", "gan4j_client_reused_total ",
+        "gan4j_client_retried_total "))
+    samples = rmon.samples()
+    verdict = leak_verdict(samples)
+    merged = tracing_mod.merge_trace_files([events_path])
+    with open(os.path.join(artifacts_dir,
+                           "merged_trace.json"), "w") as f:
+        json.dump(merged, f)
+    with open(os.path.join(artifacts_dir,
+                           "soak_samples.json"), "w") as f:
+        json.dump(samples, f)
+    load_ok = (stats["errors"] == 0 and stats["undrained"] == 0)
+    trace_frac = merged["stats"]["complete_frac"]
+    rec = {
+        "metric": "dcgan_mnist_img_per_sec", "soak": True,
+        "soak_seconds": float(soak_seconds),
+        "rate_rps": float(rate_rps),
+        "leak_injected": bool(leak),
+        "leaked_dispatches": (injector.dispatches
+                              if injector is not None else 0),
+        "load": {k: stats[k] for k in
+                 ("submitted", "completed", "errors", "shed",
+                  "unavailable", "undrained", "p50_ms", "p99_ms")
+                 if k in stats},
+        "series_ok": bool(series_ok),
+        "trace_complete_frac": round(trace_frac, 4),
+        "trace": merged["stats"],
+        "leak": verdict,
+        "artifacts_dir": artifacts_dir,
+    }
+    gate = bench_gate.check_soak(rec)
+    rec["gate"] = gate
+    rec["ok"] = bool(load_ok and series_ok
+                     and trace_frac >= 0.95 and gate["ok"])
+    with open(os.path.join(artifacts_dir, "soak.json"), "w") as f:
+        json.dump(rec, f, indent=1)
+    return rec
 
 
 def main(argv=None) -> None:
@@ -1909,6 +2142,27 @@ def main(argv=None) -> None:
                    help="serve /metrics + /healthz during the e2e "
                         "trainer run (and the --dryrun smoke's "
                         "self-scrape); 0 = ephemeral")
+    p.add_argument("--soak", action="store_true",
+                   help="wall-clock soak with the LEAK GATE: run the "
+                        "full serving stack under load for "
+                        "--soak-seconds while sampling process "
+                        "resources, then gate on a robust linear-"
+                        "trend leak verdict (telemetry/resources.py) "
+                        "and print one JSON line")
+    p.add_argument("--soak-seconds", type=float, default=30.0,
+                   metavar="S",
+                   help="soak wall-clock budget (default 30)")
+    p.add_argument("--soak-rps", type=float, default=40.0,
+                   help="open-loop arrival rate during the soak")
+    p.add_argument("--soak-leak", action="store_true",
+                   help="inject a reference-hoarding dispatch leak "
+                        "(testing.chaos.LeakyDispatchSource) — the "
+                        "verdict MUST go red; proves the gate can "
+                        "fail")
+    p.add_argument("--soak-artifacts", default=None, metavar="DIR",
+                   help="write soak artifacts (events timeline, "
+                        "merged trace, sample ring, soak.json) here "
+                        "instead of a fresh tempdir")
     p.add_argument("--serve", action="store_true",
                    help="serving bench of record (serve/): ramp an "
                         "open-loop Poisson load to the continuous-"
@@ -2055,6 +2309,13 @@ def main(argv=None) -> None:
     if args.dryrun:
         print(json.dumps(dryrun(telemetry=args.telemetry,
                                 metrics_port=args.metrics_port)))
+        return
+    if args.soak:
+        rec = soak_bench(soak_seconds=args.soak_seconds,
+                         rate_rps=args.soak_rps,
+                         leak=args.soak_leak,
+                         artifacts_dir=args.soak_artifacts)
+        print(json.dumps(rec))
         return
     if args.serve:
         print(json.dumps(serve_bench(

@@ -211,3 +211,45 @@ def check_against_lastgood(capture: dict, lastgood_path: str) -> dict:
                 "skipped": [s for s, _ in SERIES],
                 "reason": f"no usable lastgood: {e}"}
     return check_capture(capture, lastgood)
+
+
+def check_soak(capture: dict) -> dict:
+    """Gate a ``bench --soak`` capture: the leak verdict must EXIST
+    with its full typed structure (a soak that forgot to sample, or a
+    verdict missing a resource block, is a broken gate — fail loudly,
+    not vacuously) and must be clean.  Returns the familiar
+    ``{"ok", "checks", "failures"}`` shape; a red verdict fails with
+    the leaking resource names so CI logs say WHAT grew, not just
+    that something did."""
+    checks: list = []
+    failures: list = []
+    verdict = capture.get("leak")
+    if not isinstance(verdict, dict):
+        return {"ok": False, "checks": checks,
+                "failures": ["capture has no leak verdict"]}
+    if verdict.get("type") != "resource_leak":
+        failures.append(
+            f"verdict type {verdict.get('type')!r} != 'resource_leak'")
+    for key in ("ok", "samples", "window_s", "leaking", "resources"):
+        if key not in verdict:
+            failures.append(f"verdict missing {key!r}")
+    resources = verdict.get("resources")
+    if isinstance(resources, dict):
+        for res in ("rss_bytes", "device_bytes", "open_fds",
+                    "threads"):
+            if res not in resources:
+                failures.append(f"verdict missing resource {res!r}")
+            else:
+                checks.append(res)
+    else:
+        failures.append("verdict resources is not a dict")
+    # a no-trend-claim verdict (too few samples) is a broken soak,
+    # not a clean one: the gate must not pass vacuously
+    if not failures and "reason" in verdict:
+        failures.append(f"no trend claim: {verdict['reason']}")
+    if not failures and verdict.get("ok") is not True:
+        failures.append(
+            "resource leak: " + ",".join(verdict.get("leaking") or
+                                         ["<unnamed>"]))
+    return {"ok": not failures, "checks": checks,
+            "failures": failures}

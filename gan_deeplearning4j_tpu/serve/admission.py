@@ -62,9 +62,9 @@ class Request:
     submitter only reads after ``done`` (the event IS the barrier)."""
 
     __slots__ = ("xs", "rows", "done", "outputs", "error",
-                 "t_submit", "t_done")
+                 "t_submit", "t_done", "trace")
 
-    def __init__(self, xs: Tuple):
+    def __init__(self, xs: Tuple, trace=None):
         self.xs = tuple(np.asarray(x) for x in xs)
         if not self.xs:
             raise ValueError("a request needs at least one input array")
@@ -76,6 +76,9 @@ class Request:
         self.error: Optional[BaseException] = None
         self.t_submit = time.perf_counter()
         self.t_done: Optional[float] = None
+        # opaque TraceContext (telemetry/tracing.py) or None; when set,
+        # the engine decomposes this request into trace.* stage spans
+        self.trace = trace
 
     def result(self, timeout: Optional[float] = None) -> List:
         """Block (bounded) for completion; return the output arrays or

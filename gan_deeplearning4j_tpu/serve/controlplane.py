@@ -134,13 +134,19 @@ class ReplicaLauncher:
                  buckets: Sequence[int] = (8, 32, 64),
                  log_dir: str = ".", host: str = "127.0.0.1",
                  ready_timeout_s: float = 120.0,
-                 env: Optional[Dict[str, str]] = None):
+                 env: Optional[Dict[str, str]] = None,
+                 events_dir: Optional[str] = None):
         self.checkpoint = checkpoint
         self.buckets = tuple(int(b) for b in buckets)
         self.log_dir = log_dir
         self.host = host
         self.ready_timeout_s = float(ready_timeout_s)
         self.env = dict(env or {})
+        # when set, each replica writes its own events timeline there
+        # (``replica_{seq}.events.jsonl``) — the per-process files
+        # telemetry.tracing.merge_trace_files joins into one
+        # cross-process trace view
+        self.events_dir = events_dir
         self._seq = 0
 
     def _read_ready_line(self, proc: subprocess.Popen,
@@ -212,6 +218,9 @@ class ReplicaLauncher:
                "--buckets", ",".join(str(b) for b in self.buckets)]
         if ckpt:
             cmd += ["--checkpoint", str(ckpt)]
+        if self.events_dir:
+            cmd += ["--events", os.path.join(
+                self.events_dir, f"replica_{seq}.events.jsonl")]
         cmd += list(extra_args)
         env = dict(os.environ)
         env.update(self.env)

@@ -51,7 +51,7 @@ from gan_deeplearning4j_tpu.parallel.inference import (
 )
 from gan_deeplearning4j_tpu.serve.admission import AdmissionQueue, Request
 from gan_deeplearning4j_tpu.serve.loadgen import percentiles
-from gan_deeplearning4j_tpu.telemetry import events
+from gan_deeplearning4j_tpu.telemetry import events, tracing
 from gan_deeplearning4j_tpu.train.watchdog import (
     HeartbeatWatchdog,
     WatchdogTimeout,
@@ -68,8 +68,10 @@ from gan_deeplearning4j_tpu.utils.device import (
 _chaos_dispatch_hook: Optional[Callable[[], None]] = None
 
 # one in-flight batch: (requests, per-segment output arrays still on
-# device, dispatch-start time, real rows, padded device rows)
-_Batch = Tuple[List[Request], List[List], float, int, int]
+# device, dispatch-start time, real rows, padded device rows, stage
+# timings — the per-stage perf_counter durations trace spans are cut
+# from when any request in the batch carries a trace context)
+_Batch = Tuple[List[Request], List[List], float, int, int, Dict]
 
 
 class DispatchError(RuntimeError):
@@ -174,7 +176,7 @@ class ServeEngine:
 
     # -- producer API (any thread) ---------------------------------------------
 
-    def submit(self, *xs) -> Request:
+    def submit(self, *xs, trace=None) -> Request:
         """Enqueue one generation request; returns the ``Request`` (its
         ``result()`` blocks for the outputs).  Raises ``ValueError``
         when the inputs don't match the served graph's input spec
@@ -183,10 +185,15 @@ class ServeEngine:
         or mint a novel compile shape), ``ShedError`` when admission
         control rejects it, ``RuntimeError`` when the engine is not
         running (a dead engine must never accept work it can't
-        finish)."""
+        finish).
+
+        ``trace``: optional ``tracing.TraceContext`` — when set, the
+        dispatch loop decomposes this request into ``trace.*`` stage
+        spans (queue wait, coalesce, bucket pad, dispatch, readback)
+        parented under it.  Untraced requests record nothing extra."""
         if not self.running:
             raise RuntimeError("serve engine is not running")
-        req = Request(xs)
+        req = Request(xs, trace=trace)
         self._validate(req)
         return self.admission.submit(req)
 
@@ -496,6 +503,7 @@ class ServeEngine:
     def _dispatch(self, reqs: List[Request],
                   wd: Optional[HeartbeatWatchdog]) -> _Batch:
         hook = _chaos_dispatch_hook
+        t_drained = time.perf_counter()
         rows = sum(r.rows for r in reqs)
         segments = self._plan(rows)
         padded = sum(segments)
@@ -511,33 +519,45 @@ class ServeEngine:
             # coalesce + pad in HOST numpy: the device only ever sees
             # exact bucket shapes, so the compiled-program set is the
             # warmed bucket forwards and nothing else
+            pad_s = 0.0
             xs = []
             for i in range(self._n_inputs):
                 parts = [r.xs[i] for r in reqs]
                 if padded > rows:
+                    tp = time.perf_counter()
                     parts.append(np.zeros(
                         (padded - rows,) + parts[0].shape[1:],
                         dtype=parts[0].dtype))
+                    pad_s += time.perf_counter() - tp
                 xs.append(parts[0] if len(parts) == 1
                           else np.concatenate(parts))
+            t_coalesced = time.perf_counter()
             outs: List[List] = []
             lo = 0
             for seg in segments:
                 outs.append(self._infer.output(
                     *[x[lo:lo + seg] for x in xs]))
                 lo += seg
-        return (reqs, outs, t0, rows, padded)
+            t_dispatched = time.perf_counter()
+        stages = {"t_drained": t_drained,
+                  "coalesce_s": (t_coalesced - t0) - pad_s,
+                  "bucket_pad_s": pad_s,
+                  "t_infer": t_coalesced,
+                  "dispatch_s": t_dispatched - t_coalesced}
+        return (reqs, outs, t0, rows, padded, stages)
 
     def _complete(self, batch: _Batch,
                   wd: Optional[HeartbeatWatchdog]) -> None:
-        reqs, seg_outs, t0, rows, padded = batch
+        reqs, seg_outs, t0, rows, padded, stages = batch
         region = wd.region("readback") if wd is not None \
             else nullcontext()
+        t_fence = time.perf_counter()
         with region:
             # the fence IS the materialization: one overlapped readback
             # of every segment's outputs; responses are then sliced in
             # numpy (no per-request device ops, no compile shapes)
             host_segs = overlap_device_get(seg_outs)
+        t_fenced = time.perf_counter()
         full = (host_segs[0] if len(host_segs) == 1
                 else [np.concatenate([seg[i] for seg in host_segs])
                       for i in range(len(host_segs[0]))])
@@ -556,6 +576,40 @@ class ServeEngine:
             for r in reqs:
                 self._latencies.append((now - r.t_submit) * 1000.0)
             del self._open[:len(reqs)]
+        # trace stage spans for traced requests — emitted OUTSIDE every
+        # lock (rule lock-held-blocking-call: the recorder may write),
+        # and only when a trace context rode in, so the untraced hot
+        # path (run_load straight into submit) records nothing extra
+        for r in reqs:
+            if r.trace is not None:
+                self._emit_trace(r, rows, stages, t_fence,
+                                 t_fenced - t_fence)
+
+    def _emit_trace(self, r: Request, batch_rows: int, stages: Dict,
+                    t_fence: float, readback_s: float) -> None:
+        """Cut one traced request's stage spans from the batch's
+        timings: queue wait is per-request, the rest are the batch's
+        shared stages (continuous batching — the batch IS the unit of
+        work, so its stage costs are every member's stage costs)."""
+        ctx = r.trace
+        base = {"trace": ctx.trace, "parent": ctx.span,
+                "rows": r.rows, "batch_rows": batch_rows}
+        events.complete("trace.queue_wait",
+                        dur=stages["t_drained"] - r.t_submit,
+                        t_start=r.t_submit,
+                        span=tracing.new_span_id(), **base)
+        events.complete("trace.coalesce", dur=stages["coalesce_s"],
+                        t_start=stages["t_drained"],
+                        span=tracing.new_span_id(), **base)
+        events.complete("trace.bucket_pad", dur=stages["bucket_pad_s"],
+                        t_start=stages["t_drained"],
+                        span=tracing.new_span_id(), **base)
+        events.complete("trace.dispatch", dur=stages["dispatch_s"],
+                        t_start=stages["t_infer"],
+                        span=tracing.new_span_id(), **base)
+        events.complete("trace.readback", dur=readback_s,
+                        t_start=t_fence,
+                        span=tracing.new_span_id(), **base)
 
     # -- hang recovery ---------------------------------------------------------
 

@@ -42,7 +42,7 @@ from gan_deeplearning4j_tpu.serve.client import (
     GatewayHTTPError,
 )
 from gan_deeplearning4j_tpu.serve.router import NoHealthyReplicaError
-from gan_deeplearning4j_tpu.telemetry import events
+from gan_deeplearning4j_tpu.telemetry import events, tracing
 
 # what a probe treats as "the socket is broken" (vs. an HTTP answer)
 _TRANSPORT_ERRORS = (ConnectionError, HTTPException, OSError)
@@ -88,9 +88,10 @@ class RemoteReplica:
 
     def generate(self, xs: Sequence[np.ndarray], *,
                  tenant: Optional[str] = None,
-                 encoding: str = "json") -> List[np.ndarray]:
+                 encoding: str = "json",
+                 trace=None) -> List[np.ndarray]:
         return self._client.generate(xs, tenant=tenant,
-                                     encoding=encoding)
+                                     encoding=encoding, trace=trace)
 
     def admin(self, verb: str, params: Optional[Dict] = None) -> Dict:
         """POST /admin/{verb}; returns the result payload.  Raises
@@ -212,9 +213,30 @@ class MeshRouter:
 
     def generate(self, xs: Sequence[np.ndarray], *,
                  tenant: Optional[str] = None,
-                 encoding: str = "json") -> List[np.ndarray]:
+                 encoding: str = "json",
+                 trace=None) -> List[np.ndarray]:
         """Place one request on a healthy replica (semantics in the
-        module docstring)."""
+        module docstring).
+
+        Tracing: the mesh is the first hop for its direct callers —
+        with ``trace=None`` it mints a root and wraps the whole
+        routing decision in a ``trace.route`` span; a caller context
+        parents the route span instead.  EVERY attempt (failed hops
+        included) is its own ``trace.hop`` child span, and the hop's
+        context rides the wire to the replica — so a failover's
+        merged trace shows both hops under one trace id."""
+        ctx = (tracing.child(trace) if trace is not None
+               else tracing.mint())
+        route_attrs = {"trace": ctx.trace, "span": ctx.span}
+        if trace is not None:
+            route_attrs["parent"] = trace.span
+        with events.span("trace.route", **route_attrs):
+            return self._generate_routed(xs, tenant, encoding, ctx)
+
+    def _generate_routed(self, xs: Sequence[np.ndarray],
+                         tenant: Optional[str], encoding: str,
+                         ctx: "tracing.TraceContext"
+                         ) -> List[np.ndarray]:
         with self._lock:
             replicas = list(self._replicas)
             start = self._rr
@@ -230,9 +252,17 @@ class MeshRouter:
             if not self._healthy(replica):
                 continue
             tried += 1
+            hop = tracing.child(ctx)
             try:
-                return replica.generate(xs, tenant=tenant,
-                                        encoding=encoding)
+                # the hop span closes with an ``error`` attribute when
+                # the attempt raises — the failed hop stays visible in
+                # the merged timeline next to the one that succeeded
+                with events.span("trace.hop", trace=ctx.trace,
+                                 span=hop.span, parent=ctx.span,
+                                 replica=replica.name):
+                    return replica.generate(xs, tenant=tenant,
+                                            encoding=encoding,
+                                            trace=hop)
             except GatewayHTTPError as e:
                 if e.status == 429:
                     last_shed = e  # alive but shedding: try the next

@@ -201,6 +201,11 @@ def main(argv=None) -> int:
     gateway.stop()
     engine.stop()
     events.instant("replica.stopped", pid=os.getpid())
+    # flush the events file's buffered tail: with fewer events than
+    # the recorder's flush_every, NOTHING would hit disk otherwise —
+    # and trace_merge would see a replica that served traffic but
+    # recorded no spans
+    events.current().close()
     return 0
 
 

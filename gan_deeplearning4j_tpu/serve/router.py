@@ -121,7 +121,7 @@ class Router:
     # -- dispatch --------------------------------------------------------------
 
     def submit(self, xs: Sequence[np.ndarray],
-               tenant: Optional[str] = None) -> Request:
+               tenant: Optional[str] = None, trace=None) -> Request:
         """Admit one request and return its ``Request`` handle.
 
         Tenant requests go to the tenant's own engine (``KeyError``
@@ -130,11 +130,12 @@ class Router:
         is ejected and skipped, a shedding replica is passed over; the
         request fails with the LAST shed only when every healthy
         replica shed it, and with ``NoHealthyReplicaError`` when none
-        was healthy at all."""
+        was healthy at all.  ``trace`` (a ``tracing.TraceContext``)
+        rides through to the engine unchanged."""
         if tenant is not None:
             if self.tenants is None:
                 raise KeyError(tenant)
-            return self.tenants.engine(tenant).submit(*xs)
+            return self.tenants.engine(tenant).submit(*xs, trace=trace)
         if not self.replicas:
             raise NoHealthyReplicaError(
                 "router has no replicas configured")
@@ -150,7 +151,7 @@ class Router:
                 continue
             tried += 1
             try:
-                return self.replicas[idx].submit(*xs)
+                return self.replicas[idx].submit(*xs, trace=trace)
             except ShedError as e:
                 last_shed = e  # at capacity, not unhealthy: try next
             except ValueError:

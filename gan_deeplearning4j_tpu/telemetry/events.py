@@ -171,6 +171,32 @@ class EventRecorder:
         self._write(ev)
         return ev
 
+    def complete(self, name: str, dur: float,
+                 t_start: Optional[float] = None,
+                 **attrs) -> Optional[Dict]:
+        """Already-timed span: a ph="X" event whose duration the
+        caller measured itself (``time.perf_counter`` seconds).  This
+        is how pipelined stages record — the serving engine learns a
+        batch's dispatch time one cycle AFTER the dispatch, so the
+        span cannot be an open ``with`` block.  ``t_start`` (a raw
+        ``perf_counter`` value) back-dates the event to when the work
+        actually began; ``wall`` is derived from the recorder's own
+        anchor so merged timelines stay on one clock."""
+        if not self.enabled:
+            return None
+        t = ((t_start - self._t0) if t_start is not None
+             else self._now() - dur)
+        ev = {"name": name, "ph": "X", "t": round(t, 6),
+              "wall": round(self._wall0 + t, 6),
+              "thread": threading.current_thread().name,
+              "dur": round(float(dur), 6)}
+        if attrs:
+            ev.update(attrs)
+        with self._lock:
+            self._ring.append(ev)
+        self._write(ev)
+        return ev
+
     def _write(self, ev: Dict) -> None:
         if self._file is None:
             return
@@ -297,6 +323,11 @@ def span(name: str, **attrs):
 
 def instant(name: str, **attrs):
     return _current.instant(name, **attrs)
+
+
+def complete(name: str, dur: float, t_start: Optional[float] = None,
+             **attrs):
+    return _current.complete(name, dur, t_start=t_start, **attrs)
 
 
 def dump_flight_record(directory: str, reason: str,

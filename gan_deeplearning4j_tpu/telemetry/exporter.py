@@ -148,6 +148,13 @@ class MetricsRegistry:
             ("gan4j_controlplane_scale_events_total", ()): 0.0,
             ("gan4j_controlplane_replaced_total", ()): 0.0,
             ("gan4j_controlplane_rollbacks_total", ()): 0.0,
+            # client keep-alive pool (serve/client.py): pool reuse /
+            # stale-socket reconnects / retry counters exist at 0 from
+            # the first scrape — a reconnect storm is a server-restart
+            # signal an alert rule must already know the name of
+            ("gan4j_client_reused_total", ()): 0.0,
+            ("gan4j_client_reconnects_total", ()): 0.0,
+            ("gan4j_client_retried_total", ()): 0.0,
         }
         self._gauges: Dict[Tuple[str, tuple], float] = {
             # age since the last data-plane incident; 0 until one
@@ -184,6 +191,13 @@ class MetricsRegistry:
             # control-plane gauge: the fleet size the controller is
             # currently holding (observe_controlplane raises it)
             ("gan4j_controlplane_replicas", ()): 0.0,
+            # resource telemetry (telemetry/resources.py): the soak
+            # gauges exist at 0 from the first scrape — a leak trend
+            # rule needs the series long before the monitor starts
+            ("gan4j_resource_rss_bytes", ()): 0.0,
+            ("gan4j_resource_device_bytes", ()): 0.0,
+            ("gan4j_resource_open_fds", ()): 0.0,
+            ("gan4j_resource_threads", ()): 0.0,
         }
         self._callbacks: List[Callable[["MetricsRegistry"], None]] = []
         self.run_id: Optional[str] = None
@@ -219,6 +233,14 @@ class MetricsRegistry:
         # drives the gan4j_controlplane_* series and the /healthz
         # "controlplane" block (ok:false once a deploy goes fatal)
         self._controlplane_fn: Optional[
+            Callable[[], Optional[Dict]]] = None
+        # client feed (serve/client.GatewayClient.report): drives the
+        # gan4j_client_* series
+        self._client_fn: Optional[Callable[[], Optional[Dict]]] = None
+        # resource feed (telemetry/resources.ResourceMonitor.report):
+        # drives the gan4j_resource_* gauges and the /healthz
+        # "resources" block
+        self._resources_fn: Optional[
             Callable[[], Optional[Dict]]] = None
 
     @staticmethod
@@ -507,6 +529,56 @@ class MetricsRegistry:
 
         self.add_callback(cb)
 
+    def observe_client(self, report_fn: Callable[[], Optional[Dict]]
+                       ) -> None:
+        """Register a ``GatewayClient.report()`` feed: connection-pool
+        reuse, reconnects, and retried requests become the
+        ``gan4j_client_*`` series — the caller-side view of the wire
+        that pairs with the gateway's server-side counters (a
+        reconnect spike with a flat gateway error rate means the
+        NETWORK between them is flapping, not the service)."""
+        with self._lock:
+            self._client_fn = report_fn
+
+        def cb(reg: "MetricsRegistry") -> None:
+            rep = report_fn()
+            if not rep:
+                return
+            reg.set_counter("gan4j_client_reused_total",
+                            float(rep.get("reused_total", 0)))
+            reg.set_counter("gan4j_client_reconnects_total",
+                            float(rep.get("reconnects_total", 0)))
+            reg.set_counter("gan4j_client_retried_total",
+                            float(rep.get("retried_total", 0)))
+
+        self.add_callback(cb)
+
+    def observe_resources(self, report_fn:
+                          Callable[[], Optional[Dict]]) -> None:
+        """Register the process-resource feed: ``report_fn`` returns a
+        ``ResourceMonitor.report()`` dict (latest RSS/device-bytes/
+        fd/thread sample).  Scrapes mirror it into the
+        ``gan4j_resource_*`` gauges and ``/healthz`` carries it as the
+        ``"resources"`` block — the live counterpart of the soak
+        gate's offline ``leak_verdict`` (telemetry/resources.py)."""
+        with self._lock:
+            self._resources_fn = report_fn
+
+        def cb(reg: "MetricsRegistry") -> None:
+            rep = report_fn()
+            if not rep:
+                return
+            for key, series in (
+                    ("rss_bytes", "gan4j_resource_rss_bytes"),
+                    ("device_bytes", "gan4j_resource_device_bytes"),
+                    ("open_fds", "gan4j_resource_open_fds"),
+                    ("threads", "gan4j_resource_threads")):
+                v = rep.get(key)
+                if isinstance(v, (int, float)):
+                    reg.set(series, float(v))
+
+        self.add_callback(cb)
+
     # -- render ---------------------------------------------------------------
 
     def render(self) -> str:
@@ -671,6 +743,23 @@ class MetricsRegistry:
                     "ok": bool(rep.get("ok", True))}
             except Exception:  # gan4j-lint: disable=swallowed-exception — a broken feed must not take down the probe
                 pass
+        # the resources block: live feed when a ResourceMonitor is
+        # sampling, else the pre-created gauges — ALWAYS present.
+        # Leak VERDICTS stay offline in the soak gate; the probe only
+        # reports the latest sample.
+        resources = None
+        rfn = self._resources_fn
+        if rfn is not None:
+            try:
+                rep = rfn() or {}
+                resources = {
+                    "rss_bytes": int(rep.get("rss_bytes", 0)),
+                    "device_bytes": int(rep.get("device_bytes", 0)),
+                    "open_fds": int(rep.get("open_fds", 0)),
+                    "threads": int(rep.get("threads", 0)),
+                    "ok": bool(rep.get("ok", True))}
+            except Exception:  # gan4j-lint: disable=swallowed-exception — a broken feed must not take down the probe
+                pass
         with self._lock:
             if data is None:
                 data = {"retries_total": int(self._counters.get(
@@ -737,6 +826,17 @@ class MetricsRegistry:
                         ("gan4j_controlplane_rollbacks_total", ()),
                         0.0)),
                     "deploy_state": None, "fatal": None, "ok": True}
+            if resources is None:
+                resources = {
+                    "rss_bytes": int(self._gauges.get(
+                        ("gan4j_resource_rss_bytes", ()), 0.0)),
+                    "device_bytes": int(self._gauges.get(
+                        ("gan4j_resource_device_bytes", ()), 0.0)),
+                    "open_fds": int(self._gauges.get(
+                        ("gan4j_resource_open_fds", ()), 0.0)),
+                    "threads": int(self._gauges.get(
+                        ("gan4j_resource_threads", ()), 0.0)),
+                    "ok": True}
             age = (None if self._last_record_wall is None
                    else round(time.time() - self._last_record_wall, 3))
             doc = {"status": "stalled" if stalled else "ok",
@@ -745,7 +845,8 @@ class MetricsRegistry:
                    "mesh": mesh, "fleet": fleet, "serve": serve,
                    "gateway": gateway,
                    "serving_mesh": serving_mesh,
-                   "controlplane": controlplane}
+                   "controlplane": controlplane,
+                   "resources": resources}
             if beat_age is not None:
                 doc["last_beat_age_s"] = round(float(beat_age), 3)
             return doc

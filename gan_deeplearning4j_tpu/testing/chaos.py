@@ -893,3 +893,50 @@ def poison_checkpoint_dir(directory: str, name: str = "gen") -> int:
     os.rename(tmp, os.path.join(directory, f"ckpt_{new_step}"))
     _ckpt_mod._fsync_dir(directory)
     return new_step
+
+
+class LeakyDispatchSource:
+    """Reference-hoarding leak injector for the soak gate
+    (``bench --soak --soak-leak``): hooks the serving engine's
+    per-batch dispatch (``serve/engine._chaos_dispatch_hook``, the
+    same seam ``hang_at_dispatch`` uses) and APPENDS
+    ``bytes_per_dispatch`` of live memory to an internal hoard on
+    every batch — the classic "a callback captured a buffer and the
+    list never drains" production leak.  RSS then grows linearly with
+    served load, which is exactly the signature
+    ``telemetry/resources.leak_verdict`` must flag; the CI soak lane
+    uses this to prove the leak gate CAN fail."""
+
+    def __init__(self, bytes_per_dispatch: int = 256 << 10):
+        if bytes_per_dispatch <= 0:
+            raise ValueError("bytes_per_dispatch must be > 0")
+        self.bytes_per_dispatch = int(bytes_per_dispatch)
+        self.hoard: list = []   # the leak: grows forever, never read
+        self.dispatches = 0
+        self._prev = None
+        self._serve_mod = None
+
+    def _hook(self) -> None:
+        # bytearray, not bytes: guarantees fresh, non-interned pages
+        self.hoard.append(bytearray(self.bytes_per_dispatch))
+        self.dispatches += 1
+
+    def install(self) -> "LeakyDispatchSource":
+        from gan_deeplearning4j_tpu.serve import engine as _serve_mod
+
+        self._serve_mod = _serve_mod
+        self._prev = _serve_mod._chaos_dispatch_hook
+        _serve_mod._chaos_dispatch_hook = self._hook
+        return self
+
+    def uninstall(self) -> None:
+        if self._serve_mod is not None:
+            self._serve_mod._chaos_dispatch_hook = self._prev
+            self._serve_mod = None
+        self.hoard.clear()
+
+    def __enter__(self) -> "LeakyDispatchSource":
+        return self.install()
+
+    def __exit__(self, *exc) -> None:
+        self.uninstall()

@@ -52,7 +52,10 @@ def test_dead_backend_emits_structured_skip():
         "TPU_LIBRARY_PATH": "/nonexistent/libtpu.so",
         "BENCH_PROBE_ATTEMPTS": "2",
         "BENCH_PROBE_BACKOFF": "1",
-        "BENCH_PROBE_TIMEOUT": "60",
+        # the dead-TPU init HANGS (it does not fail fast), so every
+        # attempt burns the FULL probe timeout before the kill: this
+        # knob is pure wall-clock, 2x60s of it at the old value
+        "BENCH_PROBE_TIMEOUT": "15",
     })
     assert out.returncode == 0, (out.stdout[-500:], out.stderr[-500:])
     rec = _last_json(out)

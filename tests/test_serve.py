@@ -57,8 +57,15 @@ def gen_infer(cpu_devices):
 @pytest.fixture(scope="module")
 def warm_engine(gen_infer):
     """A started, bucket-warmed engine for the tests that only need
-    traffic (admission, load, exporter) — torn down once."""
-    eng = ServeEngine(infer=gen_infer, watchdog_deadline_s=30.0)
+    traffic (admission, load, exporter) — torn down once.  The
+    admission deadline budget is deliberately roomy: these tests
+    assert recompile/chunking/validation contracts, not shed policy
+    (which has its own queues with explicit budgets below), and the
+    default 1000ms budget is within noise of a loaded single-core
+    runner's small-batch service rate — a 70-row chunked request
+    would shed on an estimate of 69 rows/s."""
+    eng = ServeEngine(infer=gen_infer, watchdog_deadline_s=30.0,
+                      admission=AdmissionQueue(deadline_ms=10_000.0))
     eng.warmup(np.zeros((1, 2), np.float32))
     eng.start()
     yield eng

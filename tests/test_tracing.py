@@ -1,0 +1,461 @@
+"""Distributed request tracing (telemetry/tracing.py) + resource
+telemetry with the leak gate (telemetry/resources.py).
+
+Three layers of evidence:
+
+* **pure units** — header mint/child/parse roundtrips (malformed
+  headers degrade to None, never raise), the cross-file merge's
+  completeness/parenting/wall-normalization rules on hand-built
+  timelines, the Theil–Sen slope's robustness to outliers, and the
+  typed leak verdict on synthetic sample rings.
+* **in-process socket contracts** — a real gateway over a real
+  loopback socket: success replies carry the echoed ``X-Gan4j-Trace``
+  and a ``Server-Timing`` stage breakdown and the request resolves to
+  ONE complete span tree (client wire spans, gateway stages, engine
+  stage decomposition, all parented through the wire header); error
+  replies (503 from an empty router, 400 from a bad body) echo the
+  trace header too and land a terminal ``trace.reject`` event.
+* **cross-process acceptance** — two replica PROCESSES behind a
+  ``MeshRouter``; one is SIGKILLed mid-sequence and the next traced
+  generate FAILS OVER: the merged timeline (test process + per-replica
+  events files) shows both hops — the failed one closing with
+  ``error``, the succeeding one carrying the request into the other
+  process — under ONE trace id, complete, spanning >= 2 processes.
+
+Process spawns cost ~3-4s each; the acceptance test budgets two.
+"""
+
+import glob
+import json
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from gan_deeplearning4j_tpu.models import dcgan_mnist as M
+from gan_deeplearning4j_tpu.parallel import data_mesh
+from gan_deeplearning4j_tpu.parallel.inference import ParallelInference
+from gan_deeplearning4j_tpu.serve import (
+    Gateway,
+    GatewayClient,
+    MeshRouter,
+    RemoteReplica,
+    ReplicaLauncher,
+    Router,
+    ServeEngine,
+)
+from gan_deeplearning4j_tpu.telemetry import events, tracing
+from gan_deeplearning4j_tpu.telemetry.resources import (
+    ResourceMonitor,
+    leak_verdict,
+    theil_sen_slope,
+)
+from gan_deeplearning4j_tpu.testing import chaos
+
+BUCKETS = (8, 32)
+REPLICA_ENV = {"JAX_PLATFORMS": "cpu"}
+
+
+def _mk(rows, seed=0):
+    return np.random.RandomState(seed).rand(rows, 2).astype(
+        np.float32) * 2 - 1
+
+
+# -- pure units: context + header ----------------------------------------------
+
+
+def test_header_roundtrip():
+    ctx = tracing.mint()
+    hdr = tracing.to_header(ctx)
+    assert hdr == f"trace={ctx.trace};parent={ctx.span}"
+    assert tracing.from_header(hdr) == ctx
+
+
+def test_child_keeps_trace_and_changes_span():
+    root = tracing.mint()
+    kid = tracing.child(root)
+    assert kid.trace == root.trace
+    assert kid.span != root.span
+    assert tracing.child(root).span != kid.span  # fresh every time
+
+
+@pytest.mark.parametrize("bad", [
+    None, "", "garbage", "trace=;parent=x", "parent=only",
+    "trace=" + "a" * 200 + ";parent=b",   # oversized id
+])
+def test_malformed_header_is_none_not_an_error(bad):
+    assert tracing.from_header(bad) is None
+
+
+def test_header_parse_ignores_unknown_fields():
+    # forward compatibility: extra ;key=value fields don't reject the
+    # context (and a repeated key is last-wins, not an error)
+    got = tracing.from_header("trace=a;parent=b;extra=junk")
+    assert got == tracing.TraceContext("a", "b")
+
+
+def test_span_ids_are_pid_prefixed_and_unique():
+    ids = {tracing.new_span_id() for _ in range(100)}
+    assert len(ids) == 100
+    assert all(i.startswith(f"{os.getpid():x}-") for i in ids)
+
+
+# -- pure units: the cross-file merge ------------------------------------------
+
+
+def _recorder_file(path, host, fn):
+    """Run ``fn()`` under a file recorder claiming to be ``host``."""
+    rec = events.EventRecorder(path=str(path))
+    rec.host = host
+    prev = events.install(rec)
+    try:
+        fn()
+    finally:
+        events.install(prev)
+        rec.close()
+
+
+def test_merge_joins_processes_and_normalizes_wall(tmp_path):
+    root = tracing.mint()
+    hop = tracing.child(root)
+    g = tracing.child(hop)
+
+    def proc_a():
+        with events.span("trace.route", trace=root.trace,
+                         span=root.span):
+            with events.span("trace.hop", trace=root.trace,
+                             span=hop.span, parent=root.span):
+                time.sleep(0.02)
+
+    def proc_b():
+        events.complete("trace.request", dur=0.01, trace=root.trace,
+                        span=g.span, parent=hop.span)
+        events.complete("trace.queue_wait", dur=0.002,
+                        trace=root.trace, span=tracing.new_span_id(),
+                        parent=g.span)
+
+    _recorder_file(tmp_path / "a.jsonl", "hostA:1", proc_a)
+    _recorder_file(tmp_path / "b.jsonl", "hostB:2", proc_b)
+    merged = tracing.merge_trace_files(
+        [str(tmp_path / "a.jsonl"), str(tmp_path / "b.jsonl")])
+    assert merged["stats"]["files"] == 2
+    assert merged["stats"]["traces"] == 1
+    assert merged["stats"]["complete"] == 1
+    assert merged["stats"]["cross_process"] == 1
+    tr = merged["traces"][root.trace]
+    assert tr["complete"] and tr["root"] == "trace.route"
+    assert len(tr["processes"]) == 2
+    # wall normalization: every span's wall time is absolute (anchored
+    # through its file's recorder.start), so the merged order is
+    # chronological across files, not file-concatenation order
+    walls = [s["wall"] for s in tr["spans"]]
+    assert walls == sorted(walls)
+    assert all(abs(w - time.time()) < 60 for w in walls)
+
+
+def test_merge_flags_orphan_parent_as_incomplete(tmp_path):
+    tid = tracing.new_trace_id()
+
+    def proc():
+        events.complete("trace.request", dur=0.01, trace=tid,
+                        span="s1", parent="never-recorded")
+
+    _recorder_file(tmp_path / "a.jsonl", "hostA:1", proc)
+    merged = tracing.merge_trace_files([str(tmp_path / "a.jsonl")])
+    assert merged["stats"]["complete_frac"] == 0.0
+    assert not merged["traces"][tid]["complete"]
+
+
+def test_merge_skips_unreadable_files(tmp_path):
+    tid = tracing.new_trace_id()
+    _recorder_file(
+        tmp_path / "a.jsonl", "hostA:1",
+        lambda: events.complete("trace.request", dur=0.01, trace=tid,
+                                span="s1"))
+    merged = tracing.merge_trace_files(
+        [str(tmp_path / "a.jsonl"), str(tmp_path / "missing.jsonl")])
+    assert merged["stats"]["files"] == 1
+    assert merged["traces"][tid]["complete"]
+
+
+# -- pure units: the leak gate -------------------------------------------------
+
+
+def test_theil_sen_ignores_outliers():
+    ts = [float(i) for i in range(50)]
+    vs = [10.0 + 2.0 * t for t in ts]
+    vs[25] = 1e9  # one GC-spike-sized outlier
+    slope = theil_sen_slope(ts, vs)
+    assert abs(slope - 2.0) < 0.2
+
+
+def _ring(n=60, dt=0.5, rss=200 << 20, rss_per_s=0.0, fds=32,
+          threads=8):
+    return [{"t": i * dt, "rss_bytes": rss + rss_per_s * i * dt,
+             "device_bytes": 0, "open_fds": fds, "threads": threads}
+            for i in range(n)]
+
+
+def test_leak_verdict_clean_is_typed_and_ok():
+    v = leak_verdict(_ring())
+    assert v["ok"] and v["type"] == "resource_leak"
+    assert v["leaking"] == []
+    assert set(v["resources"]) == {"rss_bytes", "device_bytes",
+                                   "open_fds", "threads"}
+    for block in v["resources"].values():
+        assert "growth" in block and "growth_threshold" in block
+
+
+def test_leak_verdict_flags_linear_rss_growth():
+    v = leak_verdict(_ring(rss_per_s=float(4 << 20)))  # 4 MiB/s
+    assert not v["ok"]
+    assert v["leaking"] == ["rss_bytes"]
+    blk = v["resources"]["rss_bytes"]
+    assert blk["leak"] and blk["slope_per_s"] > blk["slope_threshold"]
+
+
+def test_leak_verdict_needs_both_slope_and_growth():
+    # steep slope but a tiny window: growth below the 32 MiB floor —
+    # a short blip must not be called a leak
+    ring = _ring(n=20, dt=0.1, rss_per_s=float(4 << 20))
+    assert leak_verdict(ring)["ok"]
+
+
+def test_leak_verdict_fd_growth_gates_without_slope():
+    ring = _ring()
+    for i, s in enumerate(ring):
+        s["open_fds"] = 32 + i * 3  # staircase past the +64 floor
+    v = leak_verdict(ring)
+    assert not v["ok"] and "open_fds" in v["leaking"]
+
+
+def test_leak_verdict_too_few_samples_is_no_claim():
+    v = leak_verdict(_ring(n=3))
+    assert v["ok"] and "reason" in v
+    # ...but the soak GATE refuses the vacuous pass
+    from gan_deeplearning4j_tpu import bench_gate
+
+    gate = bench_gate.check_soak({"leak": v})
+    assert not gate["ok"]
+
+
+def test_check_soak_red_names_the_resource():
+    from gan_deeplearning4j_tpu import bench_gate
+
+    v = leak_verdict(_ring(rss_per_s=float(4 << 20)))
+    gate = bench_gate.check_soak({"leak": v})
+    assert not gate["ok"]
+    assert any("rss_bytes" in f for f in gate["failures"])
+    clean = bench_gate.check_soak({"leak": leak_verdict(_ring())})
+    assert clean["ok"]
+
+
+def test_resource_monitor_samples_and_reports():
+    mon = ResourceMonitor(interval_s=0.01)
+    with mon:
+        time.sleep(0.12)
+        assert any(t.name == "gan4j-resource-sampler"
+                   for t in threading.enumerate())
+        rep = mon.report()
+    samples = mon.samples()
+    assert len(samples) >= 8
+    assert samples[0]["rss_bytes"] > 0
+    assert samples[0]["open_fds"] > 0
+    assert samples[0]["threads"] >= 1
+    assert rep["rss_bytes"] > 0 and rep["ok"] is True
+    assert not any(t.name == "gan4j-resource-sampler"
+                   for t in threading.enumerate())
+
+
+def test_leaky_dispatch_source_hoards_per_call():
+    inj = chaos.LeakyDispatchSource(bytes_per_dispatch=1024)
+    with inj:
+        from gan_deeplearning4j_tpu.serve import engine as engine_mod
+
+        assert engine_mod._chaos_dispatch_hook is not None
+        for _ in range(5):
+            engine_mod._chaos_dispatch_hook()
+        assert inj.dispatches == 5
+        assert sum(len(b) for b in inj.hoard) == 5 * 1024
+    from gan_deeplearning4j_tpu.serve import engine as engine_mod
+
+    assert engine_mod._chaos_dispatch_hook is None
+    assert inj.hoard == []  # uninstall releases the references
+
+
+# -- in-process socket contracts -----------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def gen_infer(cpu_devices):
+    gen = M.build_generator()
+    return ParallelInference(gen, mesh=data_mesh(8), buckets=BUCKETS)
+
+
+def test_gateway_success_trace_tree_and_server_timing(gen_infer,
+                                                      tmp_path):
+    ev_path = str(tmp_path / "events.jsonl")
+    recorder = events.EventRecorder(path=ev_path)
+    prev = events.install(recorder)
+    eng = ServeEngine(infer=gen_infer, watchdog_deadline_s=30.0)
+    eng.warmup(np.zeros((1, 2), np.float32))
+    eng.start()
+    try:
+        with Gateway(Router([eng])) as gw:
+            client = GatewayClient("127.0.0.1", gw.port, retries=0)
+            try:
+                ctx = tracing.mint()
+                body = json.dumps(
+                    {"inputs": [_mk(4).tolist()]}).encode()
+                # the caller records its own root span (what
+                # client.generate does for untraced callers) so the
+                # merged tree has exactly one root
+                with events.span("trace.client", trace=ctx.trace,
+                                 span=ctx.span):
+                    status, headers, _ = client._request(
+                        "POST", "/v1/generate", body,
+                        "application/json", trace=ctx)
+            finally:
+                client.close()
+    finally:
+        eng.stop()
+        events.install(prev)
+        recorder.close()
+    assert status == 200
+    # the wire contract additions: trace echo + stage breakdown
+    assert headers.get(tracing.TRACE_HEADER, "").startswith(
+        f"trace={ctx.trace};")
+    timing = headers.get(tracing.TIMING_HEADER, "")
+    assert "dispatch;dur=" in timing and "decode;dur=" in timing
+    # the request resolves to ONE complete tree rooted at the caller's
+    # context, containing every layer's spans
+    merged = tracing.merge_trace_files([ev_path])
+    tr = merged["traces"][ctx.trace]
+    assert tr["complete"], tr
+    names = {s["name"] for s in tr["spans"]}
+    assert {"trace.wire_send", "trace.wire_recv", "trace.request",
+            "trace.rate_limit", "trace.decode", "trace.dispatch_wait",
+            "trace.response_encode", "trace.queue_wait",
+            "trace.coalesce", "trace.bucket_pad", "trace.dispatch",
+            "trace.readback"} <= names, names
+
+
+def test_untraced_engine_requests_record_no_trace_events(gen_infer):
+    recorder = events.EventRecorder(ring_size=2048)
+    prev = events.install(recorder)
+    eng = ServeEngine(infer=gen_infer, watchdog_deadline_s=30.0)
+    eng.warmup(np.zeros((1, 2), np.float32))
+    eng.start()
+    try:
+        assert eng.submit(_mk(4)).result(timeout=30)[0].shape[0] == 4
+    finally:
+        eng.stop()
+        events.install(prev)
+    assert not [e for e in recorder.recent()
+                if e["name"].startswith("trace.")]
+
+
+def test_gateway_error_replies_echo_trace_and_reject(tmp_path):
+    """Satellite bugfix pin: EVERY error reply carries the trace
+    header back and lands a terminal ``trace.reject`` event."""
+    recorder = events.EventRecorder(ring_size=1024)
+    prev = events.install(recorder)
+    try:
+        with Gateway(Router([])) as gw:   # nobody behind the door
+            client = GatewayClient("127.0.0.1", gw.port, retries=0)
+            try:
+                ctx = tracing.mint()
+                body = json.dumps(
+                    {"inputs": [_mk(4).tolist()]}).encode()
+                status, headers, data = client._request(
+                    "POST", "/v1/generate", body,
+                    "application/json", trace=ctx)
+                assert status == 503
+                assert headers.get(tracing.TRACE_HEADER) == \
+                    tracing.to_header(tracing.from_header(
+                        headers[tracing.TRACE_HEADER]))
+                assert f"trace={ctx.trace};" in headers[
+                    tracing.TRACE_HEADER]
+                ctx2 = tracing.mint()
+                status2, headers2, _ = client._request(
+                    "POST", "/v1/generate", b"not json",
+                    "application/json", trace=ctx2)
+                assert status2 == 400
+                assert f"trace={ctx2.trace};" in headers2[
+                    tracing.TRACE_HEADER]
+            finally:
+                client.close()
+    finally:
+        events.install(prev)
+    rejects = [e for e in recorder.recent()
+               if e["name"] == "trace.reject"]
+    assert {e["trace"] for e in rejects} >= {ctx.trace, ctx2.trace}
+    by_trace = {e["trace"]: e for e in rejects}
+    assert by_trace[ctx.trace]["status"] == 503
+    assert by_trace[ctx2.trace]["status"] == 400
+
+
+# -- cross-process acceptance: failover continuity -----------------------------
+
+
+def test_failover_trace_spans_both_hops_and_processes(tmp_path):
+    """Satellite: eject a replica mid-sequence; the traced generate
+    that fails over shows BOTH hops — the dead one closing with
+    ``error``, the live one carrying the request into the other
+    process — under one trace id, complete, >= 2 processes."""
+    launcher = ReplicaLauncher(buckets=BUCKETS,
+                               log_dir=str(tmp_path),
+                               events_dir=str(tmp_path),
+                               env=REPLICA_ENV)
+    ev_path = str(tmp_path / "test.events.jsonl")
+    recorder = events.EventRecorder(path=ev_path)
+    prev = events.install(recorder)
+    procs, mesh = [], MeshRouter(recheck_s=30.0)
+    failover_ctx = None
+    try:
+        for _ in range(2):
+            p = launcher.spawn()
+            procs.append(p)
+            mesh.add(RemoteReplica(p.host, p.port))
+        # round-robin starts at replica 0: burn one rotation so the
+        # NEXT generate offers replica 1 first, then kill replica 1 —
+        # that generate must fail over to replica 0
+        assert np.isfinite(mesh.generate([_mk(4)])[0]).all()
+        chaos.kill_replica_process(procs[1])
+        failover_ctx = tracing.mint()
+        # record the caller-side root span: mesh parents trace.route
+        # under the caller's span, so without this the tree is orphaned
+        with events.span("trace.client", trace=failover_ctx.trace,
+                         span=failover_ctx.span):
+            out = mesh.generate([_mk(4, seed=1)],
+                                trace=failover_ctx)[0]
+        assert np.isfinite(out).all()
+    finally:
+        for p in procs:
+            try:
+                mesh.remove(p.name)
+            finally:
+                p.stop()     # SIGTERM: the live replica flushes its
+            #                  events tail before the merge below
+        mesh.close()
+        events.install(prev)
+        recorder.close()
+    merged = tracing.merge_trace_files(
+        [ev_path] + sorted(glob.glob(
+            os.path.join(str(tmp_path), "replica_*.events.jsonl"))))
+    tr = merged["traces"][failover_ctx.trace]
+    hops = [s for s in tr["spans"] if s["name"] == "trace.hop"]
+    assert len(hops) == 2, [s["name"] for s in tr["spans"]]
+    failed = [h for h in hops if "error" in h]
+    lived = [h for h in hops if "error" not in h]
+    assert len(failed) == 1 and len(lived) == 1
+    assert failed[0]["attrs"]["replica"] == procs[1].name
+    assert lived[0]["attrs"]["replica"] == procs[0].name
+    assert tr["complete"], tr
+    assert len(tr["processes"]) >= 2, tr["processes"]
+    # the surviving replica's request span is parented on the LIVE
+    # hop — the wire header did the parenting across the process gap
+    reqs = [s for s in tr["spans"] if s["name"] == "trace.request"]
+    assert any(s.get("parent") == lived[0]["span"] for s in reqs)
