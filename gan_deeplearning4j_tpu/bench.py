@@ -1916,6 +1916,11 @@ def dryrun(telemetry: bool = True,
                 stop()
                 events_mod.install(prev_rec)
                 recorder.close()
+        # publication-pipeline surface (serve/publisher.py): promote /
+        # reject / scrape series, in-process — the cheap slice of the
+        # combined-chaos scenario the CI scenario lane runs in full
+        scenario_rec = publication_smoke()
+        scenario_ok = scenario_rec["ok"]
         return {"metric": "dcgan_mnist_img_per_sec", "dryrun": True,
                 "ok": bool(ok and math.isfinite(t) and ckpt_ok
                            and exporter_ok and events_ok
@@ -1924,7 +1929,7 @@ def dryrun(telemetry: bool = True,
                            and prove["ok"] and race_ok
                            and bench_stable_ok and fleet_ok
                            and serve_ok and gateway_ok and mesh_ok
-                           and trace_ok),
+                           and trace_ok and scenario_ok),
                 "platform": device.platform,
                 "telemetry": telemetry,
                 "checkpoint": ckpt,
@@ -1951,6 +1956,8 @@ def dryrun(telemetry: bool = True,
                 "controlplane": cp_rec,
                 "trace_ok": bool(trace_ok),
                 "trace": t_stats,
+                "scenario_ok": bool(scenario_ok),
+                "scenario": scenario_rec,
                 "trace_overhead_frac": round(trace_overhead_frac, 6),
                 "trace_span_record_us": round(per_event_us, 3),
                 "bench_stable_ok": bool(bench_stable_ok),
@@ -1958,6 +1965,95 @@ def dryrun(telemetry: bool = True,
                 "watchdog_beat_us": round(beat_us, 3)}
     finally:
         BATCH = prev_batch
+
+
+def publication_smoke() -> dict:
+    """In-process checkpoint-publication pipeline smoke (the --dryrun
+    slice of the combined-chaos scenario): a verified fleet checkpoint
+    promotes through the publisher's deploy seam, the poisoned forge
+    (testing.chaos.poison_fleet_checkpoint_dir) is REJECTED by the
+    finite-params probe without ever reaching a deploy, and the
+    ``gan4j_publish_*`` scrape surface + the ``/healthz`` publication
+    block carry both outcomes."""
+    import tempfile
+
+    from gan_deeplearning4j_tpu.models import mlpgan_insurance as _ins
+    from gan_deeplearning4j_tpu.serve.publisher import (
+        CheckpointPublisher,
+    )
+    from gan_deeplearning4j_tpu.telemetry.exporter import (
+        MetricsRegistry,
+    )
+    from gan_deeplearning4j_tpu.testing.chaos import (
+        poison_fleet_checkpoint_dir,
+    )
+    from gan_deeplearning4j_tpu.train import fused_step as _fused
+    from gan_deeplearning4j_tpu.train.fleet import (
+        FleetCheckpointer,
+        replicate_state,
+    )
+
+    cfg = _ins.InsuranceConfig()
+    dis = _ins.build_discriminator(cfg)
+    graphs = (dis, _ins.build_generator(cfg), _ins.build_gan(cfg),
+              _ins.build_classifier(dis, cfg))
+    state = replicate_state(_fused.state_from_graphs(*graphs), 2)
+    deploys = []
+    with tempfile.TemporaryDirectory(prefix="gan4j_pub_") as d:
+        FleetCheckpointer(d, keep=8).save(1, state)
+        pub = CheckpointPublisher(
+            d, deploy_fn=lambda directory, step:
+            (deploys.append(step), "promoted")[1])
+        pub.poll_once()
+        bad = poison_fleet_checkpoint_dir(d, tenant=0)
+        pub.poll_once()
+        rep = pub.report()
+        reg = MetricsRegistry()
+        reg.observe_publication(pub.report)
+        body = reg.render()
+        health = reg.health()
+    blk = health.get("publication") or {}
+    ok = (deploys == [1]
+          and rep["promoted_total"] == 1
+          and rep["rejected_total"] == 1
+          and rep["last_step"] == 1
+          and bad not in rep["promoted_steps"]
+          and "gan4j_publish_promoted_total 1" in body
+          and "gan4j_publish_rejected_total 1" in body
+          and "gan4j_publish_last_step 1" in body
+          and blk.get("last_step") == 1 and blk.get("ok") is True
+          and health.get("serving_stale") is False)
+    return {"ok": bool(ok), "deploys": deploys, "poisoned_step": bad,
+            "publish": {k: rep[k] for k in
+                        ("last_step", "promoted_total",
+                         "rejected_total", "ok")}}
+
+
+def scenario_bench(*, seed: int = 23, soak: bool = False,
+                   budget_s: float = 180.0,
+                   artifacts_dir: Optional[str] = None) -> dict:
+    """The combined-chaos train→serve scenario (scenario/runner.py) as
+    a bench verb: fleet-trains-while-mesh-serves under the seeded
+    chaos schedule, typed verdict printed as one JSON line.  With
+    ``soak`` the run additionally samples resources and must pass the
+    ``bench_gate.check_soak`` leak gate — the scenario as a soak
+    payload."""
+    import tempfile
+
+    from gan_deeplearning4j_tpu import bench_gate
+    from gan_deeplearning4j_tpu.scenario import run_scenario
+
+    if artifacts_dir is None:
+        artifacts_dir = tempfile.mkdtemp(prefix="gan4j_scenario_")
+    rec = run_scenario(artifacts_dir, seed=seed, soak=soak,
+                       budget_s=budget_s)
+    if soak:
+        gate = bench_gate.check_soak(rec)
+        rec["gate"] = gate
+        rec["ok"] = bool(rec["ok"] and gate["ok"])
+        if not gate["ok"]:
+            rec["failures"].append(f"soak_gate: {gate}")
+    return rec
 
 
 def soak_bench(soak_seconds: float = 30.0, *, rate_rps: float = 40.0,
@@ -2142,6 +2238,26 @@ def main(argv=None) -> None:
                    help="serve /metrics + /healthz during the e2e "
                         "trainer run (and the --dryrun smoke's "
                         "self-scrape); 0 = ephemeral")
+    p.add_argument("--scenario", action="store_true",
+                   help="combined-chaos train→serve scenario "
+                        "(scenario/runner.py): a fleet trainer "
+                        "checkpoints through preemption/device-loss "
+                        "while a fleet serving mesh answers traffic, "
+                        "the publisher carries every verified "
+                        "checkpoint across via canary, and a seeded "
+                        "chaos schedule breaks both planes; one typed-"
+                        "verdict JSON line.  Combine with --soak to "
+                        "also sample resources and ride the leak gate")
+    p.add_argument("--scenario-seed", type=int, default=23,
+                   help="chaos schedule / data / trainer seed")
+    p.add_argument("--scenario-budget-s", type=float, default=180.0,
+                   metavar="S",
+                   help="wall budget recorded in the verdict (CI "
+                        "lanes enforce it with their own timeout)")
+    p.add_argument("--scenario-artifacts", default=None, metavar="DIR",
+                   help="write scenario artifacts (scenario.json, "
+                        "merged trace, child logs/events) here "
+                        "instead of a fresh tempdir")
     p.add_argument("--soak", action="store_true",
                    help="wall-clock soak with the LEAK GATE: run the "
                         "full serving stack under load for "
@@ -2310,6 +2426,13 @@ def main(argv=None) -> None:
         print(json.dumps(dryrun(telemetry=args.telemetry,
                                 metrics_port=args.metrics_port)))
         return
+    if args.scenario:
+        rec = scenario_bench(seed=args.scenario_seed,
+                             soak=args.soak,
+                             budget_s=args.scenario_budget_s,
+                             artifacts_dir=args.scenario_artifacts)
+        print(json.dumps(rec, default=float))
+        sys.exit(0 if rec["ok"] else 1)
     if args.soak:
         rec = soak_bench(soak_seconds=args.soak_seconds,
                          rate_rps=args.soak_rps,

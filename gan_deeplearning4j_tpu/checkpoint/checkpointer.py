@@ -159,11 +159,20 @@ def snapshot_state(graphs: Dict[str, object], step: int,
 
 
 class TrainCheckpointer:
-    def __init__(self, directory: str, keep: int = 3):
+    def __init__(self, directory: str, keep: int = 3,
+                 sweep_debris: bool = True):
+        """``sweep_debris=False`` makes this a READ-SIDE handle: no
+        debris purge / orphan adoption at init.  Anything watching a
+        directory some OTHER process is actively saving into — the
+        checkpoint publisher, a serving bank hotswap — must pass False:
+        the owner's in-flight ``.ckpt_tmp_*`` is indistinguishable from
+        crash debris, and sweeping it tears the save mid-write.  Only
+        the directory's owner (the trainer, at startup) sweeps."""
         self.directory = directory
         self.keep = keep
         os.makedirs(directory, exist_ok=True)
-        self._purge_debris()
+        if sweep_debris:
+            self._purge_debris()
 
     def _purge_debris(self) -> None:
         """Reclaim temp/swap dirs a hard kill mid-save left behind —

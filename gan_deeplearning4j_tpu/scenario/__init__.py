@@ -1,0 +1,22 @@
+"""Combined-chaos train→serve scenario: the production organism.
+
+One process tree, two planes, every robustness subsystem engaged at
+once: a fleet trainer (train/fleet.py) checkpoints while a serving
+mesh (serve/controlplane.py) answers traffic; the checkpoint
+publisher (serve/publisher.py) carries every verified checkpoint
+across the gap via canary deployment; a seeded chaos schedule
+(testing/chaos.py) injects preemption, device loss, replica kills,
+slow-loris sockets, and corrupt tenant rows into both planes at
+once.  The runner (scenario/runner.py) orchestrates the whole thing
+and emits a typed verdict; the trainer child
+(scenario/trainer_child.py) is the preemptible unit the runner
+respawns.
+
+Entry point: ``bench --scenario`` (``--soak`` rides the leak gate),
+or ``run_scenario`` directly.  docs/SCENARIO.md is the operator's
+guide.
+"""
+
+from gan_deeplearning4j_tpu.scenario.runner import run_scenario
+
+__all__ = ["run_scenario"]

@@ -1,0 +1,651 @@
+"""Combined-chaos train→serve scenario runner.
+
+One invocation stands up the WHOLE production organism and breaks it
+on a seeded schedule, across both planes at once:
+
+* a fleet trainer (scenario/trainer_child.py, a real subprocess)
+  checkpoints while it trains; the runner respawns it through the
+  preemption (exit 75) and device-lost (exit 82, shrunk world)
+  protocols;
+* a serving mesh (serve/controlplane.py, fleet replicas as real
+  subprocesses) answers plain + per-tenant traffic throughout;
+* the checkpoint publisher (serve/publisher.py) carries every
+  verified checkpoint across the train→serve gap via canary
+  deployment — and rejects the poisoned one;
+* a seeded :class:`~gan_deeplearning4j_tpu.testing.chaos.ChaosSchedule`
+  coordinates the injections (trainer SIGTERM, corrupt tenant rows,
+  replica SIGKILL, slow-loris, device-lost + world shrink) and writes
+  its deterministic timeline into the events stream.
+
+The verdict is TYPED: zero non-typed serving failures, every verified
+checkpoint promoted and the poisoned one rejected
+(``gan4j_publish_rejected_total >= 1``), a direct poisoned deploy
+rolled back by the canary, serving stale-but-answering after the
+trainer stops, the chaos trajectory banded ≤``band`` (default 5%)
+against an undisturbed control run at identical step count, and ONE
+merged cross-process timeline (telemetry/tracing.merge_trace_files)
+spanning every trainer incarnation and every replica.  ``soak=True``
+additionally samples resources for the leak gate
+(bench_gate.check_soak) — the scenario as a soak payload.
+
+Entry: ``bench --scenario [--soak]``; docs/SCENARIO.md is the
+operator's guide.
+"""
+
+import glob
+import json
+import os
+import shutil
+import signal
+import subprocess
+import sys
+import threading
+import time
+from typing import Dict, List, Optional
+
+import numpy as np
+
+_PKG_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+TRAINER_MODULE = "gan_deeplearning4j_tpu.scenario.trainer_child"
+
+# the merged-timeline ingestion set: every plane's instant events
+TRACE_EVENT_PREFIXES = (
+    "fleet.", "preempt.", "chaos.", "publish.", "serve.", "replica.",
+    "controlplane.", "scenario.", "router.", "mesh.",
+)
+
+
+def _child_env(world: Optional[int]) -> Dict[str, str]:
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    # subprocesses must resolve the package the same way this process
+    # did (the repo is run in-tree, not installed)
+    env["PYTHONPATH"] = _PKG_ROOT + os.pathsep + env.get("PYTHONPATH", "")
+    if world and world > 1:
+        env["XLA_FLAGS"] = (
+            f"--xla_force_host_platform_device_count={world} "
+            + env.get("XLA_FLAGS", "")).strip()
+    return env
+
+
+def _write_insurance_csv(path: str, rows: int, width: int,
+                         seed: int) -> None:
+    rng = np.random.default_rng(seed)
+    feats = rng.uniform(0.0, 1.0, size=(rows, width - 1))
+    labels = (rng.random(rows) < 0.5).astype(np.float64)
+    data = np.concatenate([feats, labels[:, None]], axis=1)
+    with open(path, "w") as f:
+        for r in data:
+            f.write(",".join(f"{v:.6f}" for v in r) + "\n")
+
+
+class _LoadLoop:
+    """Continuous plain + per-tenant traffic against whatever replicas
+    the control plane currently reports — the SLO witness.  Every
+    failure is CLASSIFIED: wire/HTTP/route errors during chaos are
+    typed (expected, counted); anything else is a non-typed failure
+    that fails the verdict."""
+
+    def __init__(self, cp, tenants: int, seed: int):
+        self.cp = cp
+        self.tenants = int(tenants)
+        self.rng = np.random.default_rng(seed)
+        self.requests = 0
+        self.ok = 0
+        self.typed_errors = 0
+        self.non_typed: List[str] = []
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="gan4j-scenario-load")
+
+    def start(self):
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._thread.join(timeout=30.0)
+
+    def probe_once(self) -> bool:
+        """One synchronous plain request against the first live
+        replica (the degradation witness: stale weights must still
+        answer)."""
+        return self._one_request(tenant=None)
+
+    def _one_request(self, tenant: Optional[str]) -> bool:
+        from gan_deeplearning4j_tpu.serve.client import (
+            GatewayClient,
+            GatewayHTTPError,
+        )
+
+        names = self.cp.replica_names()
+        with self._lock:
+            self.requests += 1
+        if not names:
+            with self._lock:
+                self.typed_errors += 1  # mid-heal: typed, not silent
+            return False
+        name = names[self.requests % len(names)]
+        host, port = name.rsplit(":", 1)
+        xs = [self.rng.normal(size=(2, 2)).astype(np.float32)]
+        client = GatewayClient(host, int(port), retries=0,
+                               timeout_s=15.0)
+        try:
+            out = client.generate(xs, tenant=tenant, encoding="npy")
+            finite = all(np.isfinite(o).all() for o in out)
+            with self._lock:
+                if finite:
+                    self.ok += 1
+                else:
+                    self.non_typed.append(
+                        f"non-finite output from {name}")
+            return finite
+        except (GatewayHTTPError, OSError):
+            # replicas being killed / hotswapped / slow-lorised answer
+            # with typed wire or HTTP errors — the contract under test
+            with self._lock:
+                self.typed_errors += 1
+            return False
+        except Exception as e:
+            with self._lock:
+                self.non_typed.append(f"{type(e).__name__}: {e}")
+            return False
+        finally:
+            client.close()
+
+    def _run(self) -> None:
+        i = 0
+        while not self._stop.is_set():
+            tenant = (None if i % 2 == 0
+                      else str(i % self.tenants))
+            self._one_request(tenant)
+            i += 1
+            self._stop.wait(0.1)
+
+    def report(self) -> Dict:
+        with self._lock:
+            return {"requests": self.requests, "ok": self.ok,
+                    "typed_errors": self.typed_errors,
+                    "non_typed": list(self.non_typed)}
+
+
+class _TrainerSupervisor:
+    """Spawn/respawn scenario trainer children and expose the current
+    process to the chaos schedule (which signals it by pid)."""
+
+    def __init__(self, res_path: str, data_csv: str, *, tenants: int,
+                 batch_size: int, seed: int, checkpoint_every: int,
+                 step_delay_s: float, log_dir: str):
+        self.res_path = res_path
+        self.data_csv = data_csv
+        self.tenants = tenants
+        self.batch_size = batch_size
+        self.seed = seed
+        self.checkpoint_every = checkpoint_every
+        self.step_delay_s = step_delay_s
+        self.log_dir = log_dir
+        self.proc: Optional[subprocess.Popen] = None
+        self.incarnation = 0
+        self.exits: List[int] = []
+        self._lock = threading.Lock()
+
+    def spawn(self, *, iterations: int, world: Optional[int],
+              resume: bool, step_delay_s: Optional[float] = None
+              ) -> subprocess.Popen:
+        with self._lock:
+            self.incarnation += 1
+        delay = (self.step_delay_s if step_delay_s is None
+                 else step_delay_s)
+        cmd = [sys.executable, "-m", TRAINER_MODULE,
+               "--res-path", self.res_path,
+               "--data", self.data_csv,
+               "--tenants", str(self.tenants),
+               "--iterations", str(iterations),
+               "--batch-size", str(self.batch_size),
+               "--seed", str(self.seed),
+               "--checkpoint-every", str(self.checkpoint_every),
+               "--step-delay-s", str(delay)]
+        if world is not None:
+            cmd += ["--n-devices", str(world)]
+        if resume:
+            cmd += ["--resume"]
+        log_path = os.path.join(
+            self.log_dir, f"trainer_{self.incarnation}.log")
+        log = open(log_path, "ab")
+        try:
+            proc = subprocess.Popen(cmd, stdout=log, stderr=log,
+                                    env=_child_env(world))
+        finally:
+            log.close()
+        with self._lock:
+            self.proc = proc
+        return proc
+
+    def current(self) -> Optional[subprocess.Popen]:
+        with self._lock:
+            return self.proc
+
+    def signal_current(self, signum: int) -> bool:
+        proc = self.current()
+        if proc is None or proc.poll() is not None:
+            return False
+        proc.send_signal(signum)
+        return True
+
+    def wait(self, timeout_s: float) -> int:
+        """Bounded wait; a child that outlives the bound is killed and
+        reported as exit -1 (a typed verdict failure, not a hang)."""
+        proc = self.current()
+        assert proc is not None
+        try:
+            code = int(proc.wait(timeout=timeout_s))
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            proc.wait(timeout=10.0)
+            code = -1
+        self.exits.append(code)
+        return code
+
+    def kill_current(self) -> None:
+        proc = self.current()
+        if proc is not None and proc.poll() is None:
+            proc.kill()
+            proc.wait(timeout=10.0)
+
+
+def _wait_for(pred, timeout_s: float, what: str,
+              poll_s: float = 0.2) -> bool:
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(poll_s)
+    return pred()
+
+
+def run_scenario(out_dir: str, *, seed: int = 23, soak: bool = False,
+                 budget_s: float = 180.0, tenants: int = 4,
+                 rows_per_tenant: int = 16, batch_size: int = 4,
+                 checkpoint_every: int = 8, step_delay_s: float = 0.15,
+                 final_extra_steps: int = 16, band: float = 0.05,
+                 stale_after_s: float = 6.0,
+                 log=print) -> Dict:
+    """Run the combined-chaos scenario; returns the typed verdict
+    dict (``ok`` plus per-plane evidence), writing ``scenario.json``,
+    ``merged_trace.json`` and all child logs/events under
+    ``out_dir``."""
+    from gan_deeplearning4j_tpu.models import mlpgan_insurance as M
+    from gan_deeplearning4j_tpu.serve import (
+        Autoscaler,
+        CheckpointPublisher,
+        ControlPlane,
+        ReplicaLauncher,
+    )
+    from gan_deeplearning4j_tpu.telemetry import (
+        MetricsRegistry,
+        events as events_mod,
+        serve_exporter,
+        tracing as tracing_mod,
+    )
+    from gan_deeplearning4j_tpu.testing import chaos
+
+    t_start = time.monotonic()
+    os.makedirs(out_dir, exist_ok=True)
+    trainer_dir = os.path.join(out_dir, "trainer")
+    control_dir = os.path.join(out_dir, "control")
+    serving_dir = os.path.join(out_dir, "serving")
+    data_dir = os.path.join(out_dir, "data")
+    for d in (trainer_dir, control_dir, serving_dir, data_dir):
+        os.makedirs(d, exist_ok=True)
+    ckpt_dir = os.path.join(trainer_dir, "checkpoints")
+
+    width = M.InsuranceConfig().num_features + 1
+    chaos_csv = os.path.join(data_dir, "chaos.csv")
+    control_csv = os.path.join(data_dir, "control.csv")
+    _write_insurance_csv(chaos_csv, tenants * rows_per_tenant, width,
+                         seed)
+    shutil.copyfile(chaos_csv, control_csv)
+
+    events_path = os.path.join(out_dir, "scenario.events.jsonl")
+    recorder = events_mod.EventRecorder(path=events_path)
+    prev_rec = events_mod.install(recorder)
+    registry = MetricsRegistry()
+    rmon = None
+    if soak:
+        from gan_deeplearning4j_tpu.telemetry.resources import (
+            ResourceMonitor,
+        )
+
+        rmon = ResourceMonitor(interval_s=0.25)
+        rmon.start()
+        registry.observe_resources(rmon.report)
+    stop_exporter = serve_exporter(registry, 0)
+
+    failures: List[str] = []
+
+    def check(ok: bool, name: str, detail: str = "") -> bool:
+        if not ok:
+            failures.append(f"{name}: {detail}" if detail else name)
+        return ok
+
+    sup = _TrainerSupervisor(
+        trainer_dir, chaos_csv, tenants=tenants,
+        batch_size=batch_size, seed=seed,
+        checkpoint_every=checkpoint_every,
+        step_delay_s=step_delay_s, log_dir=out_dir)
+    launcher = ReplicaLauncher(
+        buckets=(4, 16), log_dir=serving_dir,
+        env={"JAX_PLATFORMS": "cpu",
+             "PYTHONPATH": _child_env(None)["PYTHONPATH"]},
+        events_dir=serving_dir,
+        args=("--fleet", "--fleet-tenants", str(tenants)))
+    scaler = Autoscaler(min_replicas=2, max_replicas=2,
+                        up_after=10 ** 6, down_after=10 ** 6,
+                        cooldown_ticks=4)
+    cp = ControlPlane(launcher, autoscaler=scaler, tick_s=0.25,
+                      hold_ticks=2, max_rollbacks=2,
+                      probe_timeout_s=60.0, p99_floor_ms=10_000.0)
+    pub = None
+    load = None
+    schedule = None
+    trainer_final: Optional[Dict] = None
+    control_final: Optional[Dict] = None
+    band_rec: Dict = {}
+    merged_stats: Dict = {}
+    trace_rec: Dict = {}
+    try:
+        events_mod.instant("scenario.start", seed=seed, soak=soak,
+                           tenants=tenants)
+        log(f"[scenario] seed {seed}: starting mesh (2 fleet replicas)")
+        cp.start()
+        registry.observe_controlplane(cp.report)
+        registry.observe_serving_mesh(cp.mesh.report)
+        pub = CheckpointPublisher(ckpt_dir, controlplane=cp,
+                                  poll_s=0.3,
+                                  stale_after_s=stale_after_s,
+                                  deploy_timeout_s=60.0)
+        registry.observe_publication(pub.report)
+        pub.start()
+        load = _LoadLoop(cp, tenants, seed + 1).start()
+
+        # -- incarnation 1: train until the schedule preempts it ------
+        log("[scenario] incarnation 1 (world=2): training under chaos")
+        sup.spawn(iterations=10 ** 6, world=2, resume=False)
+        # something to resume from + something for the publisher to
+        # carry BEFORE chaos starts tearing things down
+        check(_wait_for(lambda: pub.report()["last_step"] > 0, 120.0,
+                        "first publication"),
+              "first_publication",
+              "no checkpoint published within 120s")
+
+        victim = cp.replica_names()[0]
+        vhost, vport = victim.rsplit(":", 1)
+        schedule = chaos.ChaosSchedule(seed)
+        schedule.add(0.2, "preempt_trainer", lambda: sup.signal_current(
+            signal.SIGTERM), plane="train", signal="SIGTERM")
+        schedule.add(0.5, "corrupt_tenant_rows",
+                     lambda: chaos.ChaosInjector(seed).corrupt_csv_rows(
+                         chaos_csv, n_rows=2),
+                     plane="train", rows=2)
+        schedule.add(1.0, "kill_replica",
+                     lambda: chaos.kill_replica_process(
+                         cp.process(victim)),
+                     plane="serve", replica=victim)
+        schedule.add(2.0, "slow_loris",
+                     lambda: chaos.SlowLorisClient(
+                         vhost, int(vport)).run(max_s=2.0),
+                     plane="serve", target=victim)
+
+        def _ready_pid() -> int:
+            try:
+                with open(os.path.join(trainer_dir,
+                                       "READY.json")) as f:
+                    return int(json.load(f).get("pid", -1))
+            except (OSError, ValueError):
+                return -1
+
+        def _signal_ready_child(signum: int) -> bool:
+            # only signal a child whose handler is ARMED (READY.json
+            # names the pid): SIGUSR1 during interpreter startup would
+            # kill the process instead of injecting the fault
+            proc = sup.current()
+            if (sup.incarnation < 2 or proc is None
+                    or proc.poll() is not None
+                    or _ready_pid() != proc.pid):
+                return False
+            return sup.signal_current(signum)
+
+        def _device_lost():
+            # fires once incarnation 2 is up and armed (bounded wait;
+            # the schedule thread owns the delay, not the runner)
+            _wait_for(lambda: _signal_ready_child(signal.SIGUSR1),
+                      120.0, "device-lost signal delivery", poll_s=0.5)
+
+        schedule.add(4.0, "device_lost_shrink_world", _device_lost,
+                     plane="train", signal="SIGUSR1", world="2->1")
+        schedule.start()
+
+        code = sup.wait(timeout_s=120.0)
+        check(code == 75, "preempt_exit_code",
+              f"incarnation 1 exited {code}, wanted 75")
+        check(os.path.exists(os.path.join(trainer_dir,
+                                          "PREEMPTED.json")),
+              "preempted_marker", "PREEMPTED.json missing")
+
+        # -- incarnation 2: resume; the schedule's device-lost lands --
+        log("[scenario] incarnation 2 (world=2): resume after preempt")
+        sup.spawn(iterations=10 ** 6, world=2, resume=True)
+        code = sup.wait(timeout_s=180.0)
+        check(code == 82, "device_lost_exit_code",
+              f"incarnation 2 exited {code}, wanted 82")
+
+        # -- incarnation 3: shrunk world, runs to completion ----------
+        from gan_deeplearning4j_tpu.train.fleet import FleetCheckpointer
+
+        resume_step = (FleetCheckpointer(
+            ckpt_dir, sweep_debris=False)._inner.latest_step() or 0)
+        final_target = int(resume_step) + int(final_extra_steps)
+        log(f"[scenario] incarnation 3 (world=1): resume at "
+            f"{resume_step}, run to {final_target}")
+        sup.spawn(iterations=final_target, world=1, resume=True)
+        code = sup.wait(timeout_s=180.0)
+        check(code == 0, "final_exit_code",
+              f"incarnation 3 exited {code}, wanted 0")
+        final_path = os.path.join(trainer_dir, "final.json")
+        if os.path.exists(final_path):
+            with open(final_path) as f:
+                trainer_final = json.load(f)
+        check(trainer_final is not None, "trainer_final",
+              "final.json missing")
+        schedule.stop()
+
+        # -- publication catches up to the final checkpoint -----------
+        ck = FleetCheckpointer(ckpt_dir, sweep_debris=False)
+        final_step = int(ck._inner.latest_verified_step() or 0)
+        check(_wait_for(
+            lambda: pub.report()["last_step"] >= final_step, 90.0,
+            "final promotion"),
+            "final_promotion",
+            f"publisher at {pub.report()['last_step']}, "
+            f"final checkpoint {final_step}")
+        verified = [s for s in ck.steps() if ck.verify(s)]
+        promoted = set(pub.report()["promoted_steps"])
+        check(set(verified) <= promoted, "every_verified_published",
+              f"verified {verified} vs promoted {sorted(promoted)}")
+
+        # -- poison: publisher rejects; direct deploy canary-rolls-back
+        # (tenant 0 poisoned so the canary's plain probe — tenant 0's
+        # engine — sees the NaN weights too)
+        bad_step = chaos.poison_fleet_checkpoint_dir(ckpt_dir, tenant=0)
+        events_mod.instant("chaos.poison_checkpoint", step=bad_step,
+                           tenant=0)
+        check(_wait_for(
+            lambda: pub.report()["rejected_total"] >= 1, 30.0,
+            "publisher rejection"),
+            "publisher_rejects_poison",
+            f"rejected_total={pub.report()['rejected_total']}")
+        check(pub.report()["last_step"] == final_step,
+              "poison_never_promoted",
+              f"last_step moved to {pub.report()['last_step']}")
+
+        deployed = False
+        for _ in range(40):
+            try:
+                cp.deploy(ckpt_dir, step=bad_step)
+                deployed = True
+                break
+            except RuntimeError:
+                time.sleep(0.25)  # publisher deploy still in flight
+        check(deployed, "direct_poison_deploy", "deploy stayed busy")
+        if deployed:
+            _wait_for(lambda: cp.deployment_status()["state"]
+                      not in ("pending", "canary"), 90.0,
+                      "poisoned canary resolution")
+            status = cp.deployment_status()
+            check(status["state"] == "rolled_back",
+                  "canary_rollback",
+                  f"deployment ended {status['state']}")
+        check(cp.report()["rollbacks_total"] >= 1, "rollback_counted",
+              str(cp.report()["rollbacks_total"]))
+        check(cp.report()["replaced_total"] >= 1, "replica_healed",
+              "killed replica was never replaced")
+
+        # -- graceful degradation: stale but still answering ----------
+        _wait_for(lambda: pub.report()["stale"], stale_after_s + 10.0,
+                  "staleness flag")
+        check(pub.report()["stale"], "serving_stale_flag",
+              "publication never went stale after trainer stopped")
+        check(registry.health().get("serving_stale") is True,
+              "healthz_serving_stale", "healthz flag not raised")
+        check(load.probe_once(), "stale_probe",
+              "replica did not answer on stale weights")
+    finally:
+        if schedule is not None:
+            schedule.stop()
+        if load is not None:
+            load.stop()
+        if pub is not None:
+            pub.stop()
+        sup.kill_current()
+        try:
+            cp.stop()
+        except Exception as e:  # gan4j-lint: disable=swallowed-exception — teardown must reach the recorder/exporter below; a stop error is recorded in the verdict via failures
+            failures.append(f"controlplane_stop: {e!r}")
+        if rmon is not None:
+            rmon.stop()
+        events_mod.instant("scenario.done",
+                           wall_s=round(time.monotonic() - t_start, 3))
+        recorder.flush()
+        events_mod.install(prev_rec)
+        recorder.close()
+        stop_exporter()
+
+    # -- serving SLO ---------------------------------------------------
+    serving = load.report() if load is not None else {}
+    check(not serving.get("non_typed"), "zero_non_typed",
+          "; ".join(serving.get("non_typed", [])[:3]))
+    check(serving.get("ok", 0) >= 5, "serving_throughput",
+          f"only {serving.get('ok', 0)} successful requests")
+
+    # -- one merged cross-process timeline -----------------------------
+    trainer_events = os.path.join(trainer_dir, "events.jsonl")
+    replica_events = sorted(glob.glob(
+        os.path.join(serving_dir, "replica_*.events.jsonl")))
+    trace_paths = [p for p in
+                   [events_path, trainer_events] + replica_events
+                   if os.path.exists(p)]
+    merged = tracing_mod.merge_trace_files(
+        trace_paths, include_events=TRACE_EVENT_PREFIXES)
+    merged_stats = merged["stats"]
+    with open(os.path.join(out_dir, "merged_trace.json"), "w") as f:
+        json.dump(merged, f)
+    timeline = merged["timeline"]
+    trainer_hosts = {e["host"] for e in timeline
+                     if e["name"].startswith(("fleet.", "preempt."))}
+    replica_hosts = {e["host"] for e in timeline
+                     if e["name"].startswith(("serve.", "replica."))}
+    chaos_marks = [e for e in timeline
+                   if e["name"].startswith("chaos.")]
+    trace_rec = {"stats": merged_stats,
+                 "trainer_incarnations": len(trainer_hosts),
+                 "replica_processes": len(replica_hosts),
+                 "chaos_events": len(chaos_marks)}
+    check(len(trainer_hosts) >= 2, "trace_trainer_incarnations",
+          f"{len(trainer_hosts)} trainer hosts in merged timeline")
+    check(len(replica_hosts) >= 2, "trace_replica_hosts",
+          f"{len(replica_hosts)} replica hosts in merged timeline")
+    check(len(chaos_marks) >= 4, "trace_chaos_timeline",
+          f"{len(chaos_marks)} chaos events in merged timeline")
+    check(merged_stats.get("segments", 0) >= 3, "trace_segments",
+          f"{merged_stats.get('segments')} recorder segments")
+
+    # -- undisturbed control run at identical step count ----------------
+    if trainer_final is not None:
+        log(f"[scenario] control run: {trainer_final['step']} clean "
+            "steps (no delay, no chaos)")
+        ctl = _TrainerSupervisor(
+            control_dir, control_csv, tenants=tenants,
+            batch_size=batch_size, seed=seed,
+            checkpoint_every=0, step_delay_s=0.0, log_dir=out_dir)
+        ctl.spawn(iterations=int(trainer_final["step"]), world=2,
+                  resume=False)
+        code = ctl.wait(timeout_s=180.0)
+        check(code == 0, "control_exit_code", f"exited {code}")
+        ctl_path = os.path.join(control_dir, "final.json")
+        if os.path.exists(ctl_path):
+            with open(ctl_path) as f:
+                control_final = json.load(f)
+        if control_final is not None:
+            for key in ("d_loss", "g_loss"):
+                a = float(trainer_final[key])
+                b = float(control_final[key])
+                rel = abs(a - b) / max(abs(b), 1e-6)
+                band_rec[key] = {"chaos": a, "control": b,
+                                 "rel": round(rel, 4)}
+                check(rel <= band, f"band_{key}",
+                      f"|{a:.4f}-{b:.4f}|/{abs(b):.4f}="
+                      f"{rel:.3f} > {band}")
+            check(control_final["step"] == trainer_final["step"],
+                  "band_same_steps", "step counts differ")
+        else:
+            check(False, "control_final", "control final.json missing")
+
+    verdict: Dict = {
+        "type": "scenario", "scenario": "combined_chaos",
+        "seed": int(seed), "soak": bool(soak),
+        "failures": failures, "ok": not failures,
+        "trainer": {"exits": sup.exits,
+                    "incarnations": sup.incarnation,
+                    "final": trainer_final},
+        "control": control_final,
+        "band": band_rec,
+        "publish": pub.report() if pub is not None else {},
+        "controlplane": cp.report(),
+        "serving": serving,
+        "chaos": schedule.report() if schedule is not None else {},
+        "trace": trace_rec,
+        "wall_s": round(time.monotonic() - t_start, 3),
+        "budget_s": float(budget_s),
+        "artifacts_dir": out_dir,
+    }
+    if soak and rmon is not None:
+        from gan_deeplearning4j_tpu.telemetry.resources import (
+            leak_verdict,
+        )
+
+        samples = rmon.samples()
+        verdict["leak"] = leak_verdict(samples)
+        with open(os.path.join(out_dir, "soak_samples.json"),
+                  "w") as f:
+            json.dump(samples, f)
+        verdict["ok"] = bool(verdict["ok"]
+                             and verdict["leak"].get("ok"))
+        if not verdict["leak"].get("ok"):
+            verdict["failures"].append(
+                f"leak_gate: {verdict['leak'].get('leaking')}")
+    with open(os.path.join(out_dir, "scenario.json"), "w") as f:
+        json.dump(verdict, f, indent=1, default=float)
+    return verdict

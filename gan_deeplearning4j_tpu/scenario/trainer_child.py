@@ -1,0 +1,200 @@
+"""The scenario's preemptible trainer unit: one fleet incarnation.
+
+``python -m gan_deeplearning4j_tpu.scenario.trainer_child`` runs ONE
+incarnation of the fleet trainer (train/fleet.py) the way a cluster
+scheduler would see it — a process that either finishes, is preempted,
+or loses hardware — and maps each outcome to the exit-code protocol
+the runner (scenario/runner.py) supervises:
+
+* **0** — ran to ``--iterations``; ``final.json`` in ``--res-path``
+  carries the terminal trajectory (step, mean d/g loss, quarantined
+  rows) for the ≤5%-band comparison against the undisturbed control.
+* **75** (``EXIT_PREEMPTED``) — the default SIGTERM guard
+  (train/preemption.py) latched and the loop drained: emergency fleet
+  checkpoint, ``PREEMPTED.json`` marker, clean exit.  The orchestrator
+  respawns with ``--resume``; 75 is "requeue me", not a crash.
+* **82** (``EXIT_DEVICE_LOST``) — the ``--device-lost-signal``
+  (default SIGUSR1) handler raised
+  :class:`~gan_deeplearning4j_tpu.testing.chaos.DeviceLostError` at
+  the next step boundary, deliberately WITHOUT an emergency save:
+  lost hardware does not get to flush, so the respawn exercises the
+  restore-from-older-cadence-checkpoint path (and, when the runner
+  shrinks ``--n-devices``, the elastic reshard-on-restore path).
+
+Data is read tolerantly: rows the chaos injector rewrote as
+``#CORRUPT#,...`` (testing/chaos.corrupt_csv_rows) parse to NaN rows
+of the right width and flow into the TenantRouter, whose per-tenant
+quarantine is exactly the subsystem under test — a corrupt feed must
+cost rows, not the run.
+
+``--step-delay-s`` paces the loop (the insurance MLPs step far faster
+than any real fleet would) so checkpoint cadence, publisher
+throughput, and chaos timing interact on CI the way they would at
+production step times.
+"""
+
+import argparse
+import json
+import os
+import signal
+import sys
+import time
+from typing import List, Optional
+
+import numpy as np
+
+# deliberately NOT train/preemption's 75: a distinct code so the
+# orchestrator can tell "requeue me" (preempted, emergency checkpoint
+# on disk) from "hardware gone" (resume from an older cadence save)
+EXIT_DEVICE_LOST = 82
+
+FINAL_NAME = "final.json"
+
+
+def read_csv_tolerant(path: str, width: int) -> np.ndarray:
+    """Parse ``path`` into ``(rows, width)`` float32, mapping every
+    unparsable or wrong-width line (e.g. the chaos injector's
+    ``#CORRUPT#`` rewrites) to a NaN row instead of failing the load —
+    deciding what a bad row COSTS is the TenantRouter quarantine's
+    job, not the parser's."""
+    rows: List[List[float]] = []
+    nan_row = [float("nan")] * width
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                vals = [float(p) for p in line.split(",")]
+            except ValueError:
+                vals = nan_row
+            rows.append(vals if len(vals) == width else nan_row)
+    if not rows:
+        raise ValueError(f"{path}: no data rows")
+    return np.asarray(rows, np.float32)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--res-path", required=True)
+    p.add_argument("--data", required=True,
+                   help="CSV of num_features feature columns + 1 label")
+    p.add_argument("--tenants", type=int, default=4)
+    p.add_argument("--iterations", type=int, required=True)
+    p.add_argument("--batch-size", type=int, default=4)
+    p.add_argument("--seed", type=int, default=23)
+    p.add_argument("--checkpoint-every", type=int, default=8)
+    p.add_argument("--keep-checkpoints", type=int, default=64)
+    p.add_argument("--n-devices", type=int, default=None,
+                   help="tenant-mesh size (shrinks across respawns)")
+    p.add_argument("--resume", action="store_true")
+    p.add_argument("--print-every", type=int, default=0)
+    p.add_argument("--step-delay-s", type=float, default=0.0)
+    p.add_argument("--preempt-signals", default="SIGTERM",
+                   help='guard signals ("" disables; exit 75 protocol)')
+    p.add_argument("--device-lost-signal", default="SIGUSR1",
+                   help='simulated hardware loss ("" disables; exit '
+                        f"{EXIT_DEVICE_LOST})")
+    args = p.parse_args(argv)
+
+    from gan_deeplearning4j_tpu.models import mlpgan_insurance as M
+    from gan_deeplearning4j_tpu.testing.chaos import DeviceLostError
+    from gan_deeplearning4j_tpu.train import fleet as fleet_lib
+    from gan_deeplearning4j_tpu.train.preemption import (
+        EXIT_PREEMPTED,
+        PreemptionError,
+    )
+
+    width = M.InsuranceConfig().num_features + 1
+    data = read_csv_tolerant(args.data, width)
+    feats, labels = data[:, :-1], data[:, -1]
+
+    cfg = fleet_lib.FleetConfig(
+        num_tenants=args.tenants,
+        num_iterations=args.iterations,
+        batch_size=args.batch_size,
+        seed=args.seed,
+        res_path=args.res_path,
+        per_tenant_data=True,
+        print_every=args.print_every,
+        checkpoint_every=args.checkpoint_every,
+        keep_checkpoints=args.keep_checkpoints,
+        n_devices=args.n_devices,
+        events=True,
+        resume=args.resume,
+        preempt_signals=args.preempt_signals or None,
+    )
+    trainer = fleet_lib.FleetTrainer(cfg)
+
+    # device-lost seam: the signal only LATCHES here; the raise happens
+    # at the next step boundary through fleet._chaos_step_hook, so the
+    # "hardware loss" lands where a real one would be observed — at a
+    # dispatch edge, not mid-handler
+    lost = {"armed": False}
+    if args.device_lost_signal:
+        signum = getattr(signal, args.device_lost_signal)
+        signal.signal(signum, lambda s, f: lost.update(armed=True))
+    delay = max(0.0, float(args.step_delay_s))
+
+    # readiness marker: the orchestrator must not fire the device-lost
+    # signal while this process is still importing (the default SIGUSR1
+    # action would kill it outright) — READY.json names the pid whose
+    # handler is armed, and the runner gates the injection on it
+    ready_tmp = os.path.join(args.res_path, "READY.json.tmp")
+    with open(ready_tmp, "w") as f:
+        json.dump({"pid": os.getpid()}, f)
+    os.replace(ready_tmp, os.path.join(args.res_path, "READY.json"))
+
+    def _hook(step: int) -> None:
+        if lost["armed"]:
+            raise DeviceLostError(
+                f"injected device loss at step {step} "
+                f"({args.device_lost_signal})")
+        if delay:
+            time.sleep(delay)
+
+    fleet_lib._chaos_step_hook = _hook
+    try:
+        out = trainer.train(feats, labels)
+    except PreemptionError as e:
+        # PREEMPTED.json + the emergency checkpoint are already on
+        # disk (train/fleet._preempt_drain); report, exit 75
+        print(json.dumps({"preempted": True, "step": e.step,
+                          "checkpoint": e.checkpoint}))
+        return EXIT_PREEMPTED
+    except DeviceLostError as e:
+        # no emergency save, on purpose: lost hardware does not flush
+        print(json.dumps({"device_lost": True,
+                          "step": trainer.batch_counter,
+                          "reason": str(e)}))
+        return EXIT_DEVICE_LOST
+    finally:
+        fleet_lib._chaos_step_hook = None
+
+    losses = trainer.last_losses
+    final = {
+        "step": int(out["steps"]),
+        "tenants": int(out["tenants"]),
+        "quarantined": int(out["quarantined"]),
+        "d_loss": (None if losses is None
+                   else float(np.mean(np.asarray(losses[0])))),
+        "g_loss": (None if losses is None
+                   else float(np.mean(np.asarray(losses[1])))),
+    }
+    tmp = os.path.join(args.res_path, FINAL_NAME + ".tmp")
+    with open(tmp, "w") as f:
+        json.dump(final, f)
+    os.replace(tmp, os.path.join(args.res_path, FINAL_NAME))
+    print(json.dumps(final))
+    return 0
+
+
+def cli() -> None:
+    from gan_deeplearning4j_tpu.runtime import backend as _backend
+
+    _backend.apply_env_platform()
+    sys.exit(main())
+
+
+if __name__ == "__main__":
+    cli()

@@ -52,6 +52,10 @@ from gan_deeplearning4j_tpu.serve.mesh import (
     RemoteReplica,
     ReplicaProbeError,
 )
+from gan_deeplearning4j_tpu.serve.publisher import (
+    CheckpointPublisher,
+    finite_params_probe,
+)
 from gan_deeplearning4j_tpu.serve.router import (
     FleetTenantBank,
     NoHealthyReplicaError,
@@ -62,6 +66,7 @@ __all__ = [
     "AdmissionQueue",
     "Autoscaler",
     "CanaryDeployment",
+    "CheckpointPublisher",
     "ControlPlane",
     "DeploymentRollbackError",
     "DispatchError",
@@ -81,6 +86,7 @@ __all__ = [
     "ServeEngine",
     "ShedError",
     "TokenBucket",
+    "finite_params_probe",
     "measure_saturation",
     "percentiles",
     "run_load",

@@ -135,13 +135,18 @@ class ReplicaLauncher:
                  log_dir: str = ".", host: str = "127.0.0.1",
                  ready_timeout_s: float = 120.0,
                  env: Optional[Dict[str, str]] = None,
-                 events_dir: Optional[str] = None):
+                 events_dir: Optional[str] = None,
+                 args: Sequence[str] = ()):
         self.checkpoint = checkpoint
         self.buckets = tuple(int(b) for b in buckets)
         self.log_dir = log_dir
         self.host = host
         self.ready_timeout_s = float(ready_timeout_s)
         self.env = dict(env or {})
+        # extra CLI args EVERY spawn gets (e.g. ``--fleet``/
+        # ``--fleet-tenants N``) — unlike per-spawn ``extra_args``,
+        # these survive the control plane's heal/scale respawns
+        self.args = tuple(str(a) for a in args)
         # when set, each replica writes its own events timeline there
         # (``replica_{seq}.events.jsonl``) — the per-process files
         # telemetry.tracing.merge_trace_files joins into one
@@ -221,7 +226,7 @@ class ReplicaLauncher:
         if self.events_dir:
             cmd += ["--events", os.path.join(
                 self.events_dir, f"replica_{seq}.events.jsonl")]
-        cmd += list(extra_args)
+        cmd += list(self.args) + list(extra_args)
         env = dict(os.environ)
         env.update(self.env)
         with open(log_path, "ab") as log_f:
@@ -404,7 +409,7 @@ class ControlPlane:
         self._procs: Dict[str, ReplicaProcess] = {}
         self._canary_name: Optional[str] = None
         self._canary: Optional[CanaryDeployment] = None
-        self._pending_deploy: Optional[str] = None
+        self._pending_deploy: Optional[Tuple[str, Optional[int]]] = None
         self._deploy_state: Dict = {"state": "idle"}
         self._fatal: Optional[str] = None
         self._scale_up_total = 0
@@ -466,11 +471,15 @@ class ControlPlane:
 
     # -- public API ------------------------------------------------------------
 
-    def deploy(self, directory: str) -> None:
+    def deploy(self, directory: str,
+               step: Optional[int] = None) -> None:
         """Queue a rolling deployment of ``directory`` (picked up on
-        the next tick).  Raises ``DeploymentRollbackError`` once the
-        budget is exhausted, ``RuntimeError`` while another deploy is
-        still in flight."""
+        the next tick).  ``step`` pins the EXACT checkpoint to canary
+        (the publisher's contract: the step it verified is the step
+        that deploys — "newest verified" could silently pick up a
+        younger save it never probed).  Raises
+        ``DeploymentRollbackError`` once the budget is exhausted,
+        ``RuntimeError`` while another deploy is still in flight."""
         with self._lock:
             if self._fatal is not None:
                 raise DeploymentRollbackError(self._fatal)
@@ -480,9 +489,12 @@ class ControlPlane:
                 raise RuntimeError(
                     "a deployment is already in flight; wait for "
                     "deployment_status() to settle")
-            self._pending_deploy = str(directory)
+            self._pending_deploy = (
+                str(directory), None if step is None else int(step))
             self._deploy_state = {"state": "pending",
                                   "directory": str(directory)}
+            if step is not None:
+                self._deploy_state["step"] = int(step)
 
     def deployment_status(self) -> Dict:
         with self._lock:
@@ -560,7 +572,8 @@ class ControlPlane:
                            rc=proc.proc.returncode)
             if canary_died:
                 self._finish_rollback(
-                    "canary replica process died mid-hold")
+                    "canary replica process died mid-hold",
+                    environmental=True)
             self._spawn_one()
 
     def _spawn_one(self) -> Optional[ReplicaProcess]:
@@ -634,14 +647,15 @@ class ControlPlane:
             canary = self._canary
             canary_name = self._canary_name
         if pending is not None and canary is None:
-            self._start_canary(pending)
+            self._start_canary(*pending)
             return
         if canary is None:
             return
         replica = self.mesh.get(canary_name) \
             if canary_name is not None else None
         if replica is None:
-            self._finish_rollback("canary replica left the mesh")
+            self._finish_rollback("canary replica left the mesh",
+                                  environmental=True)
             return
         probe_ms, finite, failure = self._probe_canary(replica)
         errors_delta = 0
@@ -654,9 +668,23 @@ class ControlPlane:
         if verdict == "promote":
             self._finish_promote(canary)
         elif verdict == "rollback":
-            self._finish_rollback(canary.reason or "slo regression")
+            environmental = False
+            if failure is not None:
+                # the probe never got an answer out of the canary
+                # (connection reset, refused, timeout).  That refutes
+                # the WEIGHTS only if the process behind it is still
+                # standing; if it died under us (chaos, preemption)
+                # the wire error is just the death seen from the
+                # client side — same environmental verdict as the
+                # canary-died scan path
+                proc = self.process(canary_name) \
+                    if canary_name is not None else None
+                environmental = proc is None or not proc.alive()
+            self._finish_rollback(canary.reason or "slo regression",
+                                  environmental=environmental)
 
-    def _start_canary(self, directory: str) -> None:
+    def _start_canary(self, directory: str,
+                      step: Optional[int] = None) -> None:
         names = self.mesh.names()
         replica = None
         for name in names:
@@ -673,9 +701,11 @@ class ControlPlane:
         baseline_ms, _, fail = self._probe_canary(replica)
         if fail is not None:
             baseline_ms = None
+        body = {"directory": directory}
+        if step is not None:
+            body["step"] = int(step)
         try:
-            result = replica.admin("hotswap",
-                                   {"directory": directory})
+            result = replica.admin("hotswap", body)
         except (GatewayHTTPError, ReplicaProbeError, OSError) as e:
             with self._lock:
                 self._deploy_failed_total += 1
@@ -731,7 +761,14 @@ class ControlPlane:
                        directory=canary.directory, step=canary.step,
                        fleet_failures=len(failures))
 
-    def _finish_rollback(self, reason: str) -> None:
+    def _finish_rollback(self, reason: str, *,
+                         environmental: bool = False) -> None:
+        """``environmental=True`` marks a rollback that says nothing
+        about the ARTIFACT — the canary process died or left the mesh
+        mid-hold (chaos, preemption, OOM) before the SLO probes could
+        refute the weights.  The flag rides the deployment status so
+        the publisher retries the step once the mesh heals instead of
+        stickying weights that were never proven bad."""
         with self._lock:
             canary = self._canary
             canary_name = self._canary_name
@@ -763,7 +800,7 @@ class ControlPlane:
         events.instant("controlplane.rollback",
                        directory=canary.directory, step=canary.step,
                        restored_step=restored, reason=reason,
-                       budget_ok=ok,
+                       environmental=environmental, budget_ok=ok,
                        budget_attempts=self._budget.attempts)
         if ok:
             with self._lock:
@@ -772,7 +809,8 @@ class ControlPlane:
                     "state": "rolled_back",
                     "directory": canary.directory,
                     "step": canary.step, "restored_step": restored,
-                    "reason": reason}
+                    "reason": reason,
+                    "environmental": bool(environmental)}
             return
         fatal = (f"deployment rollback budget exhausted "
                  f"({self._budget.attempts} rollbacks, max "

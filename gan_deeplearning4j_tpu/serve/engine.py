@@ -253,11 +253,16 @@ class ServeEngine:
             TrainCheckpointer,
         )
 
-        ckpt = TrainCheckpointer(directory)
+        # read-side handle: this engine only OBSERVES the trainer's
+        # directory; sweeping would tear an in-flight save's tmp dir
+        ckpt = TrainCheckpointer(directory, sweep_debris=False)
         if step is not None:
             with self._swap_lock:
+                # target_mesh=None: a serving host loads onto ITSELF —
+                # checkpoints written on a bigger training mesh reshard
+                # down to this host's single device instead of refusing
                 got, _ = ckpt.restore({name: self._infer.graph},
-                                      step=step)
+                                      step=step, target_mesh=None)
             self.refresh()
             events.instant("serve.hotswap", step=got,
                            directory=directory)
@@ -278,7 +283,7 @@ class ServeEngine:
             try:
                 with self._swap_lock:
                     got, _ = ckpt.restore({name: self._infer.graph},
-                                          step=s)
+                                          step=s, target_mesh=None)
             except ValueError:
                 raise  # structure mismatch: fatal, not corruption
             except Exception as e:  # unreadable despite the manifest
@@ -295,6 +300,18 @@ class ServeEngine:
             + (f" at or below step {max_step}"
                if max_step is not None else "")
             + f" (candidates: {candidates})")
+
+    def hotswap_params(self, params) -> None:
+        """Swap an already-materialized parameter tree into the served
+        graph (same structure, same shapes), then flag the refresh.
+        This is the in-memory sibling of ``hotswap_from`` for callers
+        that restore weights themselves — ``FleetTenantBank`` restores
+        a whole fleet once and pushes each tenant's slice here —
+        keeping the engine object (and every router holding it)
+        stable across the swap."""
+        with self._swap_lock:
+            self._infer.graph.params = params
+        self.refresh()
 
     # -- lifecycle -------------------------------------------------------------
 

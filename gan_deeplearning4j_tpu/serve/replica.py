@@ -153,6 +153,113 @@ def build_replica(*, port: int = 0, host: str = "127.0.0.1",
     return engine, gateway, wedge
 
 
+def build_fleet_replica(*, port: int = 0, host: str = "127.0.0.1",
+                        checkpoint=None, tenants: int = 4,
+                        buckets=DEFAULT_SERVING_BUCKETS,
+                        max_rows: int = 4096,
+                        read_timeout_s: float = 5.0,
+                        result_timeout_s: float = 60.0):
+    """Build (engine, gateway, wedge, bank) — the FLEET serving stack:
+    a ``FleetTenantBank`` over the insurance-protocol generators with
+    ``/v1/tenants/{id}/generate`` routing, behind the same process
+    contract as :func:`build_replica`.
+
+    Tenant 0's engine doubles as the plain ``/v1/generate`` replica,
+    so the control plane's model-agnostic canary probes (zero-latent
+    rows — insurance ``z_size`` wide) exercise real fleet weights: a
+    poisoned tenant-0 slice fails the canary, not just the publisher's
+    file probe.  ``max_live`` is pinned above the tenant count so the
+    probe engine can never be LRU-evicted out from under the router.
+
+    ``checkpoint``: a fleet checkpoint dir — restored lazily when it
+    holds a verified fleet checkpoint; otherwise (empty dir, first
+    boot before the trainer's first save, or a non-fleet dir) the bank
+    serves a freshly initialized ``tenants``-wide fleet, mirroring the
+    single-model replica's serve-fresh-init boot contract.  Admin
+    ``hotswap`` routes to ``FleetTenantBank.hotswap_from`` — every
+    live tenant engine gets its new slice in place, zero recompile."""
+    from gan_deeplearning4j_tpu.models import mlpgan_insurance as IM
+    from gan_deeplearning4j_tpu.serve.router import FleetTenantBank
+    from gan_deeplearning4j_tpu.train import fused_step as fused_lib
+    from gan_deeplearning4j_tpu.train.fleet import (
+        FleetCheckpointer,
+        replicate_state,
+    )
+
+    cfg = IM.InsuranceConfig()
+
+    def build_graph():
+        return IM.build_generator(cfg)
+
+    max_live = max(int(tenants), 4) + 1
+    bank = None
+    if checkpoint:
+        # read-side handle: the trainer owns the checkpoint dir
+        ck = FleetCheckpointer(str(checkpoint), sweep_debris=False)
+        if ck.latest_verified_step() is not None:
+            candidate = FleetTenantBank(
+                build_graph, checkpointer=ck,
+                buckets=tuple(buckets), max_live=max_live)
+            try:
+                candidate.num_tenants()  # force the restore NOW
+                bank = candidate
+            except (FileNotFoundError, ValueError) as e:
+                # a verified-but-not-fleet checkpoint (or one pruned
+                # between the verify and the restore): fresh init, as
+                # the boot contract promises
+                print(f"replica: cannot serve fleet from "
+                      f"{checkpoint!r} ({e}); serving fresh "
+                      f"initialization", file=sys.stderr, flush=True)
+    if bank is None:
+        dis = IM.build_discriminator(cfg)
+        graphs = (dis, IM.build_generator(cfg), IM.build_gan(cfg),
+                  IM.build_classifier(dis, cfg))
+        state = replicate_state(
+            fused_lib.state_from_graphs(*graphs), int(tenants))
+        bank = FleetTenantBank(build_graph, state=state,
+                               buckets=tuple(buckets),
+                               max_live=max_live)
+    engine = bank.engine(0)  # built, warmed, started
+    wedge = WedgeState()
+
+    def serve_report():
+        rep = engine.report()
+        rep["wedged"] = wedge.wedged()
+        rep["tenants"] = bank.num_tenants()
+        rep["tenants_live"] = bank.live_count()
+        if rep["wedged"]:
+            rep["ok"] = False
+            rep["stalled"] = True
+        return rep
+
+    def admin_hotswap(params):
+        directory = params.get("directory")
+        if not directory:
+            raise ValueError(
+                'hotswap needs {"directory": "<checkpoint dir>"}')
+        step = params.get("step")
+        max_step = params.get("max_step")
+        got = bank.hotswap_from(
+            str(directory),
+            step=None if step is None else int(step),
+            max_step=None if max_step is None else int(max_step))
+        return {"step": got}
+
+    def admin_wedge(params):
+        seconds = float(params.get("seconds", 5.0))
+        wedge.wedge(seconds)
+        return {"wedged_s": seconds}
+
+    gateway = Gateway(
+        Router([engine], tenants=bank), host=host, port=port,
+        max_rows=max_rows, read_timeout_s=read_timeout_s,
+        result_timeout_s=result_timeout_s,
+        serve_report=serve_report,
+        admin={"hotswap": admin_hotswap, "chaos/wedge": admin_wedge})
+    gateway.start()
+    return engine, gateway, wedge, bank
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(
         prog="python -m gan_deeplearning4j_tpu.serve.replica",
@@ -171,15 +278,31 @@ def main(argv=None) -> int:
     p.add_argument("--events", default=None,
                    help="write this process's events timeline to PATH "
                         "(jsonl)")
+    p.add_argument("--fleet", action="store_true",
+                   help="serve a multi-tenant FLEET (insurance "
+                        "generators + /v1/tenants/{id}/generate) "
+                        "instead of the single dcgan generator")
+    p.add_argument("--fleet-tenants", type=int, default=4,
+                   help="fresh-init fleet width when --checkpoint "
+                        "holds no verified fleet checkpoint yet")
     args = p.parse_args(argv)
 
     if args.events:
         events.install(events.EventRecorder(path=args.events))
 
-    engine, gateway, _wedge = build_replica(
-        port=args.port, host=args.host, checkpoint=args.checkpoint,
-        buckets=_parse_buckets(args.buckets),
-        result_timeout_s=args.result_timeout_s)
+    bank = None
+    if args.fleet:
+        engine, gateway, _wedge, bank = build_fleet_replica(
+            port=args.port, host=args.host,
+            checkpoint=args.checkpoint, tenants=args.fleet_tenants,
+            buckets=_parse_buckets(args.buckets),
+            result_timeout_s=args.result_timeout_s)
+    else:
+        engine, gateway, _wedge = build_replica(
+            port=args.port, host=args.host,
+            checkpoint=args.checkpoint,
+            buckets=_parse_buckets(args.buckets),
+            result_timeout_s=args.result_timeout_s)
 
     stop_evt = threading.Event()
 
@@ -199,7 +322,10 @@ def main(argv=None) -> int:
         pass
 
     gateway.stop()
-    engine.stop()
+    if bank is not None:
+        bank.stop()  # every live tenant engine, the probe one included
+    else:
+        engine.stop()
     events.instant("replica.stopped", pid=os.getpid())
     # flush the events file's buffered tail: with fewer events than
     # the recorder's flush_every, NOTHING would hit disk otherwise —

@@ -253,7 +253,11 @@ class FleetTenantBank:
             state = self._state
         if state is not None:
             return state
-        _, state, extra = self._checkpointer.restore()
+        # target_mesh=None: a serving host restores onto ITSELF (one
+        # device), whatever tenant mesh the trainer wrote the
+        # checkpoint on — extras-only fleet restores carry no sharded
+        # graphs, so the elastic path just lifts the host arrays
+        _, state, extra = self._checkpointer.restore(target_mesh=None)
         n = extra.get("fleet_tenants")
         with self._lock:
             if self._state is None:
@@ -333,6 +337,73 @@ class FleetTenantBank:
             events.instant("router.tenant_evicted",
                            evicted=len(evicted), tenant=t)
         return eng
+
+    # -- hotswap ---------------------------------------------------------------
+
+    def hotswap_from(self, directory: Optional[str] = None, *,
+                     step: Optional[int] = None,
+                     max_step: Optional[int] = None) -> int:
+        """Restore a newer fleet checkpoint and push each live
+        tenant's fresh slice into its engine in place (zero
+        recompile — ``ServeEngine.hotswap_params``; engine objects
+        stay the same, so routers holding them stay valid).  The
+        publication pipeline's fleet-serving analogue of
+        ``ServeEngine.hotswap_from``, with the same contract:
+        ``step`` pins exactly (``CheckpointCorruptError`` on
+        verification failure), ``max_step`` bounds the newest-first
+        verified walk, and ``NoVerifiedCheckpointError`` propagates
+        when nothing loads (the bank keeps serving the old state).
+
+        ``directory`` defaults to the bank's own checkpointer;
+        state-mode banks must pass it explicitly.  Tenants at or above
+        the new fleet size are evicted (their engines stopped outside
+        the lock).  A concurrently-building engine that sliced the
+        OLD state can land after the swap; the next hotswap refreshes
+        it — the bank trades that narrow staleness window for never
+        holding its lock across a restore."""
+        from gan_deeplearning4j_tpu.train.fleet import (
+            FleetCheckpointer,
+            slice_tenant,
+        )
+
+        if directory is not None:
+            # read-side handle: the trainer owns this directory and may
+            # be mid-save — never sweep its in-flight tmp dirs
+            ck = FleetCheckpointer(str(directory), sweep_debris=False)
+        elif self._checkpointer is not None:
+            ck = self._checkpointer
+        else:
+            raise ValueError(
+                "a state-mode FleetTenantBank needs an explicit "
+                "directory to hotswap from")
+        # target_mesh=None: serve whatever mesh the trainer wrote on
+        # (see _ensure_state) — hotswapping a 2-device fleet checkpoint
+        # onto a 1-device replica is the NORMAL publication case
+        got, state, extra = ck.restore(step=step, max_step=max_step,
+                                       target_mesh=None)
+        n = extra.get("fleet_tenants")
+        if n is None:
+            import jax
+
+            leaf = jax.tree_util.tree_leaves(state.gen_params)[0]
+            n = int(leaf.shape[0])
+        n = int(n)
+        evicted: List[ServeEngine] = []
+        with self._lock:
+            self._state = state
+            self._num_tenants = n
+            for t in [t for t in self._live if t >= n]:
+                evicted.append(self._live.pop(t))
+            live = list(self._live.items())
+        for victim in evicted:
+            victim.stop()
+        # push the new slices OUTSIDE the lock (device transfers):
+        # each engine's own swap lock serializes against its dispatch
+        for t, eng in live:
+            eng.hotswap_params(slice_tenant(state, t).gen_params)
+        events.instant("router.fleet_hotswap", step=got, tenants=n,
+                       live=len(live), evicted=len(evicted))
+        return got
 
     def live_count(self) -> int:
         with self._lock:

@@ -125,9 +125,12 @@ class EventRecorder:
         if path and enabled:
             os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
             self._file = open(path, "a" if append else "w")
-            # continuing a non-empty file: it already carries a header
-            self._header_written = (append
-                                    and os.path.getsize(path) > 0)
+            # a continued file gets a FRESH header too: each
+            # incarnation is a new process (new pid in the host label,
+            # new monotonic anchor), and the merger
+            # (telemetry/tracing.merge_trace_files) segments the file
+            # at every header so each incarnation's events anchor to
+            # its own wall clock
 
     # -- recording ------------------------------------------------------------
 

@@ -155,6 +155,12 @@ class MetricsRegistry:
             ("gan4j_client_reused_total", ()): 0.0,
             ("gan4j_client_reconnects_total", ()): 0.0,
             ("gan4j_client_retried_total", ()): 0.0,
+            # checkpoint publication (serve/publisher.py): a rejected
+            # checkpoint is exactly the event an alert rule exists for
+            # — the series must be scrapeable before the first
+            # poisoned checkpoint ever shows up
+            ("gan4j_publish_rejected_total", ()): 0.0,
+            ("gan4j_publish_promoted_total", ()): 0.0,
         }
         self._gauges: Dict[Tuple[str, tuple], float] = {
             # age since the last data-plane incident; 0 until one
@@ -198,6 +204,11 @@ class MetricsRegistry:
             ("gan4j_resource_device_bytes", ()): 0.0,
             ("gan4j_resource_open_fds", ()): 0.0,
             ("gan4j_resource_threads", ()): 0.0,
+            # publication gauges (serve/publisher.py): last promoted
+            # step and its age; 0 = "nothing published yet" — the feed
+            # (observe_publication) raises them
+            ("gan4j_publish_last_step", ()): 0.0,
+            ("gan4j_publish_age_seconds", ()): 0.0,
         }
         self._callbacks: List[Callable[["MetricsRegistry"], None]] = []
         self.run_id: Optional[str] = None
@@ -241,6 +252,13 @@ class MetricsRegistry:
         # drives the gan4j_resource_* gauges and the /healthz
         # "resources" block
         self._resources_fn: Optional[
+            Callable[[], Optional[Dict]]] = None
+        # publication feed (serve/publisher.CheckpointPublisher.report):
+        # drives the gan4j_publish_* series, the /healthz
+        # "publication" block, and the top-level "serving_stale" flag
+        # (true while the serving plane runs on old weights because no
+        # fresh checkpoint has arrived / survived verification)
+        self._publication_fn: Optional[
             Callable[[], Optional[Dict]]] = None
 
     @staticmethod
@@ -529,6 +547,34 @@ class MetricsRegistry:
 
         self.add_callback(cb)
 
+    def observe_publication(self, report_fn:
+                            Callable[[], Optional[Dict]]) -> None:
+        """Register the checkpoint-publication feed: ``report_fn``
+        returns a ``CheckpointPublisher.report()`` dict (last promoted
+        step, age, promote/reject totals).  Scrapes mirror it into the
+        ``gan4j_publish_*`` series and ``/healthz`` carries it as the
+        ``"publication"`` block plus a top-level ``serving_stale``
+        flag — the graceful-degradation signal: replicas still answer
+        (status stays "ok") but on weights older than the staleness
+        budget, which is a trainer-down page, not a serving page."""
+        with self._lock:
+            self._publication_fn = report_fn
+
+        def cb(reg: "MetricsRegistry") -> None:
+            rep = report_fn()
+            if not rep:
+                return
+            reg.set("gan4j_publish_last_step",
+                    float(rep.get("last_step", 0)))
+            reg.set("gan4j_publish_age_seconds",
+                    float(rep.get("age_seconds", 0.0)))
+            reg.set_counter("gan4j_publish_promoted_total",
+                            float(rep.get("promoted_total", 0)))
+            reg.set_counter("gan4j_publish_rejected_total",
+                            float(rep.get("rejected_total", 0)))
+
+        self.add_callback(cb)
+
     def observe_client(self, report_fn: Callable[[], Optional[Dict]]
                        ) -> None:
         """Register a ``GatewayClient.report()`` feed: connection-pool
@@ -743,6 +789,25 @@ class MetricsRegistry:
                     "ok": bool(rep.get("ok", True))}
             except Exception:  # gan4j-lint: disable=swallowed-exception — a broken feed must not take down the probe
                 pass
+        # the publication block: live feed when a CheckpointPublisher
+        # is running, else the pre-created series — ALWAYS present.
+        # stale:true means the serving plane is answering on old
+        # weights (trainer down or checkpoints failing verification);
+        # the top-level serving_stale flag mirrors it so probes need
+        # not descend into the block.
+        publication = None
+        pubfn = self._publication_fn
+        if pubfn is not None:
+            try:
+                rep = pubfn() or {}
+                publication = {
+                    "last_step": int(rep.get("last_step", 0)),
+                    "age_seconds": round(
+                        float(rep.get("age_seconds", 0.0)), 3),
+                    "stale": bool(rep.get("stale", False)),
+                    "ok": bool(rep.get("ok", True))}
+            except Exception:  # gan4j-lint: disable=swallowed-exception — a broken feed must not take down the probe
+                pass
         # the resources block: live feed when a ResourceMonitor is
         # sampling, else the pre-created gauges — ALWAYS present.
         # Leak VERDICTS stay offline in the soak gate; the probe only
@@ -826,6 +891,13 @@ class MetricsRegistry:
                         ("gan4j_controlplane_rollbacks_total", ()),
                         0.0)),
                     "deploy_state": None, "fatal": None, "ok": True}
+            if publication is None:
+                publication = {
+                    "last_step": int(self._gauges.get(
+                        ("gan4j_publish_last_step", ()), 0.0)),
+                    "age_seconds": round(self._gauges.get(
+                        ("gan4j_publish_age_seconds", ()), 0.0), 3),
+                    "stale": False, "ok": True}
             if resources is None:
                 resources = {
                     "rss_bytes": int(self._gauges.get(
@@ -846,6 +918,8 @@ class MetricsRegistry:
                    "gateway": gateway,
                    "serving_mesh": serving_mesh,
                    "controlplane": controlplane,
+                   "publication": publication,
+                   "serving_stale": bool(publication.get("stale")),
                    "resources": resources}
             if beat_age is not None:
                 doc["last_beat_age_s"] = round(float(beat_age), 3)

@@ -27,13 +27,19 @@ signal crosses the wire.  This module is the Dapper-style answer:
   ``trace.dispatch``/``trace.readback``) is documented in
   docs/OBSERVABILITY.md.
 * **trace_merge** — ``merge_trace_files`` joins per-process
-  ``events.jsonl`` files into ONE timeline keyed by trace id.  Each
-  file's first line is its recorder's ``recorder.start`` header
-  anchoring the process-local monotonic clock (``t``) to wall time
-  (``wall``); the merge normalizes every span to ``wall0 + t`` so
-  spans from different hosts order correctly without assuming a
-  shared monotonic epoch.  ``python -m
-  gan_deeplearning4j_tpu.telemetry.tracing FILE...`` is the CLI.
+  ``events.jsonl`` files into ONE timeline keyed by trace id.  A
+  ``recorder.start`` header anchors each process-local monotonic
+  clock (``t``) to wall time (``wall``); the merge normalizes every
+  span to ``wall0 + t`` so spans from different hosts order correctly
+  without assuming a shared monotonic epoch.  Files are SEGMENTED at
+  every header: an appended multi-incarnation trainer file (resume
+  after preemption) gets one anchor per incarnation, not one per
+  file.  ``include_events=`` prefixes additionally ingest non-trace
+  events (trainer lifecycle, publication decisions, chaos firings)
+  into a flat wall-ordered ``timeline`` — the combined-chaos
+  scenario's one contiguous cross-process story.  ``python -m
+  gan_deeplearning4j_tpu.telemetry.tracing FILE... [--events
+  PREFIX]`` is the CLI.
 
 A trace tree is COMPLETE when it has exactly one root (a span with
 no parent) and every other span's parent id resolves to a span in
@@ -134,8 +140,8 @@ _STRUCTURAL = ("name", "ph", "t", "wall", "thread", "dur",
 
 
 def _file_anchor(evs: List[Dict]) -> tuple:
-    """(wall0, host) from the file's ``recorder.start`` header line —
-    the anchor that turns process-local monotonic ``t`` into a
+    """(wall0, host) from the segment's ``recorder.start`` header line
+    — the anchor that turns process-local monotonic ``t`` into a
     cross-process wall timestamp."""
     for ev in evs:
         if ev.get("name") == "recorder.start":
@@ -143,48 +149,99 @@ def _file_anchor(evs: List[Dict]) -> tuple:
     return None, None
 
 
-def merge_trace_files(paths: Sequence[str]) -> Dict:
+def _segments(evs: List[Dict]) -> List[List[Dict]]:
+    """Split one events file at EVERY ``recorder.start`` header.
+
+    A trainer that resumes after preemption/crash APPENDS to its own
+    ``events.jsonl`` (train/shell.py): one file then holds several
+    incarnations, each a distinct process (the header's host is
+    ``node:pid``) with its OWN monotonic epoch.  Anchoring the whole
+    file on the first header would misplace every later incarnation's
+    spans by the restart gap; per-segment anchors keep each
+    incarnation wall-correct, so the merged timeline genuinely spans
+    trainer incarnations and replica processes alike."""
+    segs: List[List[Dict]] = []
+    cur: List[Dict] = []
+    for ev in evs:
+        if ev.get("name") == "recorder.start" and cur:
+            segs.append(cur)
+            cur = []
+        cur.append(ev)
+    if cur:
+        segs.append(cur)
+    return segs
+
+
+def merge_trace_files(paths: Sequence[str],
+                      include_events: Sequence[str] = ()) -> Dict:
     """Join per-process events files into one timeline keyed by trace
-    id.  Returns ``{"traces": {tid: {...}}, "stats": {...}}`` where
-    each trace carries its wall-ordered spans, the process set it
-    touched, and a completeness verdict (exactly one root + every
-    parent resolves)."""
+    id.  Returns ``{"traces": {tid: {...}}, "timeline": [...],
+    "stats": {...}}`` where each trace carries its wall-ordered spans,
+    the process set it touched, and a completeness verdict (exactly
+    one root + every parent resolves).
+
+    ``include_events``: name PREFIXES (e.g. ``("fleet.", "publish.",
+    "chaos.")``) of non-trace events to ingest into the flat
+    wall-ordered ``"timeline"`` list — how the combined-chaos
+    scenario joins trainer-side lifecycle events (``fleet.start``,
+    ``preempt.exit``), publication decisions and chaos firings with
+    the serving spans into ONE contiguous cross-process story.  Files
+    are segmented at every ``recorder.start`` header so appended
+    multi-incarnation trainer files normalize correctly (see
+    :func:`_segments`)."""
+    prefixes = tuple(str(p) for p in include_events)
     spans: List[Dict] = []
+    timeline: List[Dict] = []
     files_read = 0
+    n_segments = 0
     for path in paths:
         try:
             evs = events.read_events(path)
         except OSError:  # gan4j-lint: disable=swallowed-exception — a replica that died pre-flush (SIGKILL chaos) has no file; the merge must still join the survivors
             continue
         files_read += 1
-        wall0, host = _file_anchor(evs)
-        for ev in evs:
-            name = ev.get("name", "")
-            if not name.startswith("trace."):
-                continue
-            if "trace" not in ev or "span" not in ev:
-                continue
-            t = ev.get("t")
-            if wall0 is not None and isinstance(t, (int, float)):
-                wall = wall0 + t
-            else:
-                wall = ev.get("wall")  # torn header: per-event clock
-            span = {"name": name,
-                    "trace": ev["trace"],
-                    "span": ev["span"],
-                    "parent": ev.get("parent"),
-                    "host": host or ev.get("host") or path,
-                    "wall": wall,
-                    "dur": float(ev.get("dur") or 0.0)}
-            if ev.get("error") is not None:
-                span["error"] = ev["error"]
-            if ev.get("status") is not None:
-                span["status"] = ev["status"]
-            extra = {k: v for k, v in ev.items()
-                     if k not in _STRUCTURAL}
-            if extra:
-                span["attrs"] = extra
-            spans.append(span)
+        for seg in _segments(evs):
+            n_segments += 1
+            wall0, host = _file_anchor(seg)
+            for ev in seg:
+                name = ev.get("name", "")
+                t = ev.get("t")
+                if wall0 is not None and isinstance(t, (int, float)):
+                    wall = wall0 + t
+                else:
+                    wall = ev.get("wall")  # torn header: per-event clock
+                if not name.startswith("trace."):
+                    if prefixes and name.startswith(prefixes):
+                        item = {"name": name,
+                                "host": host or ev.get("host") or path,
+                                "wall": wall}
+                        if ev.get("error") is not None:
+                            item["error"] = ev["error"]
+                        extra = {k: v for k, v in ev.items()
+                                 if k not in _STRUCTURAL}
+                        if extra:
+                            item["attrs"] = extra
+                        timeline.append(item)
+                    continue
+                if "trace" not in ev or "span" not in ev:
+                    continue
+                span = {"name": name,
+                        "trace": ev["trace"],
+                        "span": ev["span"],
+                        "parent": ev.get("parent"),
+                        "host": host or ev.get("host") or path,
+                        "wall": wall,
+                        "dur": float(ev.get("dur") or 0.0)}
+                if ev.get("error") is not None:
+                    span["error"] = ev["error"]
+                if ev.get("status") is not None:
+                    span["status"] = ev["status"]
+                extra = {k: v for k, v in ev.items()
+                         if k not in _STRUCTURAL}
+                if extra:
+                    span["attrs"] = extra
+                spans.append(span)
+    timeline.sort(key=lambda e: (e["wall"] is None, e["wall"]))
 
     by_trace: Dict[str, List[Dict]] = {}
     for s in spans:
@@ -215,7 +272,10 @@ def merge_trace_files(paths: Sequence[str]) -> Dict:
     total = len(traces)
     stats = {
         "files": files_read,
+        "segments": n_segments,
         "spans": len(spans),
+        "timeline_events": len(timeline),
+        "timeline_processes": sorted({e["host"] for e in timeline}),
         "traces": total,
         "complete": n_complete,
         "complete_frac": (n_complete / total) if total else 0.0,
@@ -225,7 +285,7 @@ def merge_trace_files(paths: Sequence[str]) -> Dict:
         "stage_p50_ms": {k: round(statistics.median(v), 3)
                          for k, v in sorted(stage_ms.items())},
     }
-    return {"traces": traces, "stats": stats}
+    return {"traces": traces, "timeline": timeline, "stats": stats}
 
 
 def main(argv: Optional[Sequence[str]] = None) -> int:
@@ -241,8 +301,15 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     p.add_argument("--trace", default=None,
                    help="print one trace id's merged spans instead "
                         "of the stats line")
+    p.add_argument("--events", action="append", default=[],
+                   metavar="PREFIX",
+                   help="also ingest non-trace events whose name "
+                        "starts with PREFIX into the flat timeline "
+                        "(repeatable; e.g. --events fleet. --events "
+                        "chaos.)")
     args = p.parse_args(argv)
-    merged = merge_trace_files(args.files)
+    merged = merge_trace_files(args.files,
+                               include_events=tuple(args.events))
     if args.out:
         with open(args.out, "w") as f:
             json.dump(merged, f, indent=1, sort_keys=True)

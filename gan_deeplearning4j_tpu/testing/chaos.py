@@ -78,6 +78,16 @@ the seeded, deterministic injector that does all four, driven by
   newest checkpoint that VERIFIES but serves NaN — only the canary's
   SLO probe can catch it, and auto-rollback must land on the previous
   step with the rollback budget charged.
+  ``poison_fleet_checkpoint_dir`` is the fleet variant: ONE tenant's
+  ``gen_params`` slice NaN'd through a genuine ``FleetCheckpointer``
+  save, catchable only by the publisher's finite-params probe or the
+  canary (docs/SCENARIO.md).
+* **cross-plane coordination** — ``ChaosSchedule`` fires a SEEDED
+  timeline of the injections above against the training and serving
+  planes in the same run (trainer preemption + world shrink AND
+  replica kill + slow-loris + corrupt tenant rows), with the resolved
+  deterministic timeline written into events up front — the
+  combined-chaos scenario's conductor (``bench --scenario``).
 
 Everything is parameterized by an explicit seed: a chaos failure must
 replay exactly.
@@ -827,7 +837,7 @@ def poison_checkpoint_dir(directory: str, name: str = "gen") -> int:
     or a bad export), so only the control plane's canary SLO probe
     (finite outputs) can catch it, and rollback must land on step N.
     Returns the poisoned step."""
-    ckpt = _ckpt_mod.TrainCheckpointer(directory)
+    ckpt = _ckpt_mod.TrainCheckpointer(directory, sweep_debris=False)
     steps = ckpt.steps()
     base = None
     for s in reversed(steps):
@@ -893,6 +903,157 @@ def poison_checkpoint_dir(directory: str, name: str = "gen") -> int:
     os.rename(tmp, os.path.join(directory, f"ckpt_{new_step}"))
     _ckpt_mod._fsync_dir(directory)
     return new_step
+
+
+def poison_fleet_checkpoint_dir(directory: str, tenant: int = 0) -> int:
+    """Fleet variant of :func:`poison_checkpoint_dir`: forge a
+    VERIFYING newest fleet checkpoint whose ``gen_params`` are NaN for
+    ONE tenant's slice.  The forgery goes through
+    ``FleetCheckpointer.save`` itself (restore newest verified → NaN
+    the slice → save as step N+1), so manifest hashing is genuine —
+    only a semantic probe can catch it: the publisher's finite-params
+    probe over ``state.npz`` (rejection at publication), or — had it
+    been deployed — the canary's finite-output probe against the
+    tenant's serving engine (``FleetTenantBank`` path, tenant 0 being
+    the fleet replica's plain-probe engine).  Returns the poisoned
+    step."""
+    from gan_deeplearning4j_tpu.train.fleet import FleetCheckpointer
+
+    # keep ALL existing checkpoints (the forge must not prune the live
+    # trainer's history) and never sweep the owner's in-flight tmps
+    ck = FleetCheckpointer(directory, keep=10 ** 9, sweep_debris=False)
+    steps = ck._inner.steps()
+    if not steps:
+        raise FileNotFoundError(
+            f"no checkpoints in {directory} to poison")
+    # target_mesh=None: the forge runs host-side (maybe fewer devices
+    # than the trainer that wrote the checkpoint); extras-only fleet
+    # restores carry no sharded graphs, so nothing needs resharding
+    _, state, _ = ck.restore(target_mesh=None)
+    n = int(state.it.shape[0])
+    if not 0 <= int(tenant) < n:
+        raise ValueError(f"tenant {tenant} outside fleet of {n}")
+
+    def nan_slice(x):
+        arr = np.array(np.asarray(x), copy=True)
+        if np.issubdtype(arr.dtype, np.floating):
+            arr[int(tenant)] = np.nan
+        return arr
+
+    import jax
+
+    poisoned = state._replace(
+        gen_params=jax.tree.map(nan_slice, state.gen_params))
+    new_step = max(steps) + 1
+    ck.save(new_step, poisoned)
+    return new_step
+
+
+class ChaosSchedule:
+    """A seeded CROSS-PLANE chaos timeline: one coordinator firing
+    injections against the training plane (preemption signal, world
+    shrink, corrupt tenant rows) and the serving plane (replica kill,
+    slow-loris, wedge) in the same run — the combined-chaos scenario's
+    conductor (scenario/runner.py, docs/SCENARIO.md).
+
+    Determinism contract: actions are registered with ``add(at_s,
+    name, fn)`` in a fixed caller order; per-entry jitter (when
+    ``jitter_s`` > 0) is drawn from ``random.Random(seed)`` in that
+    order, so the same seed + same registration sequence yields the
+    same resolved timeline, every run.  The resolved timeline is
+    written into the events stream UP FRONT (``chaos.schedule``) and
+    every firing lands a ``chaos.fire`` event with the action's
+    outcome — an action's exception is captured and counted, never
+    allowed to kill the coordinator thread (chaos that crashes the
+    chaos harness proves nothing)."""
+
+    def __init__(self, seed: int, *, jitter_s: float = 0.0):
+        self.seed = int(seed)
+        self.jitter_s = float(jitter_s)
+        self._rng = random.Random(self.seed)
+        self._entries: list = []
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._lock = threading.Lock()
+        self.fired: list = []
+
+    def add(self, at_s: float, name: str, fn, **attrs) -> float:
+        """Register ``fn`` to fire ``at_s`` seconds (plus seeded
+        jitter) after ``start()``.  Returns the resolved offset."""
+        if self._thread is not None:
+            raise RuntimeError("schedule already started")
+        at = float(at_s)
+        if self.jitter_s > 0:
+            at += self._rng.uniform(0.0, self.jitter_s)
+        self._entries.append({"at_s": round(at, 3), "name": str(name),
+                              "fn": fn, "attrs": dict(attrs)})
+        return at
+
+    def timeline(self) -> list:
+        """The resolved deterministic timeline (no callables — the
+        JSON-safe form written to events and verdicts)."""
+        return [{"at_s": e["at_s"], "name": e["name"], **e["attrs"]}
+                for e in sorted(self._entries,
+                                key=lambda e: e["at_s"])]
+
+    def start(self) -> "ChaosSchedule":
+        with self._lock:
+            if self._thread is not None:
+                raise RuntimeError("schedule already started")
+            t = threading.Thread(
+                target=self._run, name="gan4j-chaos-schedule",
+                daemon=True)
+            self._thread = t
+        from gan_deeplearning4j_tpu.telemetry import events
+
+        events.instant("chaos.schedule", seed=self.seed,
+                       jitter_s=self.jitter_s,
+                       timeline=self.timeline())
+        t.start()
+        return self
+
+    def _run(self) -> None:
+        from gan_deeplearning4j_tpu.telemetry import events
+
+        t0 = time.monotonic()
+        for entry in sorted(self._entries, key=lambda e: e["at_s"]):
+            delay = t0 + entry["at_s"] - time.monotonic()
+            if delay > 0 and self._stop.wait(delay):
+                return
+            if self._stop.is_set():
+                return
+            rec = {"name": entry["name"], "at_s": entry["at_s"],
+                   "error": None}
+            try:
+                entry["fn"]()
+            except Exception as e:  # gan4j-lint: disable=swallowed-exception — an injection that raises (its target already dead, a race with the plane it attacks) is an OUTCOME to record, not a coordinator crash
+                rec["error"] = repr(e)
+            with self._lock:
+                self.fired.append(rec)
+            events.instant("chaos.fire", action=rec["name"],
+                           at_s=rec["at_s"], error=rec["error"])
+
+    def stop(self) -> None:
+        self._stop.set()
+        t = self._thread
+        if t is not None:
+            t.join(timeout=30.0)
+
+    def __enter__(self) -> "ChaosSchedule":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    def report(self) -> Dict:
+        with self._lock:
+            fired = list(self.fired)
+        return {"seed": self.seed,
+                "planned": len(self._entries),
+                "fired": len(fired),
+                "errors": sum(1 for f in fired if f["error"]),
+                "timeline": self.timeline(),
+                "outcomes": fired}
 
 
 class LeakyDispatchSource:

@@ -314,12 +314,14 @@ class FleetCheckpointer:
 
     EXTRA_KEY = "fleet"
 
-    def __init__(self, directory: str, keep: int = 3):
+    def __init__(self, directory: str, keep: int = 3,
+                 sweep_debris: bool = True):
         from gan_deeplearning4j_tpu.checkpoint.checkpointer import (
             TrainCheckpointer,
         )
 
-        self._inner = TrainCheckpointer(directory, keep=keep)
+        self._inner = TrainCheckpointer(directory, keep=keep,
+                                        sweep_debris=sweep_debris)
         self.directory = directory
 
     def save(self, step: int, state: ProtocolState, mesh=None) -> str:
@@ -354,6 +356,19 @@ class FleetCheckpointer:
             return step_out, slice_tenant(state, int(tenants)), extra
         return step_out, subset_state(state, tenants), extra
 
+    # thin delegates to the inner checkpointer's discovery surface —
+    # the publication pipeline (serve/publisher.py) and the fleet
+    # serving bank walk fleet directories with the same verbs as
+    # single-model ones
+    def steps(self) -> list:
+        return self._inner.steps()
+
+    def verify(self, step: int) -> bool:
+        return self._inner.verify(step)
+
+    def latest_verified_step(self) -> Optional[int]:
+        return self._inner.latest_verified_step()
+
 
 # ---------------------------------------------------------------------------
 # the fleet payload behind the shared supervision shell
@@ -381,6 +396,16 @@ class FleetConfig:
     resume: bool = False
     watchdog: bool = False
     sanitize: bool = False
+    # e.g. "SIGTERM": arm the shell's PreemptionGuard; the loop then
+    # drains at the next step boundary — emergency fleet checkpoint,
+    # PREEMPTED.json marker, PreemptionError (exit 75 protocol)
+    preempt_signals: Optional[str] = None
+
+
+# fault-injection seam (testing/chaos.py, scenario/trainer_child.py):
+# called as _chaos_step_hook(step) at every fleet step boundary; a
+# raised DeviceLostError simulates losing part of the tenant mesh
+_chaos_step_hook: Optional[Callable[[int], None]] = None
 
 
 class FleetTrainer:
@@ -442,12 +467,20 @@ class FleetTrainer:
         from gan_deeplearning4j_tpu.train.shell import SupervisionShell
 
         c = self.c
+        preempt_nums = ()
+        if c.preempt_signals:
+            from gan_deeplearning4j_tpu.train.preemption import (
+                parse_signals,
+            )
+
+            preempt_nums = parse_signals(c.preempt_signals)
         shell = SupervisionShell(
             self.registry, c.res_path,
             events_enabled=c.events, events_append=c.resume,
             watchdog=c.watchdog, sanitize=c.sanitize,
             step_fn=lambda: self.batch_counter,
-            metrics_port=c.metrics_port, log=log)
+            metrics_port=c.metrics_port,
+            preempt_signal_nums=preempt_nums, log=log)
 
         def _payload():
             self.metrics_port = shell.metrics_port
@@ -466,6 +499,25 @@ class FleetTrainer:
             f"{self.c.num_tenants} tenants, "
             f"{self._steps_per_sec:.1f} steps/s "
             f"(d_loss mean {float(d.mean()):.4f})")
+
+    def _preempt_drain(self, state, mesh, shell) -> None:
+        """The latched preemption signal observed at a step boundary:
+        fence, commit an emergency fleet checkpoint, and exit through
+        the one protocol every trainer shares (``preempt_exit``:
+        PREEMPTED.json marker + ``PreemptionError`` — the scenario
+        orchestrator maps it to exit code 75 and respawns with
+        ``--resume``)."""
+        from gan_deeplearning4j_tpu.train.preemption import preempt_exit
+
+        device_fence(state)
+        path = None
+        if self.checkpointer is not None:
+            path = self.checkpointer.save(self.batch_counter, state,
+                                          mesh=mesh)
+        preempt_exit(self.c.res_path, shell.guard,
+                     local_step=self.batch_counter,
+                     fleet_min_step=self.batch_counter,
+                     checkpoint=path)
 
     def _train_impl(self, features, labels, shell, log) -> Dict:
         c = self.c
@@ -551,6 +603,10 @@ class FleetTrainer:
             window_steps += k
             if shell.watchdog is not None:
                 shell.watchdog.beat(self.batch_counter)
+            if shell.guard is not None and shell.guard.triggered:
+                self._preempt_drain(state, mesh, shell)
+            if _chaos_step_hook is not None:
+                _chaos_step_hook(self.batch_counter)
             at_print = (c.print_every
                         and self.batch_counter % c.print_every < k)
             at_ckpt = (self.checkpointer is not None

@@ -1,0 +1,93 @@
+"""The combined-chaos train→serve scenario (scenario/).
+
+Fast tier: the pieces — tolerant CSV parsing (corrupt rows become NaN
+rows, not crashes), the seeded data writer, and one real trainer-child
+incarnation driven through its exit-code protocol.  The full organism
+— fleet trains while the mesh serves, publisher carries checkpoints,
+seeded chaos tears both planes — runs in the ``slow`` lane (CI covers
+it via ``bench --scenario``)."""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from gan_deeplearning4j_tpu.scenario import runner as runner_mod
+from gan_deeplearning4j_tpu.scenario.trainer_child import (
+    EXIT_DEVICE_LOST,
+    FINAL_NAME,
+    read_csv_tolerant,
+)
+
+
+def test_read_csv_tolerant_maps_corrupt_rows_to_nan(tmp_path):
+    path = str(tmp_path / "d.csv")
+    with open(path, "w") as f:
+        f.write("1.0,2.0,3.0\n")
+        f.write("#CORRUPT#,x,y\n")          # chaos injector rewrite
+        f.write("4.0,5.0\n")                # wrong width
+        f.write("\n")                       # blank: skipped entirely
+        f.write("6.0,7.0,8.0\n")
+    data = read_csv_tolerant(path, 3)
+    assert data.shape == (4, 3) and data.dtype == np.float32
+    assert np.isfinite(data[0]).all() and np.isfinite(data[3]).all()
+    assert np.isnan(data[1]).all() and np.isnan(data[2]).all()
+
+    with open(str(tmp_path / "empty.csv"), "w") as f:
+        f.write("\n")
+    with pytest.raises(ValueError):
+        read_csv_tolerant(str(tmp_path / "empty.csv"), 3)
+
+
+def test_write_insurance_csv_deterministic(tmp_path):
+    a, b = str(tmp_path / "a.csv"), str(tmp_path / "b.csv")
+    runner_mod._write_insurance_csv(a, rows=8, width=13, seed=5)
+    runner_mod._write_insurance_csv(b, rows=8, width=13, seed=5)
+    with open(a) as f:
+        content = f.read()
+    with open(b) as f:
+        assert f.read() == content  # same seed, same bytes
+    data = read_csv_tolerant(a, 13)
+    assert data.shape == (8, 13) and np.isfinite(data).all()
+    assert set(np.unique(data[:, -1])) <= {0.0, 1.0}  # labels
+
+
+def test_trainer_child_completes_and_reports(tmp_path):
+    """One real incarnation: exit 0, atomic final.json with the
+    trajectory the band check consumes, READY.json armed."""
+    res = str(tmp_path / "run")
+    csv = str(tmp_path / "d.csv")
+    runner_mod._write_insurance_csv(csv, rows=8, width=13, seed=7)
+    proc = subprocess.run(
+        [sys.executable, "-m", runner_mod.TRAINER_MODULE,
+         "--res-path", res, "--data", csv, "--tenants", "2",
+         "--iterations", "2", "--batch-size", "2",
+         "--checkpoint-every", "0", "--seed", "7"],
+        env=runner_mod._child_env(None), capture_output=True,
+        text=True, timeout=120)
+    assert proc.returncode == 0, proc.stderr
+    with open(os.path.join(res, FINAL_NAME)) as f:
+        final = json.load(f)
+    assert final["step"] == 2 and final["tenants"] == 2
+    assert np.isfinite(final["d_loss"]) and np.isfinite(final["g_loss"])
+    with open(os.path.join(res, "READY.json")) as f:
+        assert json.load(f)["pid"] > 0
+    assert final["quarantined"] == 0
+
+
+@pytest.mark.slow
+def test_combined_chaos_scenario_end_to_end(tmp_path):
+    """The full production organism under seeded combined chaos: every
+    verified checkpoint published via canary, the poisoned one
+    rejected, SLOs held on stale weights, trajectory banded vs the
+    undisturbed control, one merged cross-process timeline."""
+    verdict = runner_mod.run_scenario(str(tmp_path / "scenario"),
+                                      seed=23)
+    assert verdict["ok"], verdict["failures"]
+    assert verdict["trainer"]["exits"][:2] == [75, EXIT_DEVICE_LOST]
+    assert verdict["publish"]["rejected_total"] >= 1
+    assert not verdict["serving"]["non_typed"]
+    assert verdict["trace"]["trainer_incarnations"] >= 2
