@@ -464,7 +464,67 @@ def _build_fleet_step(donate: bool = True) -> List[Built]:
             f"spmd{n_dev}", step, abstractify(args),
             len(jax.tree.leaves(state)) if donate else 0, b,
             mesh_shape={pfleet.AXIS: n_dev}))
+    # the lifecycle cohort form (train/lifecycle.py): the MASKED fleet
+    # step — signature gains an (N,) bool mask after rng_keys; ghost
+    # slots, quarantine freezes and onboard fills are mask VALUES in
+    # these exact programs — lowered at the smallest and a mid tenant
+    # bucket.  The contract claim is unchanged by masking: donation
+    # still aliased, zero collectives.
+    from gan_deeplearning4j_tpu.train.lifecycle import (
+        DEFAULT_TENANT_BUCKETS,
+    )
+
+    mkm = dict(mk, masked=True)
+    for n in (DEFAULT_TENANT_BUCKETS[0], 8):
+        step = fleet.make_fleet_step(
+            dis, gen, gan, classifier, I.DIS_TO_GAN, I.GAN_TO_GEN,
+            I.DIS_TO_CLASSIFIER, **mkm)
+        state, args = fleet_args(n)
+        margs = args[:5] + (jnp.ones((n,), jnp.bool_),) + args[5:]
+        built.append(Built(
+            f"masked_t{n}", step, abstractify(margs),
+            len(jax.tree.leaves(state)) if donate else 0, b))
+    # a non-default-architecture cohort (h64_l2): the heterogeneous
+    # fleet's OTHER compiled program family — each cohort lowers its
+    # own masked step, so the narrower/shallower variant must satisfy
+    # the same contract
+    cfg64 = I.InsuranceConfig(hidden=64, gen_layers=2)
+    dis64, gen64 = I.build_discriminator(cfg64), I.build_generator(cfg64)
+    gan64, clf64 = I.build_gan(cfg64), I.build_classifier(dis64, cfg64)
+    step64 = fleet.make_fleet_step(
+        dis64, gen64, gan64, clf64, I.DIS_TO_GAN,
+        I.gan_to_gen_map(cfg64), I.DIS_TO_CLASSIFIER, **mkm)
+    n = DEFAULT_TENANT_BUCKETS[0]
+    state64 = fleet.replicate_state(
+        fused.state_from_graphs(dis64, gen64, gan64, clf64), n)
+    ones = jnp.ones((b, 1), jnp.float32)
+    args64 = (state64,
+              jnp.zeros((n, b, cfg64.num_features), jnp.float32),
+              jnp.zeros((n, b, 1), jnp.float32),
+              fleet.tenant_keys(jax.random.key(0), n),
+              fleet.tenant_keys(jax.random.key(1), n),
+              jnp.ones((n,), jnp.bool_), ones, 0.0 * ones, ones)
+    built.append(Built(
+        f"masked_h64l2_t{n}", step64, abstractify(args64),
+        len(jax.tree.leaves(state64)) if donate else 0, b))
     return built
+
+
+def _tenant_bucket_spec() -> Dict:
+    # the tenant-axis bucket discipline (train/lifecycle.py): cohort
+    # capacity is always one of DEFAULT_TENANT_BUCKETS, so those counts
+    # are the complete set of fleet-step shapes lifecycle warmup can
+    # compile — "exact" membership, pinned in the contract so changing
+    # the bucket set is a contract diff, never a silent recompile
+    from gan_deeplearning4j_tpu.train.lifecycle import (
+        DEFAULT_TENANT_BUCKETS,
+    )
+
+    return {
+        "mode": "exact",
+        "code_declared": sorted(DEFAULT_TENANT_BUCKETS),
+        "reachable": sorted(DEFAULT_TENANT_BUCKETS),
+    }
 
 
 def _serving_bucket_spec() -> Dict:
@@ -522,8 +582,13 @@ register_entry(EntryPoint(
     summary="multi-tenant fleet step: the fused protocol step vmapped "
             "over the tenant axis (train/fleet.py), lowered at 8 and "
             "1024 tenants plus the shard_map tenant-mesh variant "
-            "(parallel/fleet.py; zero collectives by construction)",
+            "(parallel/fleet.py; zero collectives by construction) "
+            "and the lifecycle cohort forms — the masked step at "
+            "bucketed tenant capacities incl. a non-default h64_l2 "
+            "cohort (train/lifecycle.py; mask flips are runtime "
+            "values, never program changes)",
     build=_build_fleet_step,
+    bucket_spec=_tenant_bucket_spec,
 ))
 
 register_entry(EntryPoint(

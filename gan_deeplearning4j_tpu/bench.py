@@ -752,6 +752,20 @@ def fleet_bench(sweep=FLEET_SWEEP, flagship: int = FLEET_FLAGSHIP,
     out["fleet"] = {"multistep_step_ms": flag["step_ms"],
                     "spread": flag["spread"],
                     "tenants": flag_n}
+    # the lifecycle headline next to tenants*steps/sec: median onboard
+    # latency over in-process onboard/offboard cycles on a warmed
+    # heterogeneous fleet (zero post-warmup recompiles is part of the
+    # probe's own ok), gate-compatible as the "fleet_lifecycle" series
+    lc = lifecycle_dryrun()
+    out["onboard_latency_ms"] = lc["onboard_latency_ms"]
+    out["lifecycle_ok"] = lc["ok"]
+    out["fleet_lifecycle"] = {
+        "multistep_step_ms": lc["onboard_latency_ms"],
+        "spread": {"median_ms": lc["onboard_latency_ms"],
+                   "iqr_ms": lc["onboard_iqr_ms"]},
+        "cycles": lc["cycles"],
+        "post_warmup_recompiles": lc["post_warmup_recompiles"],
+    }
     if 1 in stages:
         t1, tn = stages[1]["step_ms"], flag["step_ms"]
         # per-dispatch slope ratio: honest but partial — the slope
@@ -1310,6 +1324,96 @@ def race_dryrun(registry=None) -> dict:
                        and not dep.leaked_threads())}
 
 
+def lifecycle_dryrun(registry=None, cycles: int = 3) -> dict:
+    """Tenant-lifecycle probe (train/lifecycle.py, docs/FLEET.md
+    "Tenant lifecycle and fault domains"): a tiny HETEROGENEOUS fleet
+    — two cohorts of different width/depth, each padded to its warmed
+    bucket — runs ``cycles`` onboard/offboard cycles plus masked
+    training windows under an armed ``RecompileSentinel``.  Membership
+    churn is host surgery on the tenant axis, so the warmed programs
+    are the whole set: ``ok`` demands ZERO post-warmup recompiles,
+    finite survivor losses through the churn, a measured onboard
+    latency, and a restorable final checkpoint from the offboard path.
+
+    The median/IQR over the cycle latencies is the gate-compatible
+    ``fleet_lifecycle`` series (bench_gate.SERIES) and the
+    ``onboard_latency_ms`` headline in ``bench --fleet``."""
+    import math
+    import shutil
+
+    import numpy as np
+
+    from gan_deeplearning4j_tpu.analysis import RecompileSentinel
+    from gan_deeplearning4j_tpu.train.lifecycle import (
+        FleetManager,
+        LifecycleConfig,
+        TenantSpec,
+    )
+
+    B = 4
+    segments = 4
+    tmp = tempfile.mkdtemp(prefix="gan4j_lifecycle_dryrun_")
+    try:
+        specs = [TenantSpec(0),                           # h100_l3
+                 TenantSpec(1, hidden=64, gen_layers=2)]  # h64_l2
+        cfg = LifecycleConfig(
+            batch_size=B, res_path=tmp, buckets=(2,), warm_buckets=(2,),
+            num_segments=segments, record_timelines=False)
+        mgr = FleetManager(specs, cfg, registry=registry)
+        rng = np.random.RandomState(7)
+
+        def feed():
+            feats = rng.rand(segments * B, 12).astype(np.float32)
+            labs = (rng.rand(segments * B, 1) > 0.5).astype(np.float32)
+            return feats, labs
+
+        latencies: list = []
+        sentinel = RecompileSentinel(registry=registry)
+        with sentinel:
+            mgr.warmup()
+            sentinel.arm()
+            mgr.step_window(*feed(), steps=1)
+            # churn: tenant 2 rides the h100 cohort's ghost slot —
+            # onboard fills it (host surgery + eager key rebuild),
+            # offboard vacates it and writes the final per-tenant
+            # checkpoint; every cycle is one latency sample
+            ckpt_path = None
+            for _ in range(max(1, int(cycles))):
+                latencies.append(mgr.onboard(TenantSpec(2)))
+                mgr.step_window(*feed(), steps=1)
+                ckpt_path = mgr.offboard(2)
+            win = mgr.step_window(*feed(), steps=1)
+        losses_ok = all(
+            math.isfinite(float(v))
+            for rec in win["losses"].values() for v in rec["d"])
+        med = float(np.median(latencies)) if latencies else 0.0
+        q1, q3 = (np.percentile(latencies, [25, 75])
+                  if latencies else (0.0, 0.0))
+        rec = {
+            "tenants": len(mgr.active_ids()),
+            "cohorts": len(mgr.cohorts),
+            "cycles": len(latencies),
+            "onboard_latency_ms": round(med, 3),
+            "onboard_iqr_ms": round(float(q3 - q1), 3),
+            "post_warmup_recompiles": len(sentinel.recompiles),
+            "compiles": len(sentinel.compiles),
+            "offboard_checkpoint": bool(
+                ckpt_path and os.path.isdir(ckpt_path)),
+            "quarantined": sorted(mgr.quarantined),
+        }
+        rec["ok"] = bool(
+            rec["post_warmup_recompiles"] == 0
+            and rec["compiles"] >= 1
+            and losses_ok
+            and rec["onboard_latency_ms"] > 0.0
+            and rec["offboard_checkpoint"]
+            and not rec["quarantined"]
+            and rec["tenants"] == len(specs))
+        return rec
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
 def dryrun(telemetry: bool = True,
            metrics_port: Optional[int] = None) -> dict:
     """CI smoke: build and execute the fused protocol program — single
@@ -1534,6 +1638,24 @@ def dryrun(telemetry: bool = True,
                         d_losses.shape == (fleet_n,)
                         and all(math.isfinite(float(v))
                                 for v in d_losses))
+                # the tenant-lifecycle fault domains (train/
+                # lifecycle.py): a heterogeneous two-cohort fleet runs
+                # onboard/offboard cycles + masked windows under its
+                # own armed sentinel — membership churn must compile
+                # NOTHING post-warmup; median onboard latency becomes
+                # the "fleet_lifecycle" bench series the gate watches
+                with events_mod.span("bench.lifecycle"):
+                    lifecycle_rec = lifecycle_dryrun(registry=registry)
+                    publish_bench_series(
+                        registry,
+                        {"fleet_lifecycle": {
+                            "multistep_step_ms":
+                                lifecycle_rec["onboard_latency_ms"],
+                            "spread": {
+                                "median_ms":
+                                    lifecycle_rec["onboard_latency_ms"],
+                                "iqr_ms":
+                                    lifecycle_rec["onboard_iqr_ms"]}}})
                 # the serving plane (serve/): a real engine — dispatch
                 # thread, admission queue, host-side bucket padding —
                 # serving a short load burst under an armed recompile
@@ -1776,6 +1898,19 @@ def dryrun(telemetry: bool = True,
                     and isinstance(fleet_block, dict)
                     and fleet_block.get("tenants") == fleet_n
                     and fleet_block.get("ok") is True)
+                # lifecycle surface: the churn probe passed (zero
+                # post-warmup recompiles through onboard/offboard
+                # cycles, finite survivors, restorable final
+                # checkpoint), its per-tenant lifecycle counters are
+                # live in the scrape (fed by the probe's manager, not
+                # just pre-created), and the "fleet_lifecycle" bench
+                # series survived a real scrape
+                lifecycle_ok = (
+                    lifecycle_rec["ok"]
+                    and "gan4j_fleet_tenant_onboarded_total " in m_body
+                    and "gan4j_fleet_tenant_offboarded_total " in m_body
+                    and 'gan4j_bench_step_ms{series="fleet_lifecycle"}'
+                    in m_body)
                 # serving surface: the short load run completed with
                 # zero errors and ZERO post-warmup recompiles (the
                 # engine pads host-side, so the warmed buckets are the
@@ -1928,6 +2063,7 @@ def dryrun(telemetry: bool = True,
                            and lint["ok"] and sanitizer["ok"]
                            and prove["ok"] and race_ok
                            and bench_stable_ok and fleet_ok
+                           and lifecycle_ok
                            and serve_ok and gateway_ok and mesh_ok
                            and trace_ok and scenario_ok),
                 "platform": device.platform,
@@ -1947,6 +2083,8 @@ def dryrun(telemetry: bool = True,
                 "race": race,
                 "fleet_ok": bool(fleet_ok),
                 "fleet": fleet_rec,
+                "lifecycle_ok": bool(lifecycle_ok),
+                "lifecycle": lifecycle_rec,
                 "serve_ok": bool(serve_ok),
                 "serve": serve_rec,
                 "gateway_ok": bool(gateway_ok),

@@ -35,6 +35,7 @@ SERIES: Tuple[Tuple[str, Tuple[str, ...]], ...] = (
     ("celeba", ("celeba",)),
     ("celeba_fast", ("celeba_fast",)),
     ("fleet", ("fleet",)),
+    ("fleet_lifecycle", ("fleet_lifecycle",)),
     ("serve", ("serve",)),
     ("gateway", ("gateway",)),
     ("mesh", ("mesh",)),

@@ -42,6 +42,13 @@ class InsuranceConfig:
     num_features: int = 12
     z_size: int = 2
     hidden: int = 100
+    # generator hidden-dense depth (the reference uses 3).  Together
+    # with ``hidden`` this is the heterogeneous-fleet cohort key
+    # (train/lifecycle.py): tenants share a vmap cohort iff their
+    # (hidden, gen_layers) agree.  Non-default depths need the dynamic
+    # name map ``gan_to_gen_map(cfg)`` instead of the literal
+    # ``GAN_TO_GEN``.
+    gen_layers: int = 3
     dis_learning_rate: float = 0.0002
     gen_learning_rate: float = 0.0004
     frozen_learning_rate: float = 0.0
@@ -70,18 +77,23 @@ def build_discriminator(cfg: InsuranceConfig = InsuranceConfig()):
 
 
 def _add_generator_layers(b, cfg, lr, prefix, input_name) -> str:
+    if cfg.gen_layers < 1:
+        raise ValueError(f"gen_layers must be >= 1, got {cfg.gen_layers}")
     b.add_layer(f"{prefix}_batch_1", BatchNorm(updater=lr), input_name)
-    b.add_layer(f"{prefix}_dense_layer_2", Dense(n_out=cfg.hidden, updater=lr),
-                f"{prefix}_batch_1")
-    b.add_layer(f"{prefix}_dense_layer_3", Dense(n_out=cfg.hidden, updater=lr),
-                f"{prefix}_dense_layer_2")
-    b.add_layer(f"{prefix}_dense_layer_4", Dense(n_out=cfg.hidden, updater=lr),
-                f"{prefix}_dense_layer_3")
-    b.add_layer(f"{prefix}_dense_layer_5",
+    prev = f"{prefix}_batch_1"
+    # hidden dense stack: layers 2..(gen_layers+1); at the default depth
+    # of 3 the names (dense_layer_2/3/4 + output dense_layer_5) match
+    # the reference graph exactly
+    for i in range(2, cfg.gen_layers + 2):
+        name = f"{prefix}_dense_layer_{i}"
+        b.add_layer(name, Dense(n_out=cfg.hidden, updater=lr), prev)
+        prev = name
+    out = f"{prefix}_dense_layer_{cfg.gen_layers + 2}"
+    b.add_layer(out,
                 Dense(n_out=cfg.num_features, n_in=cfg.hidden,
                       activation="sigmoid", updater=lr),
-                f"{prefix}_dense_layer_4")
-    return f"{prefix}_dense_layer_5"
+                prev)
+    return out
 
 
 def build_generator(cfg: InsuranceConfig = InsuranceConfig()):
@@ -152,13 +164,20 @@ DIS_TO_GAN = [
     ("gan_dis_output_layer_9", "dis_output_layer_4", WB_PARAMS),
 ]
 
-GAN_TO_GEN = [
-    ("gen_batch_1", "gan_batch_1", BN_PARAMS),
-    ("gen_dense_layer_2", "gan_dense_layer_2", WB_PARAMS),
-    ("gen_dense_layer_3", "gan_dense_layer_3", WB_PARAMS),
-    ("gen_dense_layer_4", "gan_dense_layer_4", WB_PARAMS),
-    ("gen_dense_layer_5", "gan_dense_layer_5", WB_PARAMS),
-]
+def gan_to_gen_map(cfg: InsuranceConfig = InsuranceConfig()):
+    """The gan->generator weight-sync name map for ``cfg``'s depth.
+
+    ``GAN_TO_GEN`` is this map at the reference depth (gen_layers=3);
+    heterogeneous-fleet cohorts with other depths must build their map
+    here so every generator dense layer stays synced."""
+    out = [("gen_batch_1", "gan_batch_1", BN_PARAMS)]
+    for i in range(2, cfg.gen_layers + 3):
+        out.append((f"gen_dense_layer_{i}", f"gan_dense_layer_{i}",
+                    WB_PARAMS))
+    return out
+
+
+GAN_TO_GEN = gan_to_gen_map()
 
 DIS_TO_CLASSIFIER = [
     ("dis_batch_layer_1", "dis_batch_layer_1", BN_PARAMS),

@@ -98,29 +98,38 @@ def make_sharded_fleet_step(
     steps_per_call: int = 1,
     ema_decay: float = 0.0,
     carry_dedup: bool = True,
+    masked: bool = False,
 ):
     """The fleet step shard_mapped over the tenant axis: same signature
     and same per-tenant math as ``train/fleet.make_fleet_step`` (each
     shard runs the identical vmapped block on its tenant slice), with
     state and key vectors tenant-sharded and the loop invariants
     replicated.  ``per_tenant_data`` shards the data tables over
-    tenants too; otherwise every device holds the shared table."""
+    tenants too; otherwise every device holds the shared table.
+
+    ``masked``: the lifecycle form — an ``(N,)`` bool ``mask`` after
+    ``rng_keys``, tenant-sharded like the key vectors; masked lanes
+    freeze bit-identically on their own shard (still zero collectives:
+    the mask select is element-wise per lane)."""
     vstep = fleet_lib.make_fleet_step(
         dis, gen, gan, classifier,
         dis_to_gan, gan_to_gen, dis_to_classifier,
         z_size=z_size, num_features=num_features,
         per_tenant_data=per_tenant_data, data_on_device=data_on_device,
         steps_per_call=steps_per_call, ema_decay=ema_decay,
-        carry_dedup=carry_dedup, jit=False)
+        carry_dedup=carry_dedup, masked=masked, jit=False)
     data_spec = P(AXIS) if per_tenant_data else P()
+    # state + per-tenant key vectors (and the lifecycle mask, when
+    # present) sharded over the tenant axis; y_real/y_fake/ones
+    # replicated (shared across tenants by the fleet-step convention)
+    in_specs = (P(AXIS), data_spec, data_spec, P(AXIS), P(AXIS))
+    if masked:
+        in_specs += (P(AXIS),)
+    in_specs += (P(), P(), P())
     sharded = shard_map(
         vstep,
         mesh=mesh,
-        # state + per-tenant key vectors sharded over the tenant axis;
-        # y_real/y_fake/ones replicated (shared across tenants by the
-        # fleet-step convention)
-        in_specs=(P(AXIS), data_spec, data_spec, P(AXIS), P(AXIS),
-                  P(), P(), P()),
+        in_specs=in_specs,
         out_specs=(P(AXIS), P(AXIS)),
         check_vma=False,
     )

@@ -60,6 +60,7 @@ from gan_deeplearning4j_tpu.serve.router import (
     FleetTenantBank,
     NoHealthyReplicaError,
     Router,
+    TenantThrottledError,
 )
 
 __all__ = [
@@ -85,6 +86,7 @@ __all__ = [
     "Router",
     "ServeEngine",
     "ShedError",
+    "TenantThrottledError",
     "TokenBucket",
     "finite_params_probe",
     "measure_saturation",

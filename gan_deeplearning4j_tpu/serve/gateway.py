@@ -66,6 +66,7 @@ from gan_deeplearning4j_tpu.serve.engine import DispatchError
 from gan_deeplearning4j_tpu.serve.router import (
     NoHealthyReplicaError,
     Router,
+    TenantThrottledError,
 )
 from gan_deeplearning4j_tpu.telemetry import events, tracing
 from gan_deeplearning4j_tpu.train.watchdog import WatchdogTimeout
@@ -648,6 +649,12 @@ class Gateway:
                 else e.budget_ms
             return 429, b"", "", (
                 "shed", str(e), max(0.05, wait_ms / 1000.0))
+        except TenantThrottledError as e:
+            # the bank's per-tenant quota: this tenant's fault domain
+            # only — same 429 wire shape as the gateway's own limiter
+            return 429, b"", "", (
+                "tenant_throttled", str(e),
+                max(0.05, e.retry_after_s))
         except KeyError:
             return 404, b"", "", (
                 "unknown_tenant", f"unknown tenant {tenant!r}", None)

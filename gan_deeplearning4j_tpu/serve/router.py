@@ -58,6 +58,20 @@ class NoHealthyReplicaError(RuntimeError):
     hoping a replica comes back."""
 
 
+class TenantThrottledError(RuntimeError):
+    """A tenant's serving quota bucket is empty — the typed 429 for
+    per-tenant isolation: one tenant burning its allowance never
+    degrades its neighbours.  ``retry_after_s`` is when one token is
+    back (the gateway's Retry-After header)."""
+
+    def __init__(self, tenant, retry_after_s: float):
+        self.tenant = tenant
+        self.retry_after_s = float(retry_after_s)
+        super().__init__(
+            f"tenant {tenant!r} is over its serving quota — retry in "
+            f"{self.retry_after_s:.3f}s")
+
+
 class Router:
     """Health-aware round-robin over engine replicas, plus optional
     tenant routing through a ``FleetTenantBank``.
@@ -135,6 +149,13 @@ class Router:
         if tenant is not None:
             if self.tenants is None:
                 raise KeyError(tenant)
+            # charge BEFORE the engine accessor: a throttled request
+            # must not trigger a first-use engine build (compile), and
+            # charge() validates the id, so unknown tenants still get
+            # their KeyError without allocating a quota bucket
+            charge = getattr(self.tenants, "charge", None)
+            if charge is not None:
+                charge(tenant)
             return self.tenants.engine(tenant).submit(*xs, trace=trace)
         if not self.replicas:
             raise NoHealthyReplicaError(
@@ -212,7 +233,20 @@ class FleetTenantBank:
 
     Tenant ids are validated against the fleet size BEFORE slicing:
     jax index-clamping would otherwise silently serve the LAST tenant
-    for any out-of-range id — an unacceptable cross-tenant leak."""
+    for any out-of-range id — an unacceptable cross-tenant leak.
+
+    Lifecycle checkpoints (train/lifecycle.py) record a tenant-id →
+    slot map in their manifest extras; when present the bank keys
+    EVERYTHING on stable tenant ids and resolves the slot per request,
+    so ``/v1/tenants/7/generate`` keeps serving tenant 7's params
+    through onboard/offboard events that shuffle slot indices.
+    Without a stored map, ids keep their PR-12 raw-slot-index meaning.
+
+    ``quota_capacity``/``quota_refill_per_s`` arm a per-tenant serving
+    token bucket (one token per request, charged by the router before
+    the engine accessor): an exhausted tenant gets a typed
+    ``TenantThrottledError`` — its own fault domain, not a shared
+    shed — while its neighbours keep their full allowance."""
 
     def __init__(self, build_graph: Callable, *,
                  checkpointer=None, state=None,
@@ -221,7 +255,9 @@ class FleetTenantBank:
                  max_live: int = 4,
                  supervise: bool = False,
                  watchdog_deadline_s: Optional[float] = None,
-                 admission_factory: Optional[Callable] = None):
+                 admission_factory: Optional[Callable] = None,
+                 quota_capacity: Optional[int] = None,
+                 quota_refill_per_s: Optional[float] = None):
         if (checkpointer is None) == (state is None):
             raise ValueError(
                 "FleetTenantBank needs exactly one of checkpointer= "
@@ -237,9 +273,17 @@ class FleetTenantBank:
         self._supervise = bool(supervise)
         self._wd_deadline_s = watchdog_deadline_s
         self._admission_factory = admission_factory
+        self._quota_capacity = quota_capacity
+        self._quota_refill = (quota_refill_per_s
+                              if quota_refill_per_s is not None
+                              else quota_capacity)
         self._lock = threading.Lock()
         self._live: "OrderedDict[int, ServeEngine]" = OrderedDict()
         self._num_tenants: Optional[int] = None
+        # tenant-id -> slot (from the checkpoint's fleet_tenant_map);
+        # None means raw-slot-index ids (the PR-12 fleets)
+        self._tenant_slots: Optional[List[Optional[int]]] = None
+        self._quota: Dict[int, object] = {}
 
     # -- state -----------------------------------------------------------------
 
@@ -259,11 +303,14 @@ class FleetTenantBank:
         # graphs, so the elastic path just lifts the host arrays
         _, state, extra = self._checkpointer.restore(target_mesh=None)
         n = extra.get("fleet_tenants")
+        tmap = extra.get("fleet_tenant_map")
         with self._lock:
             if self._state is None:
                 self._state = state
                 if n is not None:
                     self._num_tenants = int(n)
+                if isinstance(tmap, dict) and "slots" in tmap:
+                    self._tenant_slots = list(tmap["slots"])
             state = self._state
         return state
 
@@ -277,14 +324,63 @@ class FleetTenantBank:
                 self._num_tenants = int(leaf.shape[0])
             return self._num_tenants
 
+    def _resolve(self, t: int) -> int:
+        """The state slot serving tenant id ``t`` — identity for
+        raw-slot-index fleets, a ``slots.index`` lookup when the
+        checkpoint recorded a lifecycle tenant map.  ``KeyError`` for
+        an id the current state does not serve (offboarded ids fall
+        out of the map: 404, not someone else's params)."""
+        self._ensure_state()
+        with self._lock:
+            slots = self._tenant_slots
+        if slots is not None:
+            try:
+                return slots.index(t)
+            except ValueError:
+                raise KeyError(t) from None
+        if not 0 <= t < self.num_tenants():
+            raise KeyError(t)
+        return t
+
+    # -- quotas ----------------------------------------------------------------
+
+    def charge(self, tenant) -> None:
+        """Take one token from ``tenant``'s serving quota bucket.
+
+        A no-op when the bank was built without quotas.  Validates the
+        id FIRST (unknown tenants get their ``KeyError`` without
+        allocating a bucket), then charges under the bank lock (the
+        bucket's ``take`` is caller-serialized arithmetic).  Raises
+        :class:`TenantThrottledError` when the bucket is empty."""
+        if self._quota_capacity is None:
+            return
+        try:
+            t = int(tenant)
+        except (TypeError, ValueError):
+            raise KeyError(tenant) from None
+        self._resolve(t)
+        from gan_deeplearning4j_tpu.serve.gateway import TokenBucket
+
+        with self._lock:
+            bucket = self._quota.get(t)
+            if bucket is None:
+                bucket = TokenBucket(self._quota_capacity,
+                                     self._quota_refill)
+                self._quota[t] = bucket
+            ok, retry_after = bucket.take()
+        if not ok:
+            events.instant("router.tenant_throttled", tenant=t,
+                           retry_after_s=round(retry_after, 3))
+            raise TenantThrottledError(t, retry_after)
+
     # -- engines ---------------------------------------------------------------
 
-    def _build_engine(self, tenant: int) -> ServeEngine:
+    def _build_engine(self, tenant: int, slot: int) -> ServeEngine:
         from gan_deeplearning4j_tpu.train.fleet import slice_tenant
 
         state = self._ensure_state()
         graph = self._build_graph()
-        graph.params = slice_tenant(state, tenant).gen_params
+        graph.params = slice_tenant(state, slot).gen_params
         infer = ParallelInference(graph, mesh=self._mesh,
                                   buckets=self._buckets)
         admission = (self._admission_factory()
@@ -304,8 +400,10 @@ class FleetTenantBank:
 
     def engine(self, tenant) -> ServeEngine:
         """The live engine for ``tenant`` (built, warmed and started on
-        first use; LRU thereafter).  Raises ``KeyError`` for an id that
-        is not an integer in ``[0, num_tenants)``."""
+        first use; LRU thereafter).  Raises ``KeyError`` for an id the
+        current fleet state does not serve — an integer outside
+        ``[0, num_tenants)`` for raw-slot fleets, an id missing from
+        the recorded tenant map for lifecycle fleets."""
         try:
             t = int(tenant)
         except (TypeError, ValueError):
@@ -315,11 +413,10 @@ class FleetTenantBank:
             if eng is not None:
                 self._live.move_to_end(t)
                 return eng
-        if not 0 <= t < self.num_tenants():
-            raise KeyError(tenant)
+        slot = self._resolve(t)  # KeyError for an unknown id
         # build OUTSIDE the lock (compile + thread start are slow);
         # a racing builder for the same tenant loses and is stopped
-        built = self._build_engine(t)
+        built = self._build_engine(t, slot)
         evicted: List[ServeEngine] = []
         with self._lock:
             eng = self._live.get(t)
@@ -388,11 +485,28 @@ class FleetTenantBank:
             leaf = jax.tree_util.tree_leaves(state.gen_params)[0]
             n = int(leaf.shape[0])
         n = int(n)
+        tmap = extra.get("fleet_tenant_map")
+        slots = (list(tmap["slots"])
+                 if isinstance(tmap, dict) and "slots" in tmap
+                 else None)
+
+        def _slot_of(t: int) -> Optional[int]:
+            if slots is not None:
+                try:
+                    return slots.index(t)
+                except ValueError:
+                    return None
+            return t if 0 <= t < n else None
+
         evicted: List[ServeEngine] = []
         with self._lock:
             self._state = state
             self._num_tenants = n
-            for t in [t for t in self._live if t >= n]:
+            self._tenant_slots = slots
+            # a tenant the NEW state no longer serves (offboarded, or
+            # beyond the new raw fleet size) is evicted, never remapped
+            # onto someone else's slot
+            for t in [t for t in self._live if _slot_of(t) is None]:
                 evicted.append(self._live.pop(t))
             live = list(self._live.items())
         for victim in evicted:
@@ -400,7 +514,7 @@ class FleetTenantBank:
         # push the new slices OUTSIDE the lock (device transfers):
         # each engine's own swap lock serializes against its dispatch
         for t, eng in live:
-            eng.hotswap_params(slice_tenant(state, t).gen_params)
+            eng.hotswap_params(slice_tenant(state, _slot_of(t)).gen_params)
         events.instant("router.fleet_hotswap", step=got, tenants=n,
                        live=len(live), evicted=len(evicted))
         return got

@@ -161,6 +161,14 @@ class MetricsRegistry:
             # poisoned checkpoint ever shows up
             ("gan4j_publish_rejected_total", ()): 0.0,
             ("gan4j_publish_promoted_total", ()): 0.0,
+            # tenant lifecycle (train/lifecycle.py): quarantine is the
+            # per-tenant fault-domain event an alert rule exists for —
+            # all four lifecycle counters exist at 0 from the first
+            # scrape, before the first onboard ever happens
+            ("gan4j_fleet_tenant_quarantined_total", ()): 0.0,
+            ("gan4j_fleet_tenant_onboarded_total", ()): 0.0,
+            ("gan4j_fleet_tenant_offboarded_total", ()): 0.0,
+            ("gan4j_fleet_tenant_throttled_total", ()): 0.0,
         }
         self._gauges: Dict[Tuple[str, tuple], float] = {
             # age since the last data-plane incident; 0 until one
@@ -178,6 +186,13 @@ class MetricsRegistry:
             ("gan4j_fleet_tenants", ()): 0.0,
             ("gan4j_fleet_steps_per_sec", ()): 0.0,
             ("gan4j_fleet_dispatch_ms", ()): 0.0,
+            # tenant-lifecycle gauges (train/lifecycle.py): cohort
+            # count, live quarantine count (per-tenant named series
+            # appear labeled, e.g. ...{tenant="3"}), and the onboard
+            # latency headline that lands next to tenants·steps/sec
+            ("gan4j_fleet_cohorts", ()): 0.0,
+            ("gan4j_fleet_tenant_quarantined", ()): 0.0,
+            ("gan4j_fleet_onboard_latency_ms", ()): 0.0,
             # serving-plane gauges (serve/engine.py): 0 = "no engine
             # running"; the feed (observe_serve) raises them
             ("gan4j_serve_queue_depth", ()): 0.0,
@@ -419,7 +434,15 @@ class MetricsRegistry:
         ``gan4j_fleet_*`` series and ``/healthz`` carries it as the
         ``"fleet"`` block — the bench-of-record headline
         (tenants·steps/sec) is ``tenants * steps_per_sec`` of exactly
-        these two gauges."""
+        these two gauges.
+
+        A lifecycle fleet (``FleetManager.report``) additionally
+        carries a ``"tenants_detail"`` sub-dict; scrapes mirror it
+        into the ``gan4j_fleet_tenant_*`` / ``gan4j_fleet_cohorts`` /
+        ``gan4j_fleet_onboard_latency_ms`` series — each quarantined
+        tenant is NAMED via a labeled gauge
+        (``gan4j_fleet_tenant_quarantined{tenant="3"} 1``) — and
+        ``/healthz`` carries it as ``fleet.tenants_detail``."""
         with self._lock:
             self._fleet_fn = report_fn
 
@@ -434,6 +457,21 @@ class MetricsRegistry:
                 v = rep.get(key)
                 if isinstance(v, (int, float)):
                     reg.set(series, float(v))
+            det = rep.get("tenants_detail")
+            if not isinstance(det, dict):
+                return
+            for key, series in (("cohorts", "gan4j_fleet_cohorts"),
+                                ("onboard_latency_ms",
+                                 "gan4j_fleet_onboard_latency_ms")):
+                v = det.get(key)
+                if isinstance(v, (int, float)):
+                    reg.set(series, float(v))
+            quarantined = det.get("quarantined") or []
+            reg.set("gan4j_fleet_tenant_quarantined",
+                    float(len(quarantined)))
+            for t in quarantined:
+                reg.set("gan4j_fleet_tenant_quarantined", 1.0,
+                        labels={"tenant": str(t)})
 
         self.add_callback(cb)
 
@@ -708,6 +746,28 @@ class MetricsRegistry:
                              rep.get("steps_per_sec", 0.0)),
                          "dispatch_ms": float(rep.get("dispatch_ms", 0.0)),
                          "ok": bool(rep.get("ok", True))}
+                det = rep.get("tenants_detail")
+                if isinstance(det, dict):
+                    # the tenant-lifecycle surface: quarantined tenants
+                    # NAMED, onboard/offboard counts, cohort layout
+                    fleet["tenants_detail"] = {
+                        "active": int(det.get("active", 0)),
+                        "cohorts": int(det.get("cohorts", 0)),
+                        "quarantined": [int(t) for t in
+                                        det.get("quarantined") or []],
+                        "quarantine_reasons": {
+                            str(k): str(v) for k, v in
+                            (det.get("quarantine_reasons")
+                             or {}).items()},
+                        "onboarded_total": int(
+                            det.get("onboarded_total", 0)),
+                        "offboarded_total": int(
+                            det.get("offboarded_total", 0)),
+                        "throttled_total": int(
+                            det.get("throttled_total", 0)),
+                        "onboard_latency_ms": float(
+                            det.get("onboard_latency_ms", 0.0)),
+                    }
             except Exception:  # gan4j-lint: disable=swallowed-exception — a broken feed must not take down the probe
                 pass
         # the serving block: live feed when an engine is running, else

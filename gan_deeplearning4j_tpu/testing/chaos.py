@@ -949,6 +949,59 @@ def poison_fleet_checkpoint_dir(directory: str, tenant: int = 0) -> int:
     return new_step
 
 
+def poison_tenant_params(manager, tenant: int) -> None:
+    """Queue a NaN param-poison of ONE tenant's lane for the next
+    window boundary (``FleetManager.request`` → ``poison_params``):
+    the lifecycle-chaos injection the per-tenant health sentinel must
+    catch by quarantining exactly that tenant — its cohort-mates' loss
+    timelines stay bit-equal to an undisturbed control (the lane-
+    independence pin).  Boundary-queued because fleet membership and
+    state surgery only happen between windows — a mid-dispatch poison
+    would race the donated step's buffers."""
+    manager.request(lambda: manager.poison_params(int(tenant)))
+
+
+class TenantFeedPoisoner:
+    """Flag-guarded per-tenant feed corruption for lifecycle fleets.
+
+    Wraps a fleet feed callback ``feed(window) -> (features, labels)``;
+    once :meth:`arm`\\ ed (typically from a :class:`ChaosSchedule`
+    thread), every row of ``tenant``'s segment (``row % num_segments
+    == tenant`` — the ``TenantRouter`` ownership rule) comes back NaN.
+    The router's per-tenant quarantine budget then trips THAT tenant
+    (``raise_on_budget=False`` → a ``tripped`` marker, never an
+    exception through the fleet loop) while every other segment's rows
+    pass through untouched — byte-identical to the unwrapped feed, so
+    survivors keep their bit-equal-to-control timelines."""
+
+    def __init__(self, feed, tenant: int, num_segments: int):
+        self._feed = feed
+        self.tenant = int(tenant)
+        self.num_segments = int(num_segments)
+        self._armed = threading.Event()
+        self.windows_poisoned = 0
+
+    def arm(self) -> None:
+        self._armed.set()
+
+    def disarm(self) -> None:
+        self._armed.clear()
+
+    @property
+    def armed(self) -> bool:
+        return self._armed.is_set()
+
+    def __call__(self, window: int):
+        feats, labs = self._feed(window)
+        if not self._armed.is_set():
+            return feats, labs
+        feats = np.array(np.asarray(feats), np.float32, copy=True)
+        rows = np.arange(feats.shape[0])
+        feats[rows % self.num_segments == self.tenant] = np.nan
+        self.windows_poisoned += 1
+        return feats, labs
+
+
 class ChaosSchedule:
     """A seeded CROSS-PLANE chaos timeline: one coordinator firing
     injections against the training plane (preemption signal, world

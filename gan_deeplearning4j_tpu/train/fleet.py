@@ -35,6 +35,7 @@ supervision shell from ``train/shell.py``.
 from __future__ import annotations
 
 import dataclasses
+import json
 import os
 import time
 from typing import Callable, Dict, Optional, Sequence
@@ -164,6 +165,7 @@ def make_fleet_step(
     steps_per_call: int = 1,
     ema_decay: float = 0.0,
     carry_dedup: bool = True,
+    masked: bool = False,
     jit: bool = True,
 ):
     """Build the fleet step:
@@ -175,6 +177,15 @@ def make_fleet_step(
     tables (the TenantRouter's output) mapped over axis 0; off = one
     shared batch/table broadcast to every tenant (the bench's resident
     mode — segment routing is a data concern, not a program one).
+
+    ``masked``: the lifecycle form — the signature gains an ``(N,)``
+    bool ``mask`` after ``rng_keys``; a masked-off lane's state leaves
+    come back bit-identical (``it`` included, so a frozen tenant's PRNG
+    schedule does not advance) while active lanes step exactly as the
+    unmasked program.  Mask flips are runtime array values, never shape
+    or program changes — the mechanism behind ghost slots, quarantine
+    freezes and zero-recompile onboarding (train/lifecycle.py).  Losses
+    are still reported for every lane; callers mask them host-side.
 
     The inner program is the UNMODIFIED fused step built by
     ``make_protocol_step(mesh=None)`` — vmap supplies the tenant axis,
@@ -194,10 +205,23 @@ def make_fleet_step(
         steps_per_call=steps_per_call, ema_decay=ema_decay,
         carry_dedup=carry_dedup)
     data_ax = 0 if per_tenant_data else None
-    vstep = jax.vmap(
-        single,
-        in_axes=(0, data_ax, data_ax, 0, 0, None, None, None),
-        out_axes=(0, 0))
+    if masked:
+        def lane(st, real, labels, zk, rk, m, y_real, y_fake, ones):
+            new_st, losses = single(st, real, labels, zk, rk,
+                                    y_real, y_fake, ones)
+            kept = jax.tree.map(
+                lambda new, old: jnp.where(m, new, old), new_st, st)
+            return kept, losses
+
+        vstep = jax.vmap(
+            lane,
+            in_axes=(0, data_ax, data_ax, 0, 0, 0, None, None, None),
+            out_axes=(0, 0))
+    else:
+        vstep = jax.vmap(
+            single,
+            in_axes=(0, data_ax, data_ax, 0, 0, None, None, None),
+            out_axes=(0, 0))
     if not jit:
         return vstep
     if steps_per_call > 1 and donate:
@@ -213,37 +237,117 @@ def make_fleet_step(
 # ---------------------------------------------------------------------------
 # per-tenant data routing
 
-class TenantRouter:
-    """Route a row stream to tenants with PER-TENANT quarantine budgets.
+@dataclasses.dataclass
+class RouteInfo:
+    """What :meth:`TenantRouter.route_tables` did beyond the tables:
+    per-tenant fault-domain outcomes the lifecycle layer acts on
+    (starved/tripped tenants are frozen for the window, never allowed
+    to stall or truncate cohort-mates)."""
 
-    Row ``r`` belongs to segment/tenant ``r % num_tenants`` (the
-    production analog keys on a segment column; the modulo is the
-    deterministic stand-in the bench and tests share).  Each tenant
-    owns its own ``data/resilient.RecordQuarantine``
+    starved: list    # live tenants with < rows_per_tenant clean rows
+    tripped: list    # tenants whose quarantine budget blew this call
+    throttled: Dict[int, int]  # tenant -> rows dropped by its quota
+    unrouted: int    # rows whose segment has no live tenant
+
+
+class TenantRouter:
+    """Route a row stream to tenants with PER-TENANT quarantine budgets,
+    stable segment identity, and optional token-bucket ingest quotas.
+
+    Row ``r`` belongs to segment ``r % num_segments`` (the production
+    analog keys on a segment column; the modulo is the deterministic
+    stand-in the bench and tests share).  ``num_segments`` is the FIXED
+    segment universe — it never changes when tenants onboard or
+    offboard, so a surviving tenant's routed rows are identical before
+    and after any lifecycle event; rows for segments with no live
+    tenant are counted in ``unrouted`` and dropped.  (The legacy
+    constructor form ``TenantRouter(path, N, budget)`` keeps the old
+    behavior exactly: universe == live set == ``range(N)``.)
+
+    Each tenant owns its own ``data/resilient.RecordQuarantine``
     (``quarantine_tenant{i}.jsonl``, budget ``budget`` EACH): one
     segment's poisoned feed burns only that segment's budget and
     raises only that tenant's ``DataQuarantineError`` — a fleet must
     not lose 4095 healthy tenants to one bad one.  All charges also
     feed the shared :class:`~gan_deeplearning4j_tpu.data.resilient.DataHealth`
-    (the ``gan4j_data_*`` scrape series aggregate fleet-wide).
+    (the ``gan4j_data_*`` scrape series aggregate fleet-wide).  With
+    ``raise_on_budget=False`` (the lifecycle layer's mode) a blown
+    budget marks the tenant *tripped* in the returned
+    :class:`RouteInfo` instead of raising — the caller quarantines
+    that one tenant and the rest of the fleet keeps training.
+
+    ``quota_rows``/``quota_refill_per_s`` arm a per-tenant
+    :class:`~gan_deeplearning4j_tpu.serve.gateway.TokenBucket` over
+    ingested ROWS: a hot tenant whose feed exceeds its allowance has
+    the excess rows dropped (counted per tenant in
+    ``RouteInfo.throttled``) instead of inflating its share of routing
+    work — one tenant's traffic cannot starve cohort-mates.
 
     :meth:`route` validates rows (finite features/labels), quarantines
-    offenders, and returns rectangular per-tenant tables
-    ``(N, rows_per_tenant, ...)`` — the fleet step's
-    ``per_tenant_data`` form — truncated to the minimum surviving
-    per-tenant row count so every tenant sees the same step schedule."""
+    offenders, and returns rectangular per-tenant tables truncated to
+    the minimum surviving per-tenant row count (the PR-12 contract);
+    :meth:`route_tables` is the lifecycle form — fixed
+    ``rows_per_tenant`` tables where a short tenant is reported
+    starved (and masked for the window) rather than truncating
+    everyone else."""
 
-    def __init__(self, res_path: str, num_tenants: int, budget: int,
-                 health: Optional[resilient.DataHealth] = None):
-        if num_tenants < 1:
-            raise ValueError(f"num_tenants must be >= 1, got {num_tenants}")
+    def __init__(self, res_path: str, num_tenants: Optional[int] = None,
+                 budget: int = 100,
+                 health: Optional[resilient.DataHealth] = None, *,
+                 tenants: Optional[Sequence[int]] = None,
+                 num_segments: Optional[int] = None,
+                 quota_rows: Optional[float] = None,
+                 quota_refill_per_s: Optional[float] = None,
+                 raise_on_budget: bool = True):
+        if tenants is None:
+            if num_tenants is None or num_tenants < 1:
+                raise ValueError(
+                    f"num_tenants must be >= 1, got {num_tenants}")
+            tenants = list(range(num_tenants))
+        else:
+            tenants = [int(t) for t in tenants]
+            if len(set(tenants)) != len(tenants):
+                raise ValueError(f"duplicate tenant ids: {tenants}")
+        if num_segments is None:
+            num_segments = (max(tenants) + 1) if tenants else 1
+        self.num_segments = int(num_segments)
+        for t in tenants:
+            self._check_segment(t)
         self.res_path = res_path
-        self.num_tenants = num_tenants
+        self.tenants = tenants  # live tenant ids, stacking order
         self.budget = budget
         self.health = health
+        self.raise_on_budget = raise_on_budget
+        self.quota_rows = quota_rows
+        self.quota_refill_per_s = quota_refill_per_s
+        self.unrouted = 0
         # lazily created — a 4096-tenant fleet with clean data should
         # not stat 4096 quarantine files up front
         self._quarantines: Dict[int, resilient.RecordQuarantine] = {}
+        self._buckets: Dict[int, object] = {}
+
+    @property
+    def num_tenants(self) -> int:
+        return len(self.tenants)
+
+    def _check_segment(self, tenant: int) -> None:
+        if not 0 <= tenant < self.num_segments:
+            raise ValueError(
+                f"tenant id {tenant} outside the segment universe "
+                f"[0, {self.num_segments})")
+
+    def add_tenant(self, tenant: int) -> None:
+        """Onboard: ``tenant``'s segment starts routing to it.  Every
+        other tenant's row stream is untouched (stable ids)."""
+        tenant = int(tenant)
+        self._check_segment(tenant)
+        if tenant in self.tenants:
+            raise ValueError(f"tenant {tenant} is already live")
+        self.tenants.append(tenant)
+
+    def remove_tenant(self, tenant: int) -> None:
+        """Offboard: the segment's rows become unrouted from now on."""
+        self.tenants.remove(int(tenant))
 
     def quarantine_for(self, tenant: int) -> resilient.RecordQuarantine:
         q = self._quarantines.get(tenant)
@@ -258,9 +362,19 @@ class TenantRouter:
     def quarantined_total(self) -> int:
         return sum(q.count for q in self._quarantines.values())
 
-    def route(self, features, labels, source: str = "<memory>"):
-        """``(rows, F), (rows, L)`` -> ``(N, m, F), (N, m, L)`` stacked
-        per-tenant tables (f32), bad rows quarantined per tenant."""
+    def _bucket_for(self, tenant: int):
+        b = self._buckets.get(tenant)
+        if b is None:
+            from gan_deeplearning4j_tpu.serve.gateway import TokenBucket
+
+            b = TokenBucket(self.quota_rows,
+                            self.quota_refill_per_s or self.quota_rows)
+            self._buckets[tenant] = b
+        return b
+
+    def _gather(self, features, labels, source: str):
+        """Validate + segment-route the row stream; returns per-tenant
+        row lists plus the call's fault-domain bookkeeping."""
         feats = np.asarray(features, np.float32)
         labs = np.asarray(labels, np.float32)
         if labs.ndim == 1:
@@ -269,35 +383,100 @@ class TenantRouter:
             raise ValueError(
                 f"features/labels row counts differ: {feats.shape[0]} "
                 f"vs {labs.shape[0]}")
-        per_feat: Dict[int, list] = {t: [] for t in range(self.num_tenants)}
-        per_lab: Dict[int, list] = {t: [] for t in range(self.num_tenants)}
+        per_feat: Dict[int, list] = {t: [] for t in self.tenants}
+        per_lab: Dict[int, list] = {t: [] for t in self.tenants}
+        tripped: set = set()
+        throttled: Dict[int, int] = {}
+        live = set(self.tenants)
         bad = ~(np.isfinite(feats).all(axis=1)
                 & np.isfinite(labs).all(axis=1))
         for r in range(feats.shape[0]):
-            t = r % self.num_tenants
-            if bad[r]:
-                # raises this tenant's DataQuarantineError past budget
-                self.quarantine_for(t).charge(
-                    source, row=r, reason="non-finite row",
-                    raw=f"tenant={t}")
+            t = r % self.num_segments
+            if t not in live:
+                self.unrouted += 1
                 continue
+            if bad[r]:
+                if t in tripped:
+                    continue
+                try:
+                    # raises this tenant's DataQuarantineError past
+                    # budget; lifecycle mode converts that to a trip
+                    self.quarantine_for(t).charge(
+                        source, row=r, reason="non-finite row",
+                        raw=f"tenant={t}")
+                except resilient.DataQuarantineError:
+                    if self.raise_on_budget:
+                        raise
+                    tripped.add(t)
+                continue
+            if self.quota_rows is not None:
+                ok, _ = self._bucket_for(t).take()
+                if not ok:
+                    throttled[t] = throttled.get(t, 0) + 1
+                    continue
             per_feat[t].append(feats[r])
             per_lab[t].append(labs[r])
+        return feats, labs, per_feat, per_lab, tripped, throttled
+
+    def route(self, features, labels, source: str = "<memory>"):
+        """``(rows, F), (rows, L)`` -> ``(N, m, F), (N, m, L)`` stacked
+        per-tenant tables (f32), bad rows quarantined per tenant."""
+        _, _, per_feat, per_lab, _, _ = self._gather(
+            features, labels, source)
         m = min(len(v) for v in per_feat.values())
         if m == 0:
             raise ValueError(
                 "tenant routing left at least one tenant with zero "
-                f"rows ({feats.shape[0]} rows over {self.num_tenants} "
-                "tenants)")
+                f"rows ({np.asarray(features).shape[0]} rows over "
+                f"{self.num_tenants} tenants)")
         out_f = np.stack([np.stack(per_feat[t][:m])
-                          for t in range(self.num_tenants)])
+                          for t in self.tenants])
         out_l = np.stack([np.stack(per_lab[t][:m])
-                          for t in range(self.num_tenants)])
+                          for t in self.tenants])
         return jnp.asarray(out_f), jnp.asarray(out_l)
+
+    def route_tables(self, features, labels, rows_per_tenant: int,
+                     source: str = "<memory>"):
+        """The lifecycle form: HOST ``(N, rows_per_tenant, ...)`` f32
+        tables in ``self.tenants`` order plus a :class:`RouteInfo`.
+
+        A tenant short of ``rows_per_tenant`` clean rows is reported
+        ``starved`` (its table rows are zeros — the caller masks the
+        lane for the window) and a tenant whose quarantine budget blew
+        is ``tripped``; neither truncates or stalls cohort-mates, which
+        is what keeps survivors' loss timelines bit-equal to an
+        undisturbed control under feed poison."""
+        feats, labs, per_feat, per_lab, tripped, throttled = \
+            self._gather(features, labels, source)
+        nt = len(self.tenants)
+        out_f = np.zeros((nt, rows_per_tenant, feats.shape[1]),
+                         np.float32)
+        out_l = np.zeros((nt, rows_per_tenant, labs.shape[1]),
+                         np.float32)
+        starved = []
+        for i, t in enumerate(self.tenants):
+            if t in tripped:
+                continue
+            got = per_feat[t]
+            if len(got) < rows_per_tenant:
+                starved.append(t)
+                continue
+            out_f[i] = np.stack(got[:rows_per_tenant])
+            out_l[i] = np.stack(per_lab[t][:rows_per_tenant])
+        info = RouteInfo(starved=starved, tripped=sorted(tripped),
+                         throttled=throttled, unrouted=self.unrouted)
+        return out_f, out_l, info
 
 
 # ---------------------------------------------------------------------------
 # fleet checkpoints: save once, restore any tenant subset
+
+class TenantMappingError(ValueError):
+    """A ``restore(tenants=...)`` was asked to resolve tenant IDS
+    against a checkpoint whose recorded tenant-id -> slot/cohort
+    mapping disagrees (or lacks the id) — refused with both mappings
+    named rather than silently returning wrong-tenant params."""
+
 
 class FleetCheckpointer:
     """Stacked-fleet checkpoints over ``checkpoint/TrainCheckpointer``.
@@ -313,6 +492,7 @@ class FleetCheckpointer:
     full-fleet resume alike, bit-equal to the stacked slices."""
 
     EXTRA_KEY = "fleet"
+    MAP_KEY = "fleet_tenant_map"
 
     def __init__(self, directory: str, keep: int = 3,
                  sweep_debris: bool = True):
@@ -324,37 +504,87 @@ class FleetCheckpointer:
                                         sweep_debris=sweep_debris)
         self.directory = directory
 
-    def save(self, step: int, state: ProtocolState, mesh=None) -> str:
+    def save(self, step: int, state: ProtocolState, mesh=None,
+             tenant_map: Optional[Dict] = None) -> str:
+        """``tenant_map`` (lifecycle fleets): the slot semantics of the
+        stacked arrays, persisted in the MANIFEST extras —
+        ``{"slots": [tenant_id_or_None per slot], "cohorts":
+        {tenant_id: cohort_key}}``.  With a map on record,
+        ``restore(tenants=...)`` resolves tenant IDS through it (and
+        refuses a disagreeing expectation); without one, ``tenants``
+        keep their PR-12 raw-slot-index meaning."""
         from gan_deeplearning4j_tpu.parallel.fleet import fleet_mesh_spec
 
         extra = {self.EXTRA_KEY: state_to_tree(state),
                  "fleet_tenants": fleet_size(state)}
+        if tenant_map is not None:
+            extra[self.MAP_KEY] = json.dumps(tenant_map, sort_keys=True)
         return self._inner.save(
             step, {}, extra=extra,
             mesh_spec=fleet_mesh_spec(mesh).to_dict())
 
-    def restore(self, step: Optional[int] = None, tenants=None, **kw):
+    def restore(self, step: Optional[int] = None, tenants=None,
+                expect_map: Optional[Dict] = None, **kw):
         """Returns ``(step, state, extra)``.
 
         ``tenants``: ``None`` = the full fleet; an ``int`` = ONE
         tenant's state as a plain single-model ``ProtocolState``; a
-        sequence = a subset-fleet in the given order.  ``kw`` passes
-        through to ``TrainCheckpointer.restore`` (``max_step``,
-        ``target_mesh`` — the elastic path: restoring a fleet written
-        on 8 devices onto a 4-device tenant mesh reshards with the
-        usual accounting, values bit-equal post-gather)."""
+        sequence = a subset-fleet in the given order.  When the
+        checkpoint carries a tenant map (lifecycle saves) the values
+        are tenant IDS resolved through the STORED mapping — an id the
+        map does not hold raises :class:`TenantMappingError`; without
+        a map they are raw slot indices (PR-12 checkpoints).
+
+        ``expect_map``: the caller's belief about the tenant-id ->
+        slot/cohort mapping; if the checkpoint's stored map disagrees
+        the restore is refused with a :class:`TenantMappingError`
+        naming both mappings — never wrong-tenant params.
+
+        ``kw`` passes through to ``TrainCheckpointer.restore``
+        (``max_step``, ``target_mesh`` — the elastic path: restoring a
+        fleet written on 8 devices onto a 4-device tenant mesh
+        reshards with the usual accounting, values bit-equal
+        post-gather)."""
         step_out, extra = self._inner.restore({}, step=step, **kw)
         tree = extra.get(self.EXTRA_KEY)
         if tree is None:
             raise ValueError(
                 f"checkpoint at step {step_out} in {self.directory} "
                 "carries no fleet state (not a fleet checkpoint)")
+        stored = extra.get(self.MAP_KEY)
+        if isinstance(stored, str):
+            stored = json.loads(stored)
+            extra[self.MAP_KEY] = stored  # decoded for callers
+        if expect_map is not None:
+            want = json.loads(json.dumps(expect_map, sort_keys=True))
+            if stored != want:
+                raise TenantMappingError(
+                    f"checkpoint at step {step_out} in {self.directory} "
+                    f"records tenant map {stored!r} but the caller "
+                    f"expects {want!r} — refusing to restore "
+                    "wrong-tenant params")
         state = state_from_tree(tree)
         if tenants is None:
             return step_out, state, extra
+
+        def _slot(t) -> int:
+            t = int(t)
+            if stored is None:
+                return t  # legacy checkpoint: raw slot index
+            slots = stored.get("slots", [])
+            try:
+                return slots.index(t)
+            except ValueError:
+                raise TenantMappingError(
+                    f"tenant id {t} is not in the tenant map recorded "
+                    f"by the checkpoint at step {step_out} "
+                    f"(slots={slots!r})") from None
+
         if isinstance(tenants, (int, np.integer)):
-            return step_out, slice_tenant(state, int(tenants)), extra
-        return step_out, subset_state(state, tenants), extra
+            return step_out, slice_tenant(state, _slot(tenants)), extra
+        return (step_out,
+                subset_state(state, [_slot(t) for t in tenants]),
+                extra)
 
     # thin delegates to the inner checkpointer's discovery surface —
     # the publication pipeline (serve/publisher.py) and the fleet

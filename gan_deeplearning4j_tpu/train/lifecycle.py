@@ -1,0 +1,840 @@
+"""Tenant lifecycle layer — heterogeneous elastic fleets, per-tenant
+fault domains.
+
+``train/fleet.py`` (PR 12) runs N tenants of ONE architecture with N
+fixed at build time and a shared blast radius: one poisoned tenant's
+``DataQuarantineError`` or NaN could take the whole dispatch down.
+This module closes ROADMAP item 3 — every tenant becomes its own fault
+domain and membership becomes a runtime value:
+
+  - **Heterogeneous cohorts**: tenants are grouped by architecture
+    (``TenantSpec.hidden`` x ``TenantSpec.gen_layers``) into vmap
+    *cohorts*; each cohort is one donated masked fleet step
+    (``make_fleet_step(masked=True)``), and all cohorts advance inside
+    the one supervised window loop (``LifecycleFleetTrainer`` puts the
+    whole fleet behind the single ``SupervisionShell``).
+  - **Bucketed capacity, zero recompiles**: the serving-bucket
+    discipline applied to the tenant axis.  Each cohort is padded to a
+    bucketed slot count (``DEFAULT_TENANT_BUCKETS``); unoccupied slots
+    are *ghosts* — template params, mask off, zero data — so onboard/
+    offboard/quarantine are mask flips and host-array surgery, never a
+    new program shape.  ``warmup()`` compiles every (cohort, bucket)
+    program once; after that an armed ``RecompileSentinel`` sees
+    nothing (the lifecycle-chaos e2e pins this).
+  - **Isolation**: per-tenant NaN/divergence tripping
+    (``FleetHealthSentinel``) quarantines — freezes + masks — only the
+    sick tenant; the ``TenantRouter``'s per-tenant quarantine budgets
+    run in ``raise_on_budget=False`` mode so a poisoned feed trips one
+    tenant instead of raising through the fleet loop; token-bucket
+    ingest quotas cap a hot tenant's routing share.  Because lanes are
+    element-wise independent (the PR-12 bitwise pin), every surviving
+    tenant's loss timeline stays bit-equal (f32) to an undisturbed
+    control run through arbitrary lifecycle events.
+
+Checkpoints: one ``FleetCheckpointer`` directory per cohort, each save
+carrying the tenant-id -> slot/cohort map (``tenant_map``) so
+``restore(tenants=<id>)`` resolves by IDENTITY, refuses a disagreeing
+mapping (``TenantMappingError``), and stays bit-equal per tenant.
+Offboarding writes a final single-tenant checkpoint the tenant can be
+re-onboarded from.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import threading
+import time
+from collections import deque
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from gan_deeplearning4j_tpu.data import resilient
+from gan_deeplearning4j_tpu.runtime import prng
+from gan_deeplearning4j_tpu.telemetry import events as telemetry_events
+from gan_deeplearning4j_tpu.train import fleet as fleet_lib
+from gan_deeplearning4j_tpu.train import fused_step as fused_lib
+from gan_deeplearning4j_tpu.train.fused_step import ProtocolState
+from gan_deeplearning4j_tpu.utils import device_fence
+
+# Bucketed slot counts for the tenant axis — the serving-bucket
+# discipline (parallel/inference.py) applied to fleet membership: a
+# cohort's capacity is always one of these, so membership changes are
+# mask flips within a warmed program, or a hop to the NEXT warmed
+# bucket.  The gan4j-prove fleet_step contract lists this set as its
+# cohort coverage.
+DEFAULT_TENANT_BUCKETS = (2, 4, 8, 16, 32, 64)
+
+
+def bucket_for(n: int, buckets: Sequence[int]) -> int:
+    """Smallest bucket >= ``n`` (capacity for ``n`` occupied slots)."""
+    for b in sorted(buckets):
+        if b >= n:
+            return int(b)
+    raise ValueError(
+        f"{n} tenants exceed the largest tenant bucket "
+        f"{max(buckets)} — extend LifecycleConfig.buckets")
+
+
+@dataclasses.dataclass(frozen=True)
+class TenantSpec:
+    """One tenant's identity + architecture.  ``tenant_id`` is the
+    STABLE routing identity (``TenantRouter`` segment); the
+    architecture pair is the cohort key — tenants share a vmap cohort
+    iff their (hidden, gen_layers) agree."""
+
+    tenant_id: int
+    hidden: int = 100
+    gen_layers: int = 3
+
+    @property
+    def cohort_key(self) -> str:
+        return f"h{self.hidden}_l{self.gen_layers}"
+
+
+@dataclasses.dataclass
+class LifecycleConfig:
+    """Knobs for a lifecycle-managed heterogeneous fleet."""
+
+    batch_size: int = 4
+    seed: int = prng.NUMBER_OF_THE_BEAST
+    res_path: str = "outputs/lifecycle"
+    buckets: Tuple[int, ...] = DEFAULT_TENANT_BUCKETS
+    # buckets compiled per cohort at warmup; None = every bucket up to
+    # ONE above the cohort's initial occupancy (room to grow once
+    # without a recompile).  The zero-recompile guarantee covers
+    # exactly the warmed set.
+    warm_buckets: Optional[Tuple[int, ...]] = None
+    # fixed segment universe for the router; None = max tenant id + 1
+    # over the INITIAL specs — pass explicitly when later onboards use
+    # higher ids
+    num_segments: Optional[int] = None
+    quarantine_budget: int = 8   # bad rows per tenant before trip
+    quota_rows: Optional[float] = None          # token-bucket capacity
+    quota_refill_per_s: Optional[float] = None  # rows/s refill
+    divergence_factor: float = 1e3
+    divergence_patience: int = 2
+    keep_checkpoints: int = 3
+    checkpoint_every: int = 0    # steps; 0 = only explicit saves
+    record_timelines: bool = False  # keep per-step per-tenant losses
+
+
+class FleetHealthSentinel:
+    """Per-tenant divergence/NaN tripping over window loss vectors.
+
+    A non-finite d/g-loss trips immediately (``"nan"``); a window whose
+    mean loss magnitude exceeds ``factor`` x the tenant's own rolling
+    median for ``patience`` consecutive windows trips as
+    ``"divergence"``.  Scope is ONE tenant — the caller freezes + masks
+    that lane; cohort-mates never see a rollback."""
+
+    def __init__(self, factor: float = 1e3, patience: int = 2,
+                 history: int = 16):
+        self.factor = float(factor)
+        self.patience = int(patience)
+        self._hist: Dict[int, deque] = {}
+        self._strikes: Dict[int, int] = {}
+
+    def observe(self, tenant: int, d_losses, g_losses) -> Optional[str]:
+        """Feed one window of per-step losses; returns a trip reason or
+        None."""
+        d = np.asarray(d_losses, np.float64)
+        g = np.asarray(g_losses, np.float64)
+        if not (np.isfinite(d).all() and np.isfinite(g).all()):
+            return "nan"
+        mag = float(np.abs(d).mean() + np.abs(g).mean())
+        hist = self._hist.setdefault(
+            tenant, deque(maxlen=max(4, self.patience * 8)))
+        if len(hist) >= 3:
+            med = float(np.median(hist))
+            if med > 0.0 and mag > self.factor * med:
+                self._strikes[tenant] = self._strikes.get(tenant, 0) + 1
+                if self._strikes[tenant] >= self.patience:
+                    return "divergence"
+                return None  # a strike is not yet a trip
+        self._strikes[tenant] = 0
+        hist.append(mag)
+        return None
+
+    def forget(self, tenant: int) -> None:
+        self._hist.pop(tenant, None)
+        self._strikes.pop(tenant, None)
+
+
+class _PendingOps:
+    """Thread-safe boundary-op queue: chaos/ops threads enqueue
+    lifecycle mutations; the training loop drains them at step-window
+    boundaries, the only place fleet membership may change."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._ops: List[Callable[[], None]] = []
+
+    def push(self, fn: Callable[[], None]) -> None:
+        with self._lock:
+            self._ops.append(fn)
+
+    def drain(self) -> List[Callable[[], None]]:
+        with self._lock:
+            ops, self._ops = self._ops, []
+        return ops
+
+
+def _np_state(state: ProtocolState) -> ProtocolState:
+    """The stacked state as HOST numpy (fences; bit-preserving)."""
+    return jax.tree.map(np.asarray, state)
+
+
+def _stack_rows(rows: Sequence[ProtocolState]) -> ProtocolState:
+    """Host-side stack of single-tenant rows -> a stacked fleet state
+    (numpy; ``device_put`` to dispatch — no eager device ops, which is
+    what keeps lifecycle surgery off the compile path)."""
+    return jax.tree.map(lambda *xs: np.stack(xs), *rows)
+
+
+def _row(state: ProtocolState, slot: int) -> ProtocolState:
+    """Host slice of one slot (call on a ``_np_state`` result)."""
+    return jax.tree.map(lambda x: np.asarray(x)[slot], state)
+
+
+class Cohort:
+    """One architecture's slice of the fleet: a bucketed slot vector, a
+    masked donated step, and the host-surgery lifecycle verbs."""
+
+    def __init__(self, key: str, hidden: int, gen_layers: int,
+                 config: LifecycleConfig):
+        from gan_deeplearning4j_tpu.models import mlpgan_insurance as M
+
+        self.key = key
+        self.hidden = hidden
+        self.gen_layers = gen_layers
+        self.c = config
+        cfg = M.InsuranceConfig(seed=config.seed, hidden=hidden,
+                                gen_layers=gen_layers)
+        self.model_cfg = cfg
+        dis = M.build_discriminator(cfg)
+        self.graphs = (dis, M.build_generator(cfg), M.build_gan(cfg),
+                       M.build_classifier(dis, cfg))
+        self.maps = (M.DIS_TO_GAN, M.gan_to_gen_map(cfg),
+                     M.DIS_TO_CLASSIFIER)
+        self.step = fleet_lib.make_fleet_step(
+            *self.graphs, *self.maps,
+            z_size=cfg.z_size, num_features=cfg.num_features,
+            per_tenant_data=True, data_on_device=True, masked=True)
+        # ghost rows hold the template init: a fresh onboard is a pure
+        # mask flip (the ghost already IS the init state, it=0)
+        self._template = _np_state(
+            fused_lib.state_from_graphs(*self.graphs))
+        self.slots: List[Optional[int]] = []
+        self.mask = np.zeros((0,), bool)
+        self.state: Optional[ProtocolState] = None
+
+    # -- membership ----------------------------------------------------------
+
+    @property
+    def capacity(self) -> int:
+        return len(self.slots)
+
+    def active_ids(self) -> List[int]:
+        return [t for t, on in zip(self.slots, self.mask)
+                if t is not None and on]
+
+    def occupied_ids(self) -> List[int]:
+        return [t for t in self.slots if t is not None]
+
+    def slot_of(self, tenant: int) -> int:
+        return self.slots.index(tenant)
+
+    def _ensure_capacity(self, need_slots: int) -> None:
+        """Grow to the bucket holding ``need_slots`` occupied slots —
+        host re-pad with template ghost rows (a boundary op; the larger
+        bucket's program comes from the warmed set)."""
+        cap = bucket_for(need_slots, self.c.buckets)
+        if cap <= self.capacity:
+            return
+        grow = cap - self.capacity
+        if self.state is not None:
+            host = _np_state(self.state)
+            rows = [_row(host, s) for s in range(self.capacity)]
+            rows += [self._template] * grow
+            self.state = jax.device_put(_stack_rows(rows))
+        self.slots += [None] * grow
+        self.mask = np.concatenate([self.mask, np.zeros(grow, bool)])
+        telemetry_events.instant("fleet.cohort_grow", cohort=self.key,
+                                 capacity=cap)
+
+    def admit(self, tenant: int,
+              params: Optional[ProtocolState] = None) -> int:
+        """Occupy a slot for ``tenant`` (growing if full) and unmask
+        it.  ``params``: a host single-tenant state (re-onboard from a
+        final checkpoint); None = the template init the ghost already
+        holds."""
+        if tenant in self.slots:
+            raise ValueError(f"tenant {tenant} already holds a slot "
+                             f"in cohort {self.key}")
+        free = [i for i, t in enumerate(self.slots) if t is None]
+        if not free:
+            self._ensure_capacity(len(self.occupied_ids()) + 1)
+            free = [i for i, t in enumerate(self.slots) if t is None]
+        slot = free[0]
+        if params is not None and self.state is not None:
+            host = _np_state(self.state)
+            rows = [_row(host, s) for s in range(self.capacity)]
+            rows[slot] = jax.tree.map(np.asarray, params)
+            self.state = jax.device_put(_stack_rows(rows))
+        elif params is None and self.state is not None:
+            # the vacated slot may hold a previous occupant's rows —
+            # reset to the template so a fresh onboard starts at init
+            host = _np_state(self.state)
+            rows = [_row(host, s) for s in range(self.capacity)]
+            rows[slot] = self._template
+            self.state = jax.device_put(_stack_rows(rows))
+        self.slots[slot] = tenant
+        self.mask[slot] = True
+        return slot
+
+    def vacate(self, tenant: int) -> ProtocolState:
+        """Mask off + free ``tenant``'s slot; returns its final host
+        single-tenant state (the offboard checkpoint payload)."""
+        slot = self.slot_of(tenant)
+        final = _row(_np_state(self.state), slot)
+        self.slots[slot] = None
+        self.mask[slot] = False
+        return final
+
+    def freeze(self, tenant: int) -> None:
+        """Quarantine form: mask off but KEEP the slot (state frozen in
+        place for forensics; the id stays attached to the slot so the
+        checkpoint tenant map still names it)."""
+        self.mask[self.slot_of(tenant)] = False
+
+    def ensure_state(self) -> None:
+        if self.state is None:
+            self.state = jax.device_put(
+                _stack_rows([self._template] * max(1, self.capacity)))
+
+    def tenant_map(self) -> Dict:
+        """The slot semantics persisted with every cohort checkpoint."""
+        return {"slots": self.slots,
+                "cohorts": {str(t): self.key for t in self.slots
+                            if t is not None}}
+
+
+class FleetManager:
+    """The lifecycle-managed heterogeneous fleet: cohorts, bucketed
+    capacity, onboard/offboard/quarantine as boundary operations, and
+    per-tenant health.  Drive it with :meth:`step_window`; mutate
+    membership directly between windows or from another thread via
+    :meth:`request` (applied at the next window boundary)."""
+
+    def __init__(self, specs: Sequence[TenantSpec],
+                 config: LifecycleConfig,
+                 registry=None,
+                 health: Optional[resilient.DataHealth] = None):
+        self.c = config
+        os.makedirs(config.res_path, exist_ok=True)
+        self.specs: Dict[int, TenantSpec] = {}
+        self.health = health if health is not None else \
+            resilient.DataHealth()
+        num_segments = config.num_segments
+        if num_segments is None:
+            num_segments = max((s.tenant_id for s in specs),
+                               default=0) + 1
+        self.router = fleet_lib.TenantRouter(
+            config.res_path, budget=config.quarantine_budget,
+            health=self.health,
+            tenants=[s.tenant_id for s in specs],
+            num_segments=num_segments,
+            quota_rows=config.quota_rows,
+            quota_refill_per_s=config.quota_refill_per_s,
+            raise_on_budget=False)
+        self.cohorts: Dict[str, Cohort] = {}
+        self._keys: Dict[int, Tuple[jax.Array, jax.Array]] = {}
+        self._key_vecs: Dict[str, Tuple[jax.Array, jax.Array]] = {}
+        self._checkpointers: Dict[str, fleet_lib.FleetCheckpointer] = {}
+        for s in specs:
+            self._admit_spec(s)
+        for cohort in self.cohorts.values():
+            cohort.ensure_state()
+        self.sentinel = FleetHealthSentinel(
+            config.divergence_factor, config.divergence_patience)
+        self.registry = registry
+        self.quarantined: Dict[int, str] = {}
+        self.onboarded_total = 0
+        self.offboarded_total = 0
+        self.throttled_total = 0
+        self.step_count = 0
+        self._onboard_ms: deque = deque(maxlen=64)
+        self._pending = _PendingOps()
+        self._warmed = False
+        self._steps_per_sec = 0.0
+        self._dispatch_ms = 0.0
+        self.loss_history: Dict[int, Dict[str, list]] = {}
+        root = prng.root_key(config.seed)
+        self._z_base = prng.stream(root, "fleet-z")
+        self._r_base = prng.stream(root, "fleet-rng")
+        B = config.batch_size
+        self._ones = jnp.ones((B, 1), jnp.float32)
+        self._y_real = self._ones + 0.05 * jax.random.normal(
+            prng.stream(root, "soften-real"), (B, 1), dtype=jnp.float32)
+        self._y_fake = 0.05 * jax.random.normal(
+            prng.stream(root, "soften-fake"), (B, 1), dtype=jnp.float32)
+
+    # -- plumbing ------------------------------------------------------------
+
+    def _admit_spec(self, spec: TenantSpec,
+                    params: Optional[ProtocolState] = None) -> Cohort:
+        cohort = self.cohorts.get(spec.cohort_key)
+        if cohort is None:
+            cohort = Cohort(spec.cohort_key, spec.hidden,
+                            spec.gen_layers, self.c)
+            self.cohorts[spec.cohort_key] = cohort
+        cohort.admit(spec.tenant_id, params=params)
+        self.specs[spec.tenant_id] = spec
+        self._key_vecs.pop(spec.cohort_key, None)
+        return cohort
+
+    def _tenant_keys(self, tenant: int) -> Tuple[jax.Array, jax.Array]:
+        """fold_in(base, tenant_id) — the SAME folding a single-tenant
+        control uses, so lifecycle lanes keep the PR-12 bitwise
+        fleet/control equivalence."""
+        got = self._keys.get(tenant)
+        if got is None:
+            got = (jax.random.fold_in(self._z_base, tenant),
+                   jax.random.fold_in(self._r_base, tenant))
+            self._keys[tenant] = got
+        return got
+
+    def _cohort_key_vecs(self, cohort: Cohort):
+        """(capacity,) z/rng key vectors in slot order; ghosts reuse
+        the base key (their lanes are masked — the value never lands
+        in any surviving state)."""
+        got = self._key_vecs.get(cohort.key)
+        if got is not None and int(got[0].shape[0]) == cohort.capacity:
+            return got
+        zs, rs = [], []
+        for t in cohort.slots:
+            if t is None:
+                zs.append(self._z_base)
+                rs.append(self._r_base)
+            else:
+                zk, rk = self._tenant_keys(t)
+                zs.append(zk)
+                rs.append(rk)
+        got = (jnp.stack(zs), jnp.stack(rs))
+        self._key_vecs[cohort.key] = got
+        return got
+
+    def checkpointer_for(self, cohort_key: str
+                         ) -> fleet_lib.FleetCheckpointer:
+        ck = self._checkpointers.get(cohort_key)
+        if ck is None:
+            ck = fleet_lib.FleetCheckpointer(
+                os.path.join(self.c.res_path, "checkpoints", cohort_key),
+                keep=self.c.keep_checkpoints)
+            self._checkpointers[cohort_key] = ck
+        return ck
+
+    def request(self, fn: Callable[[], None]) -> None:
+        """Enqueue a lifecycle op from any thread; it runs at the next
+        window boundary (membership never changes mid-dispatch)."""
+        self._pending.push(fn)
+
+    def drain_pending(self) -> int:
+        ops = self._pending.drain()
+        for fn in ops:
+            fn()
+        return len(ops)
+
+    # -- warmup --------------------------------------------------------------
+
+    def _warm_caps(self, cohort: Cohort) -> List[int]:
+        if self.c.warm_buckets is not None:
+            return sorted(set(self.c.warm_buckets))
+        caps = sorted(self.c.buckets)
+        upto = [b for b in caps if b <= cohort.capacity]
+        nxt = [b for b in caps if b > cohort.capacity][:1]
+        return upto + nxt
+
+    def warmup(self) -> Dict[str, List[int]]:
+        """Compile every (cohort, bucket) program + the lifecycle
+        helper ops once.  After this, membership churn within the
+        warmed bucket set causes ZERO further compiles — the armed
+        ``RecompileSentinel`` in the lifecycle-chaos e2e is the
+        proof."""
+        B = self.c.batch_size
+        warmed: Dict[str, List[int]] = {}
+        for cohort in self.cohorts.values():
+            cfg = cohort.model_cfg
+            caps = self._warm_caps(cohort)
+            warmed[cohort.key] = caps
+            for cap in caps:
+                scratch = jax.device_put(
+                    _stack_rows([cohort._template] * cap))
+                data = jnp.asarray(
+                    np.full((cap, B, cfg.num_features), 0.5, np.float32))
+                labs = jnp.asarray(np.ones((cap, B, 1), np.float32))
+                zks = jnp.stack([self._z_base] * cap)
+                rks = jnp.stack([self._r_base] * cap)
+                mask = jnp.asarray(np.ones((cap,), bool))
+                out, losses = cohort.step(scratch, data, labs, zks, rks,
+                                          mask, self._y_real,
+                                          self._y_fake, self._ones)
+                device_fence(losses)
+                del out
+        # the checkpoint tree form's empty-dict marker is the one eager
+        # device op on the save path — warm its tiny fill program
+        device_fence(jnp.zeros((), jnp.int32))
+        self._warmed = True
+        telemetry_events.instant(
+            "fleet.warmup",
+            cohorts=len(self.cohorts),
+            programs=sum(len(v) for v in warmed.values()))
+        return warmed
+
+    # -- lifecycle verbs -----------------------------------------------------
+
+    def active_ids(self) -> List[int]:
+        out: List[int] = []
+        for cohort in self.cohorts.values():
+            out.extend(cohort.active_ids())
+        return sorted(out)
+
+    def cohort_of(self, tenant: int) -> Cohort:
+        for cohort in self.cohorts.values():
+            if tenant in cohort.slots:
+                return cohort
+        raise KeyError(f"tenant {tenant} holds no slot in any cohort")
+
+    def onboard(self, spec: TenantSpec,
+                from_checkpoint: Optional[str] = None) -> float:
+        """Onboard ``spec`` at this boundary: fill a ghost slot (or
+        hop the cohort to its next warmed bucket), slice in init or
+        checkpointed params, start routing its segment.  Returns the
+        onboard latency in milliseconds — the bench's
+        ``onboard_latency_ms`` headline."""
+        t0 = time.perf_counter()
+        if spec.tenant_id in self.specs:
+            raise ValueError(f"tenant {spec.tenant_id} is already "
+                             "onboarded")
+        params = None
+        if from_checkpoint is not None:
+            ck = fleet_lib.FleetCheckpointer(from_checkpoint,
+                                             sweep_debris=False)
+            _, params, _ = ck.restore(tenants=spec.tenant_id)
+        cohort = self._admit_spec(spec, params=params)
+        cohort.ensure_state()
+        self._cohort_key_vecs(cohort)  # rebuild eagerly: part of latency
+        self.router.add_tenant(spec.tenant_id)
+        ms = (time.perf_counter() - t0) * 1e3
+        self._onboard_ms.append(ms)
+        self.onboarded_total += 1
+        telemetry_events.instant(
+            "fleet.onboard", tenant=spec.tenant_id, cohort=cohort.key,
+            slot=cohort.slot_of(spec.tenant_id), latency_ms=ms,
+            restored=from_checkpoint is not None)
+        if self.registry is not None:
+            self.registry.inc("gan4j_fleet_tenant_onboarded_total")
+        return ms
+
+    def offboard(self, tenant: int) -> Optional[str]:
+        """Offboard ``tenant``: vacate its slot (ghost again), stop
+        routing its segment, and write its final per-tenant checkpoint
+        (a 1-tenant fleet save with the identity map — re-onboard with
+        ``onboard(spec, from_checkpoint=...)``).  Returns the
+        checkpoint path."""
+        cohort = self.cohort_of(tenant)
+        final = cohort.vacate(tenant)
+        self.router.remove_tenant(tenant)
+        self.specs.pop(tenant, None)
+        self._key_vecs.pop(cohort.key, None)
+        self.sentinel.forget(tenant)
+        path = None
+        ck = fleet_lib.FleetCheckpointer(
+            os.path.join(self.c.res_path, "offboarded",
+                         f"tenant{tenant}"),
+            keep=self.c.keep_checkpoints)
+        state1 = _stack_rows([final])
+        path = ck.save(self.step_count, state1,
+                       tenant_map={"slots": [tenant],
+                                   "cohorts": {str(tenant): cohort.key}})
+        self.offboarded_total += 1
+        telemetry_events.instant("fleet.offboard", tenant=tenant,
+                                 cohort=cohort.key, checkpoint=path)
+        if self.registry is not None:
+            self.registry.inc("gan4j_fleet_tenant_offboarded_total")
+        return path
+
+    def quarantine(self, tenant: int, reason: str) -> None:
+        """Freeze + mask ONE sick tenant; cohort-mates keep stepping
+        (never a fleet rollback).  The slot stays attached to the id
+        (forensics: its frozen state still lands in cohort checkpoints
+        under its own name)."""
+        if tenant in self.quarantined or tenant not in self.specs:
+            return
+        cohort = self.cohort_of(tenant)
+        cohort.freeze(tenant)
+        if tenant in self.router.tenants:
+            self.router.remove_tenant(tenant)
+        self.quarantined[tenant] = reason
+        with open(os.path.join(self.c.res_path,
+                               "quarantine_fleet.jsonl"), "a") as f:
+            f.write(json.dumps({"tenant": tenant, "reason": reason,
+                                "step": self.step_count}) + "\n")
+        telemetry_events.instant("fleet.quarantine", tenant=tenant,
+                                 cohort=cohort.key, reason=reason,
+                                 step=self.step_count)
+        if self.registry is not None:
+            self.registry.inc("gan4j_fleet_tenant_quarantined_total")
+
+    def poison_params(self, tenant: int) -> None:
+        """Chaos seam (testing/chaos.py): overwrite ``tenant``'s
+        generator/discriminator params with NaN in place — the
+        param-poison fault the per-tenant health sentinel must catch
+        WITHOUT disturbing cohort-mates."""
+        cohort = self.cohort_of(tenant)
+        slot = cohort.slot_of(tenant)
+        host = _np_state(cohort.state)
+
+        def _poison(x):
+            x = np.array(x)
+            x[slot] = np.nan
+            return x
+
+        fields = {f: getattr(host, f)
+                  for f in ("dis_params", "dis_opt", "gan_params",
+                            "gan_opt", "clf_params", "clf_opt",
+                            "gen_params")}
+        for f in ("dis_params", "gen_params"):
+            fields[f] = jax.tree.map(_poison, fields[f])
+        cohort.state = jax.device_put(ProtocolState(
+            *(fields[f] for f in ("dis_params", "dis_opt",
+                                  "gan_params", "gan_opt",
+                                  "clf_params", "clf_opt",
+                                  "gen_params")),
+            host.it, host.ema_gen))
+        telemetry_events.instant("chaos.poison_params", tenant=tenant,
+                                 cohort=cohort.key)
+
+    def checkpoint_fleet(self) -> Dict[str, str]:
+        """One verified save per cohort, each carrying its tenant map
+        — restore any tenant BY ID, bit-equal, mapping enforced."""
+        out = {}
+        for key, cohort in self.cohorts.items():
+            if cohort.state is None:
+                continue
+            ck = self.checkpointer_for(key)
+            out[key] = ck.save(self.step_count, cohort.state,
+                               tenant_map=cohort.tenant_map())
+        return out
+
+    # -- the window loop -----------------------------------------------------
+
+    def step_window(self, features, labels, steps: int) -> Dict:
+        """Drain boundary ops, route one window of data, advance every
+        cohort ``steps`` fused dispatches, then run per-tenant health.
+        Returns the window report (losses are per ACTIVE tenant; ghost
+        and quarantined lanes are masked out)."""
+        self.drain_pending()
+        c = self.c
+        B = c.batch_size
+        # per-window source tag: quarantine charges are idempotent per
+        # (source, row) — each window is a NEW stream, so the same row
+        # index going bad in consecutive windows must burn budget each
+        # time (a re-read of one window's rows still charges once)
+        f_all, l_all, info = self.router.route_tables(
+            features, labels, B,
+            source=f"<window@{self.step_count}>")
+        # table row order is the router's tenant list AS ROUTED —
+        # capture it BEFORE quarantining tripped tenants (quarantine
+        # removes them from the router, which would shift every later
+        # tenant onto a neighbour's rows)
+        order = {t: i for i, t in enumerate(self.router.tenants)}
+        for t in info.tripped:
+            self.quarantine(t, "data-quarantine-budget")
+        self.throttled_total += sum(info.throttled.values())
+        if self.registry is not None and info.throttled:
+            self.registry.inc("gan4j_fleet_tenant_throttled_total",
+                              sum(info.throttled.values()))
+        starved = set(info.starved) - set(self.quarantined)
+        t0 = time.perf_counter()
+        window_losses: Dict[str, list] = {}
+        for key, cohort in self.cohorts.items():
+            cohort.ensure_state()
+            cap = cohort.capacity
+            data = np.zeros((cap, B, f_all.shape[2]), np.float32)
+            labs = np.zeros((cap, B, l_all.shape[2]), np.float32)
+            mask = cohort.mask.copy()
+            for slot, t in enumerate(cohort.slots):
+                if t is None or not cohort.mask[slot]:
+                    continue
+                if t in starved or t not in order:
+                    mask[slot] = False  # frozen for THIS window only
+                    continue
+                data[slot] = f_all[order[t]]
+                labs[slot] = l_all[order[t]]
+            zks, rks = self._cohort_key_vecs(cohort)
+            d_dev = jnp.asarray(data)
+            l_dev = jnp.asarray(labs)
+            m_dev = jnp.asarray(mask)
+            per_step = []
+            state = cohort.state
+            for _ in range(steps):
+                state, losses = cohort.step(
+                    state, d_dev, l_dev, zks, rks, m_dev,
+                    self._y_real, self._y_fake, self._ones)
+                per_step.append(losses)
+            cohort.state = state
+            window_losses[key] = per_step
+        # ONE deliberate readback per window (the fleet-loop cadence
+        # discipline), then host-side health over the loss vectors
+        for key, per_step in window_losses.items():
+            device_fence(per_step)
+        dt = time.perf_counter() - t0
+        self.step_count += steps
+        if dt > 0:
+            self._steps_per_sec = steps / dt
+            self._dispatch_ms = (dt / steps) * 1e3
+        report: Dict[int, Dict[str, np.ndarray]] = {}
+        trips: List[Tuple[int, str]] = []
+        for key, cohort in self.cohorts.items():
+            per_step = [jax.tree.map(np.asarray, x)
+                        for x in window_losses[key]]
+            for slot, t in enumerate(cohort.slots):
+                if t is None or not cohort.mask[slot]:
+                    continue
+                if t in starved:
+                    continue
+                d = np.array([s[0][slot] for s in per_step])
+                g = np.array([s[1][slot] for s in per_step])
+                cl = np.array([s[2][slot] for s in per_step])
+                report[t] = {"d": d, "g": g, "clf": cl}
+                if c.record_timelines:
+                    hist = self.loss_history.setdefault(
+                        t, {"d": [], "g": [], "clf": []})
+                    hist["d"].extend(d.tolist())
+                    hist["g"].extend(g.tolist())
+                    hist["clf"].extend(cl.tolist())
+                reason = self.sentinel.observe(t, d, g)
+                if reason is not None:
+                    trips.append((t, reason))
+        for t, reason in trips:
+            self.quarantine(t, reason)
+        if self.registry is not None:
+            self.registry.inc("gan4j_steps_total", steps)
+            self.registry.set("gan4j_step", self.step_count)
+        if (c.checkpoint_every
+                and self.step_count % c.checkpoint_every == 0):
+            self.checkpoint_fleet()
+        return {"step": self.step_count, "losses": report,
+                "starved": sorted(starved),
+                "quarantined_now": [t for t, _ in trips],
+                "info": info}
+
+    # -- observability -------------------------------------------------------
+
+    @property
+    def onboard_latency_ms(self) -> float:
+        if not self._onboard_ms:
+            return 0.0
+        return float(np.median(self._onboard_ms))
+
+    def report(self) -> Dict:
+        """The ``observe_fleet`` feed: the PR-12 fleet block plus the
+        lifecycle ``tenants`` detail (exporter ->
+        ``gan4j_fleet_tenant_*`` series + healthz ``fleet.tenants``)."""
+        active = self.active_ids()
+        return {
+            "tenants": len(active),
+            "steps_per_sec": self._steps_per_sec,
+            "dispatch_ms": self._dispatch_ms,
+            "ok": self.health.report().get("ok", True),
+            "tenants_detail": {
+                "active": len(active),
+                "cohorts": len(self.cohorts),
+                "quarantined": sorted(self.quarantined),
+                "quarantine_reasons": dict(sorted(
+                    self.quarantined.items())),
+                "onboarded_total": self.onboarded_total,
+                "offboarded_total": self.offboarded_total,
+                "throttled_total": self.throttled_total,
+                "onboard_latency_ms": self.onboard_latency_ms,
+            },
+        }
+
+
+class LifecycleFleetTrainer:
+    """The heterogeneous lifecycle fleet as ONE payload behind the one
+    ``SupervisionShell`` — every cohort's dispatches, the health
+    sentinel, and all lifecycle boundary ops run inside a single
+    install/teardown bracket (recorder -> watchdog -> sentinel ->
+    exporter), exactly like ``GANTrainer`` and ``FleetTrainer``.
+
+    ``feed(window) -> (features, labels)`` supplies each window's raw
+    row stream (the chaos harness poisons a tenant by poisoning its
+    segment's rows here).  ``on_warm(manager)`` fires after
+    :meth:`FleetManager.warmup` — the hook the e2e uses to ARM its
+    RecompileSentinel for the zero-recompile proof."""
+
+    def __init__(self, specs: Sequence[TenantSpec],
+                 config: LifecycleConfig,
+                 metrics_port: Optional[int] = None,
+                 events_enabled: bool = True):
+        from gan_deeplearning4j_tpu.telemetry.exporter import (
+            MetricsRegistry,
+        )
+
+        self.c = config
+        self.registry = MetricsRegistry()
+        self.health = resilient.DataHealth()
+        self.registry.observe_data(self.health.report)
+        self.manager = FleetManager(specs, config,
+                                    registry=self.registry,
+                                    health=self.health)
+        self.registry.observe_fleet(self.manager.report)
+        self._metrics_port = metrics_port
+        self._events = events_enabled
+        self.metrics_port: Optional[int] = None
+
+    def train(self, feed: Callable[[int], Tuple], windows: int,
+              steps_per_window: int,
+              on_warm: Optional[Callable] = None,
+              stop: Optional[Callable[[int], bool]] = None,
+              log: Callable[[str], None] = print) -> Dict:
+        from gan_deeplearning4j_tpu.train.shell import SupervisionShell
+
+        m = self.manager
+        shell = SupervisionShell(
+            self.registry, self.c.res_path,
+            events_enabled=self._events,
+            step_fn=lambda: m.step_count,
+            metrics_port=self._metrics_port, log=log)
+
+        def _payload():
+            self.metrics_port = shell.metrics_port
+            m.warmup()
+            if on_warm is not None:
+                on_warm(m)
+            w = 0
+            while w < windows:
+                feats, labs = feed(w)
+                m.step_window(feats, labs, steps_per_window)
+                w += 1
+                if stop is not None and stop(w):
+                    break
+            m.checkpoint_fleet()
+            r = m.report()
+            r["windows"] = w
+            r["steps"] = m.step_count
+            r["timelines"] = {
+                t: {k: np.asarray(v, np.float32)
+                    for k, v in h.items()}
+                for t, h in m.loss_history.items()}
+            return r
+
+        return shell.run(_payload)

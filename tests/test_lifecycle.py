@@ -1,0 +1,560 @@
+"""Tenant lifecycle layer (train/lifecycle.py): heterogeneous elastic
+fleets, dynamic onboard/offboard without recompile, per-tenant fault
+domains (ISSUE 20).
+
+The load-bearing property stacks on the PR-12 bitwise pin: lanes are
+element-wise independent, so EVERY surviving tenant's loss timeline is
+bit-equal (f32) to an undisturbed control through arbitrary lifecycle
+events — onboard, offboard, quarantine, poisoned cohort-mates.  The
+chaos e2e at the bottom is the acceptance scenario: a seeded
+``ChaosSchedule`` onboards two tenants mid-run, poisons one tenant's
+feed and another's params, offboards a healthy tenant, and the run
+ends with survivors bit-equal, the sick tenants quarantined and NAMED
+in /metrics + healthz, and zero post-warmup recompiles.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import time
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from gan_deeplearning4j_tpu.models import mlpgan_insurance as M
+from gan_deeplearning4j_tpu.runtime import prng
+from gan_deeplearning4j_tpu.train import fleet as fleet_lib
+from gan_deeplearning4j_tpu.train import fused_step as fused_lib
+from gan_deeplearning4j_tpu.train.lifecycle import (
+    DEFAULT_TENANT_BUCKETS,
+    FleetHealthSentinel,
+    FleetManager,
+    LifecycleConfig,
+    LifecycleFleetTrainer,
+    TenantSpec,
+    bucket_for,
+)
+
+B = 4           # rows per tenant per window
+SEGMENTS = 8    # fixed segment universe for every fleet here
+
+
+def _feed(window: int, segments: int = SEGMENTS, batch: int = B):
+    """Deterministic per-window row stream: ``segments * batch`` rows,
+    row ``r`` owned by segment ``r % segments`` — seeded per WINDOW so
+    a chaos run and its control see byte-identical bytes."""
+    rng = np.random.RandomState(1000 + window)
+    feats = rng.uniform(0.0, 1.0,
+                        (segments * batch, 12)).astype(np.float32)
+    labels = (rng.uniform(size=(segments * batch, 1))
+              < 0.5).astype(np.float32)
+    return feats, labels
+
+
+def _tenant_rows(feats, labels, tenant: int,
+                 segments: int = SEGMENTS, batch: int = B):
+    """The rows ``TenantRouter.route_tables`` hands tenant ``tenant``
+    from a clean ``_feed`` window (segment slice, first ``batch``)."""
+    return (np.asarray(feats)[tenant::segments][:batch],
+            np.asarray(labels)[tenant::segments][:batch])
+
+
+def _control_invariants(seed: int):
+    """The manager's y_real/y_fake/ones, rebuilt from the same seeded
+    streams (FleetManager.__init__)."""
+    root = prng.root_key(seed)
+    ones = jnp.ones((B, 1), jnp.float32)
+    y_real = ones + 0.05 * jax.random.normal(
+        prng.stream(root, "soften-real"), (B, 1), dtype=jnp.float32)
+    y_fake = 0.05 * jax.random.normal(
+        prng.stream(root, "soften-fake"), (B, 1), dtype=jnp.float32)
+    return y_real, y_fake, ones
+
+
+def _control_keys(seed: int, tenant: int):
+    root = prng.root_key(seed)
+    return (jax.random.fold_in(prng.stream(root, "fleet-z"), tenant),
+            jax.random.fold_in(prng.stream(root, "fleet-rng"), tenant))
+
+
+def _single_step(hidden: int = 100, gen_layers: int = 3,
+                 seed: int = prng.NUMBER_OF_THE_BEAST):
+    """The pre-fleet single-model program for one architecture — the
+    control every lifecycle lane must match bitwise."""
+    cfg = M.InsuranceConfig(seed=seed, hidden=hidden,
+                            gen_layers=gen_layers)
+    dis = M.build_discriminator(cfg)
+    graphs = (dis, M.build_generator(cfg), M.build_gan(cfg),
+              M.build_classifier(dis, cfg))
+    step = fused_lib.make_protocol_step(
+        *graphs, M.DIS_TO_GAN, M.gan_to_gen_map(cfg),
+        M.DIS_TO_CLASSIFIER, z_size=cfg.z_size,
+        num_features=cfg.num_features, donate=False)
+    return step, fused_lib.state_from_graphs(*graphs)
+
+
+def _run_control(tenant: int, windows, steps_per_window: int,
+                 seed: int, hidden: int = 100, gen_layers: int = 3):
+    """Single-tenant control timeline over ``windows`` (window
+    indices), same folded keys / softened labels / routed rows as a
+    lifecycle lane."""
+    step, state = _single_step(hidden, gen_layers, seed)
+    zk, rk = _control_keys(seed, tenant)
+    y_real, y_fake, ones = _control_invariants(seed)
+    d_tl, g_tl = [], []
+    for w in windows:
+        feats, labels = _feed(w)
+        f_t, l_t = _tenant_rows(feats, labels, tenant)
+        for _ in range(steps_per_window):
+            state, (d, g, _c) = step(
+                state, jnp.asarray(f_t), jnp.asarray(l_t), zk, rk,
+                y_real, y_fake, ones)
+            d_tl.append(float(np.asarray(d)))
+            g_tl.append(float(np.asarray(g)))
+    return np.asarray(d_tl, np.float32), np.asarray(g_tl, np.float32), \
+        state
+
+
+def _config(tmp_path, **kw):
+    kw.setdefault("batch_size", B)
+    kw.setdefault("num_segments", SEGMENTS)
+    kw.setdefault("record_timelines", True)
+    return LifecycleConfig(res_path=str(tmp_path), **kw)
+
+
+# -- units --------------------------------------------------------------------
+
+
+def test_bucket_for_and_cohort_grouping(tmp_path):
+    assert bucket_for(1, DEFAULT_TENANT_BUCKETS) == 2
+    assert bucket_for(5, DEFAULT_TENANT_BUCKETS) == 8
+    with pytest.raises(ValueError):
+        bucket_for(65, DEFAULT_TENANT_BUCKETS)
+
+    specs = [TenantSpec(0), TenantSpec(1),
+             TenantSpec(3, hidden=64, gen_layers=2),
+             TenantSpec(4, hidden=64, gen_layers=2)]
+    mgr = FleetManager(specs, _config(tmp_path))
+    assert sorted(mgr.cohorts) == ["h100_l3", "h64_l2"]
+    assert mgr.cohorts["h100_l3"].capacity == 2
+    assert mgr.cohorts["h64_l2"].capacity == 2
+    assert mgr.active_ids() == [0, 1, 3, 4]
+    # ghost slots appear as None in the persisted tenant map
+    assert mgr.cohorts["h100_l3"].tenant_map()["slots"] == [0, 1]
+
+
+def test_health_sentinel_nan_and_divergence():
+    s = FleetHealthSentinel(factor=10.0, patience=2)
+    assert s.observe(0, [0.7, 0.6], [0.7, 0.8]) is None
+    assert s.observe(0, [np.nan, 0.6], [0.7, 0.8]) == "nan"
+    # divergence: build history, then exceed factor x median twice
+    for _ in range(4):
+        assert s.observe(1, [1.0, 1.0], [1.0, 1.0]) is None
+    assert s.observe(1, [100.0, 100.0], [100.0, 100.0]) is None
+    assert s.observe(1, [100.0, 100.0], [100.0, 100.0]) == "divergence"
+    s.forget(1)
+    assert s.observe(1, [100.0] * 2, [100.0] * 2) is None
+
+
+# -- bitwise controls ---------------------------------------------------------
+
+
+def test_lifecycle_matches_single_tenant_controls(tmp_path):
+    """A heterogeneous lifecycle fleet's per-tenant d/g timelines are
+    bitwise-equal (f32) to single-tenant control runs — for BOTH
+    architectures (the hetero cohort uses its own depth's weight-sync
+    map, so this pins ``gan_to_gen_map`` too)."""
+    specs = [TenantSpec(0), TenantSpec(2),
+             TenantSpec(5, hidden=64, gen_layers=2)]
+    cfg = _config(tmp_path)
+    mgr = FleetManager(specs, cfg)
+    windows, spw = 3, 2
+    for w in range(windows):
+        feats, labels = _feed(w)
+        mgr.step_window(feats, labels, spw)
+    for t, (hid, gl) in ((0, (100, 3)), (2, (100, 3)),
+                         (5, (64, 2))):
+        d, g, state = _run_control(t, range(windows), spw, cfg.seed,
+                                   hid, gl)
+        np.testing.assert_array_equal(
+            np.asarray(mgr.loss_history[t]["d"], np.float32), d,
+            err_msg=f"d timeline t{t}")
+        np.testing.assert_array_equal(
+            np.asarray(mgr.loss_history[t]["g"], np.float32), g,
+            err_msg=f"g timeline t{t}")
+        cohort = mgr.cohort_of(t)
+        lane = jax.tree.map(
+            lambda x: np.asarray(x)[cohort.slot_of(t)], cohort.state)
+        for i, (a, b) in enumerate(zip(jax.tree.leaves(lane),
+                                       jax.tree.leaves(state))):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b),
+                                          err_msg=f"t{t} leaf {i}")
+
+
+def test_onboard_matches_fresh_control(tmp_path):
+    """A tenant onboarded at window 2 trains from the template init —
+    its timeline is bit-equal to a fresh single-tenant control run over
+    windows 2.. (onboarding is a mask flip, not a perturbation)."""
+    cfg = _config(tmp_path)
+    mgr = FleetManager([TenantSpec(0), TenantSpec(1)], cfg)
+    spw = 2
+    for w in range(2):
+        feats, labels = _feed(w)
+        mgr.step_window(feats, labels, spw)
+    ms = mgr.onboard(TenantSpec(6))
+    assert ms > 0.0 and mgr.onboard_latency_ms > 0.0
+    for w in range(2, 5):
+        feats, labels = _feed(w)
+        mgr.step_window(feats, labels, spw)
+    d, g, _ = _run_control(6, range(2, 5), spw, cfg.seed)
+    np.testing.assert_array_equal(
+        np.asarray(mgr.loss_history[6]["d"], np.float32), d)
+    np.testing.assert_array_equal(
+        np.asarray(mgr.loss_history[6]["g"], np.float32), g)
+    # and the veterans never noticed: full-run control still matches
+    d0, _, _ = _run_control(0, range(5), spw, cfg.seed)
+    np.testing.assert_array_equal(
+        np.asarray(mgr.loss_history[0]["d"], np.float32), d0)
+
+
+def test_offboard_final_checkpoint_and_reonboard(tmp_path):
+    """Offboarding writes a final per-tenant checkpoint (1-tenant
+    fleet save, identity map) the tenant can be re-onboarded from,
+    resuming bit-equal where it left off."""
+    cfg = _config(tmp_path)
+    mgr = FleetManager([TenantSpec(0), TenantSpec(3)], cfg)
+    spw = 2
+    for w in range(2):
+        feats, labels = _feed(w)
+        mgr.step_window(feats, labels, spw)
+    cohort = mgr.cohort_of(3)
+    before = jax.tree.map(
+        lambda x: np.asarray(x)[cohort.slot_of(3)], cohort.state)
+    mgr.offboard(3)
+    assert 3 not in mgr.active_ids()
+    assert 3 not in mgr.router.tenants
+    ck_dir = os.path.join(str(tmp_path), "offboarded", "tenant3")
+    ck = fleet_lib.FleetCheckpointer(ck_dir, sweep_debris=False)
+    _, restored, extra = ck.restore(tenants=3)
+    for i, (a, b) in enumerate(zip(jax.tree.leaves(before),
+                                   jax.tree.leaves(restored))):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b),
+                                      err_msg=f"final ckpt leaf {i}")
+    assert extra["fleet_tenant_map"]["slots"] == [3]
+    # re-onboard from the final checkpoint: the lane resumes in place
+    mgr.onboard(TenantSpec(3), from_checkpoint=ck_dir)
+    cohort = mgr.cohort_of(3)
+    lane = jax.tree.map(
+        lambda x: np.asarray(x)[cohort.slot_of(3)], cohort.state)
+    for a, b in zip(jax.tree.leaves(before), jax.tree.leaves(lane)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+# -- satellite pins -----------------------------------------------------------
+
+
+def test_router_stable_ids_across_lifecycle(tmp_path):
+    """Satellite 1: routing keys on STABLE tenant ids, not positional
+    ``r % N`` — a surviving tenant's routed rows are identical before
+    and after an onboard AND an offboard."""
+    router = fleet_lib.TenantRouter(
+        str(tmp_path), tenants=[0, 2, 5], num_segments=SEGMENTS,
+        raise_on_budget=False)
+    feats, labels = _feed(0)
+    f1, l1, _ = router.route_tables(feats, labels, B)
+    rows_t2 = f1[router.tenants.index(2)].copy()
+
+    router.add_tenant(6)
+    f2, _, _ = router.route_tables(feats, labels, B)
+    np.testing.assert_array_equal(
+        f2[router.tenants.index(2)], rows_t2,
+        err_msg="onboard moved a survivor's rows")
+
+    router.remove_tenant(0)
+    f3, _, info = router.route_tables(feats, labels, B)
+    np.testing.assert_array_equal(
+        f3[router.tenants.index(2)], rows_t2,
+        err_msg="offboard moved a survivor's rows")
+    # the vacated segment's rows drop to unrouted, nobody inherits them
+    assert info.unrouted >= B
+    np.testing.assert_array_equal(
+        rows_t2, _tenant_rows(feats, labels, 2)[0])
+
+
+def test_router_quota_throttles_hot_tenant(tmp_path):
+    """Token-bucket ingest quotas: a tenant over its row allowance has
+    the EXCESS dropped (counted), neighbours keep their full share."""
+    router = fleet_lib.TenantRouter(
+        str(tmp_path), tenants=[0, 1], num_segments=2,
+        quota_rows=B, quota_refill_per_s=1e-3, raise_on_budget=False)
+    rng = np.random.RandomState(0)
+    feats = rng.uniform(size=(2 * 4 * B, 12)).astype(np.float32)
+    labels = np.ones((2 * 4 * B, 1), np.float32)
+    _f, _l, info = router.route_tables(feats, labels, B)
+    assert info.throttled.get(0, 0) >= 3 * B - 1
+    assert info.throttled.get(1, 0) >= 3 * B - 1
+    assert not info.starved  # each still fielded its full table
+
+
+def test_checkpoint_tenant_map_roundtrip_and_refusal(tmp_path):
+    """Satellite 2: the tenant-id -> slot/cohort map rides MANIFEST
+    extras; ``restore(tenants=...)`` resolves by IDENTITY and a
+    disagreeing ``expect_map`` is refused with a typed error naming
+    both mappings."""
+    cfg = _config(tmp_path)
+    mgr = FleetManager([TenantSpec(0), TenantSpec(4)], cfg)
+    feats, labels = _feed(0)
+    mgr.step_window(feats, labels, 1)
+    mgr.checkpoint_fleet()
+    ck = mgr.checkpointer_for("h100_l3")
+    stored_map = mgr.cohorts["h100_l3"].tenant_map()
+
+    # restore BY ID: tenant 4 lives in slot 1
+    _, by_id, _ = ck.restore(tenants=4)
+    cohort = mgr.cohort_of(4)
+    lane = jax.tree.map(
+        lambda x: np.asarray(x)[cohort.slot_of(4)], cohort.state)
+    for a, b in zip(jax.tree.leaves(lane), jax.tree.leaves(by_id)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    # matching expectation passes; disagreeing one is refused, typed,
+    # naming both mappings
+    ck.restore(expect_map=stored_map)
+    bogus = {"slots": [4, 0], "cohorts": stored_map["cohorts"]}
+    with pytest.raises(fleet_lib.TenantMappingError) as ei:
+        ck.restore(expect_map=bogus)
+    assert "[0, 4]" in str(ei.value) and "[4, 0]" in str(ei.value)
+    with pytest.raises(fleet_lib.TenantMappingError):
+        ck.restore(tenants=99)
+
+
+def test_param_poison_quarantines_only_sick_tenant(tmp_path):
+    """Satellite 3: a NaN-poisoned tenant trips ITS OWN sentinel
+    (reason ``nan``); cohort-mates' d/g timelines stay bitwise-equal
+    to an undisturbed control, and the quarantined tenant is named in
+    /metrics and healthz."""
+    from gan_deeplearning4j_tpu.telemetry.exporter import (
+        MetricsRegistry,
+    )
+
+    reg = MetricsRegistry()
+    cfg = _config(tmp_path)
+    specs = [TenantSpec(0), TenantSpec(1), TenantSpec(2)]
+    mgr = FleetManager(specs, cfg, registry=reg)
+    reg.observe_fleet(mgr.report)
+    spw = 2
+    for w in range(2):
+        feats, labels = _feed(w)
+        mgr.step_window(feats, labels, spw)
+    mgr.poison_params(1)
+    for w in range(2, 4):
+        feats, labels = _feed(w)
+        rep = mgr.step_window(feats, labels, spw)
+    assert mgr.quarantined == {1: "nan"}
+    assert 1 not in mgr.active_ids()
+    assert 1 not in rep["losses"]
+    # cohort-mates: full-run control still bit-equal
+    for t in (0, 2):
+        d, g, _ = _run_control(t, range(4), spw, cfg.seed)
+        np.testing.assert_array_equal(
+            np.asarray(mgr.loss_history[t]["d"], np.float32), d,
+            err_msg=f"survivor t{t} d timeline")
+        np.testing.assert_array_equal(
+            np.asarray(mgr.loss_history[t]["g"], np.float32), g,
+            err_msg=f"survivor t{t} g timeline")
+    # the poisoned tenant's timeline DID record the NaN window
+    assert not np.isfinite(
+        np.asarray(mgr.loss_history[1]["d"])).all()
+    # named on the wire: labeled gauge in /metrics, id in healthz
+    txt = reg.render()
+    assert 'gan4j_fleet_tenant_quarantined{tenant="1"} 1' in txt
+    assert "gan4j_fleet_tenant_quarantined_total 1" in txt
+    doc = reg.health()
+    detail = doc["fleet"]["tenants_detail"]
+    assert detail["quarantined"] == [1]
+    assert detail["quarantine_reasons"] == {"1": "nan"}
+    # the quarantine ledger names it too
+    ledger = os.path.join(str(tmp_path), "quarantine_fleet.jsonl")
+    lines = [json.loads(x) for x in open(ledger)]
+    assert lines and lines[-1]["tenant"] == 1
+    assert lines[-1]["reason"] == "nan"
+
+
+def test_sharded_masked_fleet_matches_vmap(cpu_devices):
+    """The masked fleet step shard_mapped over the 8-device tenant
+    mesh == the plain masked vmap, bitwise — the lifecycle mask keeps
+    the tenant axis embarrassingly parallel (zero collectives)."""
+    from gan_deeplearning4j_tpu.parallel import fleet as pfleet
+
+    num_tenants, steps = 16, 2
+    cfg = M.InsuranceConfig()
+    dis = M.build_discriminator(cfg)
+    graphs = (dis, M.build_generator(cfg), M.build_gan(cfg),
+              M.build_classifier(dis, cfg))
+    maps = (M.DIS_TO_GAN, M.GAN_TO_GEN, M.DIS_TO_CLASSIFIER)
+    feats = jnp.asarray(np.random.RandomState(3).uniform(
+        size=(B, 12)).astype(np.float32))
+    labels = jnp.ones((B, 1), jnp.float32)
+    ones = jnp.ones((B, 1), jnp.float32)
+    y_fake = jnp.zeros((B, 1), jnp.float32)
+    root = prng.root_key()
+    zks = fleet_lib.tenant_keys(prng.stream(root, "fleet-z"),
+                                num_tenants)
+    rks = fleet_lib.tenant_keys(prng.stream(root, "fleet-rng"),
+                                num_tenants)
+    mask = jnp.asarray(
+        np.array([True, False] * (num_tenants // 2)))
+    template = fused_lib.state_from_graphs(*graphs)
+    state_v = fleet_lib.replicate_state(template, num_tenants)
+
+    vstep = fleet_lib.make_fleet_step(
+        *graphs, *maps, z_size=cfg.z_size,
+        num_features=cfg.num_features, masked=True, donate=False)
+    mesh = pfleet.tenant_mesh(8)
+    sstep = pfleet.make_sharded_fleet_step(
+        *graphs, *maps, z_size=cfg.z_size,
+        num_features=cfg.num_features, mesh=mesh, masked=True,
+        donate=False)
+    state_s = pfleet.shard_fleet_state(state_v, mesh)
+    for _ in range(steps):
+        state_v, loss_v = vstep(state_v, feats, labels, zks, rks,
+                                mask, ones, y_fake, ones)
+        state_s, loss_s = sstep(state_s, feats, labels, zks, rks,
+                                mask, ones, y_fake, ones)
+    for a, b in zip(jax.tree.leaves(loss_v), jax.tree.leaves(loss_s)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    for i, (a, b) in enumerate(zip(jax.tree.leaves(state_v),
+                                   jax.tree.leaves(state_s))):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b),
+                                      err_msg=f"state leaf {i}")
+    # masked lanes really froze
+    it = np.asarray(state_v.it)
+    assert it[0] == steps and it[1] == 0
+
+
+# -- the acceptance scenario --------------------------------------------------
+
+
+def _wait_fired(sched, names, timeout_s: float = 30.0):
+    """Block until every action in ``names`` has fired (the e2e's
+    window gates: a queued boundary op then lands at a KNOWN window)."""
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        fired = {f["name"] for f in list(sched.fired)}
+        if names <= fired:
+            return
+        time.sleep(0.005)
+    raise AssertionError(f"chaos actions {names} never fired")
+
+
+def test_lifecycle_chaos_e2e(tmp_path, recompile_sentinel):
+    """ISSUE 20 acceptance: a seeded ``ChaosSchedule`` conducts —
+    onboard 2 tenants mid-run (one mask flip, one bucket hop), poison
+    one tenant's feed and another's params, offboard a healthy tenant
+    — and the run ends with survivors' loss timelines bit-equal (f32)
+    to an undisturbed control, both sick tenants quarantined and named
+    in /metrics and healthz, zero post-warmup recompiles, and a
+    nonzero ``onboard_latency_ms``."""
+    from gan_deeplearning4j_tpu.testing import chaos
+
+    out_dir = os.environ.get("GAN4J_LIFECYCLE_OUT")
+    res = out_dir if out_dir else str(tmp_path / "chaos")
+    specs = [TenantSpec(0), TenantSpec(1), TenantSpec(2),
+             TenantSpec(5),
+             TenantSpec(3, hidden=64, gen_layers=2),
+             TenantSpec(4, hidden=64, gen_layers=2)]
+    spw, windows = 2, 8
+    cfg = LifecycleConfig(
+        batch_size=B, res_path=res, num_segments=SEGMENTS,
+        quarantine_budget=B, record_timelines=True)
+
+    # ---- control first (its compiles must precede arming) ----
+    ctl = FleetManager(specs, dataclasses.replace(
+        cfg, res_path=str(tmp_path / "ctl")))
+    for w in range(windows):
+        feats, labels = _feed(w)
+        ctl.step_window(feats, labels, spw)
+
+    # ---- the chaos run ----
+    trainer = LifecycleFleetTrainer(specs, cfg, events_enabled=True)
+    mgr = trainer.manager
+    poisoner = chaos.TenantFeedPoisoner(
+        lambda w: _feed(w), tenant=1, num_segments=SEGMENTS)
+    sched = chaos.ChaosSchedule(seed=20)
+    sched.add(0.02, "onboard_t6",
+              lambda: mgr.request(
+                  lambda: mgr.onboard(TenantSpec(6, hidden=64,
+                                                 gen_layers=2))))
+    sched.add(0.03, "onboard_t7",
+              lambda: mgr.request(lambda: mgr.onboard(TenantSpec(7))))
+    sched.add(0.05, "poison_params_t2",
+              lambda: chaos.poison_tenant_params(mgr, 2))
+    sched.add(0.06, "poison_feed_t1", poisoner.arm)
+    sched.add(0.08, "offboard_t5",
+              lambda: mgr.request(lambda: mgr.offboard(5)))
+
+    def feed(w):
+        # window gates: block until the scheduled injections have been
+        # QUEUED, so each boundary op lands at a known window no matter
+        # how fast the loop runs (the schedule stays the conductor)
+        if w == 2:
+            _wait_fired(sched, {"onboard_t6", "onboard_t7"})
+        if w == 4:
+            _wait_fired(sched, {"poison_params_t2", "poison_feed_t1"})
+        if w == 6:
+            _wait_fired(sched, {"offboard_t5"})
+        return poisoner(w)
+
+    with sched:
+        report = trainer.train(
+            feed, windows=windows, steps_per_window=spw,
+            on_warm=lambda m: recompile_sentinel.arm(),
+            log=lambda *_: None)
+    assert sched.report()["errors"] == 0, sched.report()
+
+    detail = report["tenants_detail"]
+    # both sick tenants quarantined, reasons typed
+    assert mgr.quarantined[2] == "nan"
+    assert mgr.quarantined[1] == "data-quarantine-budget"
+    assert detail["quarantined"] == [1, 2]
+    # the healthy offboard happened and left a final checkpoint
+    assert 5 not in mgr.active_ids()
+    assert detail["offboarded_total"] == 1
+    off_ck = fleet_lib.FleetCheckpointer(
+        os.path.join(res, "offboarded", "tenant5"), sweep_debris=False)
+    off_ck.restore(tenants=5)
+    # both onboards landed and are training
+    assert detail["onboarded_total"] == 2
+    assert 6 in mgr.active_ids() and 7 in mgr.active_ids()
+    assert detail["onboard_latency_ms"] > 0.0
+    assert np.isfinite(mgr.loss_history[6]["d"]).all()
+    assert np.isfinite(mgr.loss_history[7]["d"]).all()
+
+    # survivors bit-equal (f32) to the undisturbed control — across
+    # BOTH cohorts, through every lifecycle event
+    for t in (0, 3, 4):
+        for k in ("d", "g", "clf"):
+            np.testing.assert_array_equal(
+                np.asarray(mgr.loss_history[t][k], np.float32),
+                np.asarray(ctl.loss_history[t][k], np.float32),
+                err_msg=f"survivor t{t} {k} timeline")
+
+    # sick tenants named on the wire
+    txt = trainer.registry.render()
+    assert 'gan4j_fleet_tenant_quarantined{tenant="1"} 1' in txt
+    assert 'gan4j_fleet_tenant_quarantined{tenant="2"} 1' in txt
+    doc = trainer.registry.health()
+    got = doc["fleet"]["tenants_detail"]
+    assert got["quarantined"] == [1, 2]
+    assert got["quarantine_reasons"]["2"] == "nan"
+    # the quarantine ledger survives as a forensic artifact
+    ledger = os.path.join(res, "quarantine_fleet.jsonl")
+    assert {json.loads(x)["tenant"] for x in open(ledger)} == {1, 2}
+    # zero post-warmup recompiles: recompile_sentinel (armed in
+    # on_warm) fails the test at teardown if ANY program compiled
+    # during the chaos phase
