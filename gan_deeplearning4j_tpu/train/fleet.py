@@ -247,7 +247,8 @@ class RouteInfo:
     starved: list    # live tenants with < rows_per_tenant clean rows
     tripped: list    # tenants whose quarantine budget blew this call
     throttled: Dict[int, int]  # tenant -> rows dropped by its quota
-    unrouted: int    # rows whose segment has no live tenant
+    unrouted: int    # rows whose segment had no live tenant THIS call
+    # (the router's ``unrouted`` attribute keeps the lifetime total)
 
 
 class TenantRouter:
@@ -387,13 +388,14 @@ class TenantRouter:
         per_lab: Dict[int, list] = {t: [] for t in self.tenants}
         tripped: set = set()
         throttled: Dict[int, int] = {}
+        unrouted = 0
         live = set(self.tenants)
         bad = ~(np.isfinite(feats).all(axis=1)
                 & np.isfinite(labs).all(axis=1))
         for r in range(feats.shape[0]):
             t = r % self.num_segments
             if t not in live:
-                self.unrouted += 1
+                unrouted += 1
                 continue
             if bad[r]:
                 if t in tripped:
@@ -416,12 +418,14 @@ class TenantRouter:
                     continue
             per_feat[t].append(feats[r])
             per_lab[t].append(labs[r])
-        return feats, labs, per_feat, per_lab, tripped, throttled
+        self.unrouted += unrouted
+        return (feats, labs, per_feat, per_lab, tripped, throttled,
+                unrouted)
 
     def route(self, features, labels, source: str = "<memory>"):
         """``(rows, F), (rows, L)`` -> ``(N, m, F), (N, m, L)`` stacked
         per-tenant tables (f32), bad rows quarantined per tenant."""
-        _, _, per_feat, per_lab, _, _ = self._gather(
+        _, _, per_feat, per_lab, _, _, _ = self._gather(
             features, labels, source)
         m = min(len(v) for v in per_feat.values())
         if m == 0:
@@ -446,7 +450,7 @@ class TenantRouter:
         is ``tripped``; neither truncates or stalls cohort-mates, which
         is what keeps survivors' loss timelines bit-equal to an
         undisturbed control under feed poison."""
-        feats, labs, per_feat, per_lab, tripped, throttled = \
+        feats, labs, per_feat, per_lab, tripped, throttled, unrouted = \
             self._gather(features, labels, source)
         nt = len(self.tenants)
         out_f = np.zeros((nt, rows_per_tenant, feats.shape[1]),
@@ -464,7 +468,7 @@ class TenantRouter:
             out_f[i] = np.stack(got[:rows_per_tenant])
             out_l[i] = np.stack(per_lab[t][:rows_per_tenant])
         info = RouteInfo(starved=starved, tripped=sorted(tripped),
-                         throttled=throttled, unrouted=self.unrouted)
+                         throttled=throttled, unrouted=unrouted)
         return out_f, out_l, info
 
 

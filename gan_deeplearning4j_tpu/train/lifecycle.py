@@ -282,12 +282,16 @@ class Cohort:
             self._ensure_capacity(len(self.occupied_ids()) + 1)
             free = [i for i, t in enumerate(self.slots) if t is None]
         slot = free[0]
-        if params is not None and self.state is not None:
+        if params is not None:
+            # materialize state FIRST: a brand-new cohort (state None)
+            # must not silently drop the restored params and restart
+            # the tenant from the template init
+            self.ensure_state()
             host = _np_state(self.state)
             rows = [_row(host, s) for s in range(self.capacity)]
             rows[slot] = jax.tree.map(np.asarray, params)
             self.state = jax.device_put(_stack_rows(rows))
-        elif params is None and self.state is not None:
+        elif self.state is not None:
             # the vacated slot may hold a previous occupant's rows —
             # reset to the template so a fresh onboard starts at init
             host = _np_state(self.state)
@@ -461,32 +465,38 @@ class FleetManager:
         nxt = [b for b in caps if b > cohort.capacity][:1]
         return upto + nxt
 
+    def _warm_cohort(self, cohort: Cohort) -> List[int]:
+        """Compile one cohort's (bucket) programs against scratch
+        state; returns the warmed bucket list."""
+        B = self.c.batch_size
+        cfg = cohort.model_cfg
+        caps = self._warm_caps(cohort)
+        for cap in caps:
+            scratch = jax.device_put(
+                _stack_rows([cohort._template] * cap))
+            data = jnp.asarray(
+                np.full((cap, B, cfg.num_features), 0.5, np.float32))
+            labs = jnp.asarray(np.ones((cap, B, 1), np.float32))
+            zks = jnp.stack([self._z_base] * cap)
+            rks = jnp.stack([self._r_base] * cap)
+            mask = jnp.asarray(np.ones((cap,), bool))
+            out, losses = cohort.step(scratch, data, labs, zks, rks,
+                                      mask, self._y_real,
+                                      self._y_fake, self._ones)
+            device_fence(losses)
+            del out
+        return caps
+
     def warmup(self) -> Dict[str, List[int]]:
         """Compile every (cohort, bucket) program + the lifecycle
         helper ops once.  After this, membership churn within the
         warmed bucket set causes ZERO further compiles — the armed
         ``RecompileSentinel`` in the lifecycle-chaos e2e is the
-        proof."""
-        B = self.c.batch_size
+        proof.  (A post-warmup onboard of a NEW architecture warms its
+        cohort inside :meth:`onboard`, charged to onboard latency.)"""
         warmed: Dict[str, List[int]] = {}
         for cohort in self.cohorts.values():
-            cfg = cohort.model_cfg
-            caps = self._warm_caps(cohort)
-            warmed[cohort.key] = caps
-            for cap in caps:
-                scratch = jax.device_put(
-                    _stack_rows([cohort._template] * cap))
-                data = jnp.asarray(
-                    np.full((cap, B, cfg.num_features), 0.5, np.float32))
-                labs = jnp.asarray(np.ones((cap, B, 1), np.float32))
-                zks = jnp.stack([self._z_base] * cap)
-                rks = jnp.stack([self._r_base] * cap)
-                mask = jnp.asarray(np.ones((cap,), bool))
-                out, losses = cohort.step(scratch, data, labs, zks, rks,
-                                          mask, self._y_real,
-                                          self._y_fake, self._ones)
-                device_fence(losses)
-                del out
+            warmed[cohort.key] = self._warm_cohort(cohort)
         # the checkpoint tree form's empty-dict marker is the one eager
         # device op on the save path — warm its tiny fill program
         device_fence(jnp.zeros((), jnp.int32))
@@ -527,8 +537,16 @@ class FleetManager:
             ck = fleet_lib.FleetCheckpointer(from_checkpoint,
                                              sweep_debris=False)
             _, params, _ = ck.restore(tenants=spec.tenant_id)
+        new_cohort = spec.cohort_key not in self.cohorts
         cohort = self._admit_spec(spec, params=params)
         cohort.ensure_state()
+        if new_cohort and self._warmed:
+            # a new architecture after warmup: compile its bucket
+            # programs HERE (charged to onboard latency) so the
+            # training loop keeps the zero-recompile guarantee
+            self._warm_cohort(cohort)
+            telemetry_events.instant("fleet.cohort_warm_on_onboard",
+                                     cohort=cohort.key)
         self._cohort_key_vecs(cohort)  # rebuild eagerly: part of latency
         self.router.add_tenant(spec.tenant_id)
         ms = (time.perf_counter() - t0) * 1e3
@@ -550,10 +568,16 @@ class FleetManager:
         checkpoint path."""
         cohort = self.cohort_of(tenant)
         final = cohort.vacate(tenant)
-        self.router.remove_tenant(tenant)
+        # quarantine already stopped routing this tenant — offboarding
+        # a quarantined tenant must not raise through the fleet loop
+        if tenant in self.router.tenants:
+            self.router.remove_tenant(tenant)
         self.specs.pop(tenant, None)
         self._key_vecs.pop(cohort.key, None)
         self.sentinel.forget(tenant)
+        # the tenant leaves quarantine with its slot: report()/healthz
+        # stop naming it, and a later re-onboard is quarantinable again
+        self.quarantined.pop(tenant, None)
         path = None
         ck = fleet_lib.FleetCheckpointer(
             os.path.join(self.c.res_path, "offboarded",

@@ -385,6 +385,108 @@ def test_param_poison_quarantines_only_sick_tenant(tmp_path):
     assert lines[-1]["reason"] == "nan"
 
 
+# -- review regressions -------------------------------------------------------
+
+
+def test_reonboard_from_checkpoint_into_new_cohort(tmp_path):
+    """``onboard(spec, from_checkpoint=...)`` whose architecture
+    creates a BRAND-NEW cohort (no live cohort of that (hidden,
+    gen_layers)) must slice in the restored params — not silently
+    restart the tenant from the template init."""
+    cfg = _config(tmp_path)
+    mgr = FleetManager([TenantSpec(0),
+                        TenantSpec(3, hidden=64, gen_layers=2)], cfg)
+    for w in range(2):
+        feats, labels = _feed(w)
+        mgr.step_window(feats, labels, 2)
+    cohort = mgr.cohort_of(3)
+    before = jax.tree.map(
+        lambda x: np.asarray(x)[cohort.slot_of(3)], cohort.state)
+    mgr.offboard(3)
+    ck_dir = os.path.join(str(tmp_path), "offboarded", "tenant3")
+
+    # a second fleet that has NEVER seen the h64_l2 architecture:
+    # admit() lands in a cohort whose state is still None
+    mgr2 = FleetManager([TenantSpec(0)],
+                        _config(tmp_path / "second"))
+    mgr2.onboard(TenantSpec(3, hidden=64, gen_layers=2),
+                 from_checkpoint=ck_dir)
+    cohort2 = mgr2.cohort_of(3)
+    lane = jax.tree.map(
+        lambda x: np.asarray(x)[cohort2.slot_of(3)], cohort2.state)
+    for i, (a, b) in enumerate(zip(jax.tree.leaves(before),
+                                   jax.tree.leaves(lane))):
+        np.testing.assert_array_equal(
+            np.asarray(a), np.asarray(b),
+            err_msg=f"restored leaf {i} dropped on new-cohort admit")
+
+
+def test_offboard_quarantined_tenant_via_fleet_loop(tmp_path):
+    """Offboarding a QUARANTINED tenant (already dropped from the
+    router) must not raise through step_window's boundary-op drain,
+    must clear its quarantine record, and a re-onboarded tenant must
+    be quarantinable AGAIN."""
+    cfg = _config(tmp_path)
+    mgr = FleetManager([TenantSpec(0), TenantSpec(1)], cfg)
+    feats, labels = _feed(0)
+    mgr.step_window(feats, labels, 1)
+    mgr.poison_params(1)
+    feats, labels = _feed(1)
+    mgr.step_window(feats, labels, 1)
+    assert mgr.quarantined == {1: "nan"}
+    # queued offboard drains inside the NEXT window — the fleet-loop
+    # path a single-tenant op must never take down
+    mgr.request(lambda: mgr.offboard(1))
+    feats, labels = _feed(2)
+    mgr.step_window(feats, labels, 1)
+    assert 1 not in mgr.specs and 1 not in mgr.active_ids()
+    assert mgr.quarantined == {}
+    assert mgr.report()["tenants_detail"]["quarantined"] == []
+    # re-onboard: a fresh lane whose sentinel can trip again
+    mgr.onboard(TenantSpec(1))
+    mgr.poison_params(1)
+    feats, labels = _feed(3)
+    mgr.step_window(feats, labels, 1)
+    assert mgr.quarantined == {1: "nan"}
+
+
+def test_route_info_unrouted_is_per_call(tmp_path):
+    """``RouteInfo.unrouted`` reports THIS call's dropped rows (the
+    other RouteInfo fields are per-call outcomes); the router's
+    ``unrouted`` attribute keeps the lifetime total."""
+    router = fleet_lib.TenantRouter(
+        str(tmp_path), tenants=[0], num_segments=2,
+        raise_on_budget=False)
+    feats, labels = _feed(0, segments=2)
+    _, _, info1 = router.route_tables(feats, labels, B)
+    _, _, info2 = router.route_tables(feats, labels, B)
+    assert info1.unrouted == B
+    assert info2.unrouted == B          # per-call, not cumulative
+    assert router.unrouted == 2 * B     # lifetime total
+
+
+def test_new_architecture_onboard_after_warmup(tmp_path,
+                                               recompile_sentinel):
+    """A post-warmup onboard whose architecture creates a NEW cohort
+    compiles that cohort's bucket programs INSIDE onboard (charged to
+    onboard latency) — the training loop afterwards stays
+    recompile-free under an armed sentinel."""
+    cfg = _config(tmp_path)
+    mgr = FleetManager([TenantSpec(0)], cfg)
+    mgr.warmup()
+    feats, labels = _feed(0)
+    mgr.step_window(feats, labels, 1)
+    mgr.onboard(TenantSpec(3, hidden=64, gen_layers=2))
+    recompile_sentinel.arm()
+    for w in range(1, 3):
+        feats, labels = _feed(w)
+        mgr.step_window(feats, labels, 1)
+    assert 3 in mgr.active_ids()
+    assert np.isfinite(mgr.loss_history[3]["d"]).all()
+    # teardown: the armed sentinel fails the test on ANY compile
+    # after the onboard returned
+
+
 def test_sharded_masked_fleet_matches_vmap(cpu_devices):
     """The masked fleet step shard_mapped over the 8-device tenant
     mesh == the plain masked vmap, bitwise — the lifecycle mask keeps
